@@ -1,0 +1,44 @@
+"""gubernator_tpu — a TPU-native distributed rate-limiting framework.
+
+A ground-up re-design of the capabilities of gubernator (reference:
+/root/reference, pure Go) for TPU hardware:
+
+* the counter hot path (token/leaky bucket mutation over millions of keys) runs
+  as vectorized int64/f64 kernels over an HBM-resident hash-slotted
+  struct-of-arrays state table (replaces reference algorithms.go + lrucache.go
+  + workers.go);
+* cluster key-ownership maps onto TPU mesh axes via shard_map/pjit (replaces
+  reference replicated_hash.go node spread);
+* GLOBAL-behavior hit aggregation + authoritative broadcast become mesh
+  collectives over ICI/DCN (replaces reference global.go gRPC fan-out);
+* a thin host front door keeps the gRPC/HTTP API surface, peer discovery,
+  health and Prometheus metrics (reference daemon.go / gubernator.go).
+
+int64 timestamps (epoch milliseconds) and float64 leaky-bucket remainders
+require jax x64 mode, enabled at import.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from gubernator_tpu.types import (  # noqa: E402
+    Algorithm,
+    Behavior,
+    Status,
+    RateLimitRequest,
+    RateLimitResponse,
+    has_behavior,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Algorithm",
+    "Behavior",
+    "Status",
+    "RateLimitRequest",
+    "RateLimitResponse",
+    "has_behavior",
+    "__version__",
+]

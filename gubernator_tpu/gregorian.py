@@ -1,0 +1,100 @@
+"""Gregorian-calendar expiration, host-side.
+
+Calendar math cannot live in a jitted kernel (data-dependent, irregular), so —
+exactly like the reference, which computes it inline per request
+(reference interval.go:84-148, algorithms.go:127-132,214-219,337-353) — the
+front door resolves DURATION_IS_GREGORIAN requests into absolute expiry
+timestamps and interval lengths before the batch reaches the device.
+
+Semantics parity with reference interval.go:
+* expiration = end of the current minute/hour/day/month/year, in epoch ms
+  (inclusive end: last representable instant truncated to ms);
+* interval duration = full length of that calendar interval in ms;
+* GregorianWeeks is rejected (reference interval.go:88-89 does the same).
+
+Local time: the reference uses the process's local timezone (Go time package
+default). We use the host's local timezone via datetime.astimezone().
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+
+from gubernator_tpu.types import Gregorian
+
+_MS = 1000
+
+
+class GregorianError(ValueError):
+    pass
+
+
+def _local(now_ms: int) -> _dt.datetime:
+    return _dt.datetime.fromtimestamp(now_ms / 1000.0).astimezone()
+
+
+def _to_ms(d: _dt.datetime) -> int:
+    return int(d.timestamp() * 1000)
+
+
+def gregorian_duration(now_ms: int, d: int) -> int:
+    """Full length of the calendar interval containing `now`, in ms
+    (reference interval.go:84-110)."""
+    if d == Gregorian.MINUTES:
+        return 60_000
+    if d == Gregorian.HOURS:
+        return 3_600_000
+    if d == Gregorian.DAYS:
+        return 86_400_000
+    if d == Gregorian.MONTHS:
+        n = _local(now_ms)
+        begin = n.replace(day=1, hour=0, minute=0, second=0, microsecond=0)
+        end = _add_months(begin, 1)
+        return _to_ms(end) - _to_ms(begin)
+    if d == Gregorian.YEARS:
+        n = _local(now_ms)
+        begin = n.replace(month=1, day=1, hour=0, minute=0, second=0, microsecond=0)
+        end = begin.replace(year=begin.year + 1)
+        return _to_ms(end) - _to_ms(begin)
+    if d == Gregorian.WEEKS:
+        raise GregorianError("`duration = GregorianWeeks` not supported")
+    raise GregorianError(
+        "behavior DURATION_IS_GREGORIAN is set; but `duration` is not a valid "
+        "gregorian interval"
+    )
+
+
+def gregorian_expiration(now_ms: int, d: int) -> int:
+    """Epoch-ms expiry = end of the calendar interval containing `now`
+    (reference interval.go:112-148). The reference returns the interval end
+    minus one nanosecond, truncated to ms — i.e. the last whole millisecond
+    strictly inside the interval."""
+    n = _local(now_ms)
+    if d == Gregorian.MINUTES:
+        begin = n.replace(second=0, microsecond=0)
+        return _to_ms(begin) + 60_000 - 1
+    if d == Gregorian.HOURS:
+        begin = n.replace(minute=0, second=0, microsecond=0)
+        return _to_ms(begin) + 3_600_000 - 1
+    if d == Gregorian.DAYS:
+        begin = n.replace(hour=0, minute=0, second=0, microsecond=0)
+        return _to_ms(begin) + 86_400_000 - 1
+    if d == Gregorian.MONTHS:
+        begin = n.replace(day=1, hour=0, minute=0, second=0, microsecond=0)
+        return _to_ms(_add_months(begin, 1)) - 1
+    if d == Gregorian.YEARS:
+        begin = n.replace(month=1, day=1, hour=0, minute=0, second=0, microsecond=0)
+        return _to_ms(begin.replace(year=begin.year + 1)) - 1
+    if d == Gregorian.WEEKS:
+        raise GregorianError("`duration = GregorianWeeks` not supported")
+    raise GregorianError(
+        "behavior DURATION_IS_GREGORIAN is set; but `duration` is not a valid "
+        "gregorian interval"
+    )
+
+
+def _add_months(d: _dt.datetime, months: int) -> _dt.datetime:
+    month = d.month - 1 + months
+    year = d.year + month // 12
+    month = month % 12 + 1
+    return d.replace(year=year, month=month)

@@ -1,0 +1,26 @@
+"""Key fingerprinting.
+
+The reference hashes the request key twice: once to pick the owning peer
+(consistent hash over fnv1a, reference replicated_hash.go:104-119) and once to
+pick a worker shard (63-bit xxhash, reference workers.go:185-189). The TPU
+build collapses both into one 64-bit xxhash fingerprint computed host-side:
+
+* high bits select the owning device shard (parallel/, M3+);
+* `fp mod capacity` selects the HBM slot within a shard (ops/decide.py).
+
+Strings never reach the device — only fingerprints do. fp == 0 is reserved as
+the empty-slot sentinel, so real fingerprints are remapped away from 0.
+"""
+
+from __future__ import annotations
+
+import xxhash
+
+_SEED = 0x6775626572  # arbitrary fixed seed; must be identical across peers
+
+
+def fingerprint(name: str, unique_key: str) -> int:
+    """64-bit fingerprint of a rate limit's hash key (name + "_" + key,
+    composition per reference client.go:39-41). Never returns 0."""
+    h = xxhash.xxh64_intdigest(name + "_" + unique_key, seed=_SEED)
+    return h if h != 0 else 1

@@ -1,0 +1,188 @@
+"""Device-side batch layout + host-side packing.
+
+A `ReqBatch` is the SoA form of a slice of RateLimitRequests after host-side
+resolution: strings → fingerprints, Gregorian durations → absolute expiries and
+interval lengths, leaky burst defaulting (burst==0 → limit, reference
+algorithms.go:259-261). The kernel (ops/decide.py) requires all fingerprints
+within one batch to be distinct — the pass planner (ops/plan.py) guarantees
+that, reproducing the reference's per-key sequential semantics (the worker
+hash-ring serializes same-key requests, reference workers.go:185-189).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from gubernator_tpu import gregorian
+from gubernator_tpu.hashing import fingerprint
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest, has_behavior
+
+
+class ReqBatch(NamedTuple):
+    """All arrays shape (B,). Fingerprints must be unique among active rows."""
+
+    fp: jnp.ndarray  # uint64
+    algo: jnp.ndarray  # int32
+    behavior: jnp.ndarray  # int32 bitflags
+    hits: jnp.ndarray  # int64
+    limit: jnp.ndarray  # int64
+    burst: jnp.ndarray  # int64 (resolved: 0 → limit)
+    duration: jnp.ndarray  # int64 raw request duration (ms, or Gregorian enum)
+    created_at: jnp.ndarray  # int64 epoch ms ("now" for this request)
+    expire_new: jnp.ndarray  # int64 absolute expiry for new/renewed token items
+    greg_interval: jnp.ndarray  # int64 full Gregorian interval ms (0 ⇒ not gregorian)
+    duration_eff: jnp.ndarray  # int64 effective duration for leaky expiry updates
+    active: jnp.ndarray  # bool padding mask
+
+    @property
+    def size(self) -> int:
+        return self.fp.shape[0]
+
+
+class RespBatch(NamedTuple):
+    """Kernel outputs, shape (B,), in the same row order as the ReqBatch."""
+
+    status: jnp.ndarray  # int32
+    limit: jnp.ndarray  # int64
+    remaining: jnp.ndarray  # int64
+    reset_time: jnp.ndarray  # int64
+    cache_hit: jnp.ndarray  # bool — row found a live matching slot
+    dropped: jnp.ndarray  # bool — no slot could be claimed (decision not persisted)
+
+
+class BatchStats(NamedTuple):
+    """Per-dispatch scalar counters feeding the Prometheus layer
+    (reference lrucache.go:48-59, gubernator.go:76-80)."""
+
+    cache_hits: jnp.ndarray  # int64
+    cache_misses: jnp.ndarray  # int64
+    over_limit: jnp.ndarray  # int64 — rows answered OVER_LIMIT
+    evicted_unexpired: jnp.ndarray  # int64 — live slots evicted for new keys
+    dropped: jnp.ndarray  # int64 — rows that failed slot claiming
+
+
+class HostBatch(NamedTuple):
+    """numpy staging form, built by pack_requests, before device transfer."""
+
+    fp: np.ndarray
+    algo: np.ndarray
+    behavior: np.ndarray
+    hits: np.ndarray
+    limit: np.ndarray
+    burst: np.ndarray
+    duration: np.ndarray
+    created_at: np.ndarray
+    expire_new: np.ndarray
+    greg_interval: np.ndarray
+    duration_eff: np.ndarray
+    active: np.ndarray
+
+
+def pack_requests(
+    requests: Sequence[RateLimitRequest],
+    now_ms: int,
+    pad_to: Optional[int] = None,
+) -> "tuple[HostBatch, List[Optional[str]]]":
+    """Resolve and pack requests into numpy SoA (host hot path).
+
+    Returns (batch, errors): errors[i] is a per-request error string — the row
+    is left inactive and must be answered with RateLimitResponse.error, exactly
+    as the reference isolates invalid items instead of failing the batch
+    (reference gubernator.go:215-237).
+
+    Resolution performed here, mirroring host-side work in the reference:
+    * validation: empty unique_key / name rejected (reference gubernator.go:215-224,
+      including its quirky "field 'namespace' cannot be empty" wording)
+    * created_at stamped with `now_ms` when unset (reference gubernator.go:225-227)
+    * leaky burst==0 → limit (reference algorithms.go:259-261)
+    * Gregorian: expire_new = end-of-interval, greg_interval = interval length,
+      duration_eff = expire_new - now (reference algorithms.go:337-353,440-449);
+      invalid Gregorian durations become per-request errors
+    * non-Gregorian: expire_new = created_at + duration, duration_eff = duration
+    """
+    n = len(requests)
+    size = pad_to if pad_to is not None else n
+    if size < n:
+        raise ValueError("pad_to smaller than batch")
+    errors: List[Optional[str]] = [None] * n
+    b = HostBatch(
+        fp=np.zeros(size, dtype=np.uint64),
+        algo=np.zeros(size, dtype=np.int32),
+        behavior=np.zeros(size, dtype=np.int32),
+        hits=np.zeros(size, dtype=np.int64),
+        limit=np.zeros(size, dtype=np.int64),
+        burst=np.zeros(size, dtype=np.int64),
+        duration=np.zeros(size, dtype=np.int64),
+        created_at=np.zeros(size, dtype=np.int64),
+        expire_new=np.zeros(size, dtype=np.int64),
+        greg_interval=np.zeros(size, dtype=np.int64),
+        duration_eff=np.zeros(size, dtype=np.int64),
+        active=np.zeros(size, dtype=bool),
+    )
+    for i, r in enumerate(requests):
+        if r.unique_key == "":
+            errors[i] = "field 'unique_key' cannot be empty"
+            continue
+        if r.name == "":
+            errors[i] = "field 'namespace' cannot be empty"
+            continue
+        created = r.created_at if r.created_at is not None and r.created_at != 0 else now_ms
+        b.fp[i] = fingerprint(r.name, r.unique_key)
+        b.algo[i] = int(r.algorithm)
+        b.behavior[i] = int(r.behavior)
+        b.hits[i] = r.hits
+        b.limit[i] = r.limit
+        b.duration[i] = r.duration
+        b.created_at[i] = created
+        if int(r.algorithm) == Algorithm.LEAKY_BUCKET and r.burst == 0:
+            b.burst[i] = r.limit
+        else:
+            b.burst[i] = r.burst
+        if has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN):
+            try:
+                expire = gregorian.gregorian_expiration(now_ms, r.duration)
+                b.greg_interval[i] = gregorian.gregorian_duration(now_ms, r.duration)
+            except gregorian.GregorianError as e:
+                errors[i] = str(e)
+                b.fp[i] = 0
+                continue
+            b.expire_new[i] = expire
+            b.duration_eff[i] = expire - now_ms
+        else:
+            b.expire_new[i] = created + r.duration
+            b.greg_interval[i] = 0
+            b.duration_eff[i] = r.duration
+        b.active[i] = True
+    return b, errors
+
+
+def pad_batch(b: HostBatch, to_size: int) -> HostBatch:
+    """Zero-pad every field to `to_size` rows (inactive padding)."""
+    n = b.fp.shape[0]
+    if n == to_size:
+        return b
+    if n > to_size:
+        raise ValueError("cannot pad smaller")
+    return HostBatch(
+        *[np.concatenate([f, np.zeros(to_size - n, dtype=f.dtype)]) for f in b]
+    )
+
+
+def to_device(b: HostBatch) -> ReqBatch:
+    return ReqBatch(
+        fp=jnp.asarray(b.fp),
+        algo=jnp.asarray(b.algo),
+        behavior=jnp.asarray(b.behavior),
+        hits=jnp.asarray(b.hits),
+        limit=jnp.asarray(b.limit),
+        burst=jnp.asarray(b.burst),
+        duration=jnp.asarray(b.duration),
+        created_at=jnp.asarray(b.created_at),
+        expire_new=jnp.asarray(b.expire_new),
+        greg_interval=jnp.asarray(b.greg_interval),
+        duration_eff=jnp.asarray(b.duration_eff),
+        active=jnp.asarray(b.active),
+    )

@@ -1,0 +1,339 @@
+"""The vectorized rate-limit decision kernel.
+
+One call replaces the reference's whole per-request inner stack — worker
+channel → LRU map lookup → token/leaky bucket state machine (reference
+workers.go:195-330 → lrucache.go:88-128 → algorithms.go:37-492) — with a single
+jitted batch update over the HBM table:
+
+    table', responses, stats = decide(table, batch)
+
+Phases (all batch-parallel, static shapes, no host sync):
+ 1. probe     — K linear probes per row; classify slots (live match / expired /
+                empty / foreign).
+ 2. claim     — insertion rows resolve slot contention with a scatter-max
+                "compare-and-swap" loop (K rounds); eviction prefers expired
+                slots then the soonest-expiring live slot (expiry-stamp
+                eviction ≈ the reference's LRU evict, lrucache.go:138-149).
+ 3. apply     — branchless token + leaky bucket math under masks, reproducing
+                the exact decision tables of reference algorithms.go (see
+                per-step citations inline).
+ 4. scatter   — write back every per-slot field at the claimed slots; build
+                responses in original row order.
+
+Correctness contract: fingerprints must be unique among active rows (the pass
+planner, ops/plan.py, guarantees it). This reproduces the reference's per-key
+serialization: gubernator's worker hash-ring ensures same-key requests apply
+sequentially (workers.go:185-189); here "sequentially" = "in separate passes".
+
+Deliberate divergences from the reference (documented, not cargo-culted):
+* Expiry uses the request's `created_at` as "now" instead of a wall-clock read
+  (reference cache.go:43-57 reads MillisecondNow()); the front door stamps
+  created_at at ingress, and tests get frozen time for free.
+* New-item leaky-bucket rate under DURATION_IS_GREGORIAN uses the Gregorian
+  interval length, where the reference divides by the raw enum value
+  (algorithms.go:438-449) yielding a nonsense reset_time — a known reference
+  quirk we fix (SURVEY.md §7 watch list).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from gubernator_tpu.ops.batch import BatchStats, ReqBatch, RespBatch
+from gubernator_tpu.ops.table import Table
+from gubernator_tpu.types import Algorithm, Behavior, Status
+
+# Slot-preference sort keys for the claim phase.
+_KEY_LOCKED = jnp.int64(1) << 62  # slot owned/claimed by another row: unusable
+_KEY_EVICT = jnp.int64(1) << 45  # live foreign slot: usable at eviction cost
+
+
+@partial(jax.jit, static_argnames=("probes",), donate_argnums=(0,))
+def decide(
+    table: Table, req: ReqBatch, probes: int = 8
+) -> Tuple[Table, RespBatch, BatchStats]:
+    """Apply one batch of rate-limit checks to the table. See module docstring."""
+    C = table.fp.shape[0]
+    B = req.fp.shape[0]
+    K = probes
+    i64 = jnp.int64
+
+    now = req.created_at  # per-row "now" (epoch ms)
+
+    # ------------------------------------------------------------------ probe
+    base = (req.fp % jnp.uint64(C)).astype(jnp.int32)
+    offs = jnp.arange(K, dtype=jnp.int32)
+    idx = (base[:, None] + offs[None, :]) % C  # (B, K) int32
+    slot_fp = table.fp[idx]
+    slot_exp = table.expire_at[idx]
+    slot_inv = table.invalid_at[idx]
+
+    # Expired ⇔ the reference's lazy IsExpired() removal on read
+    # (cache.go:43-57: ExpireAt < now, or InvalidAt ∈ (0, now)).
+    expired = (slot_exp < now[:, None]) | ((slot_inv != 0) & (slot_inv < now[:, None]))
+    empty = slot_fp == jnp.uint64(0)
+    fpm = (slot_fp == req.fp[:, None]) & ~empty & req.active[:, None]
+    match_live = fpm & ~expired
+    has_live = match_live.any(axis=1)
+    j_live = jnp.argmax(match_live, axis=1)
+    match_exp = fpm & expired
+    has_matchexp = match_exp.any(axis=1) & ~has_live
+    j_matchexp = jnp.argmax(match_exp, axis=1)
+
+    owns = has_live | has_matchexp  # row already has a slot with its fp
+    own_j = jnp.where(has_live, j_live, j_matchexp)
+    own_slot = jnp.take_along_axis(idx, own_j[:, None], axis=1)[:, 0]
+
+    # ------------------------------------------------------------------ claim
+    # Slots any row owns are off-limits to other rows' insert/evict.
+    DROP = jnp.int32(C)  # out-of-range scatter index → mode="drop"
+    locked = jnp.zeros(C, dtype=bool)
+    locked = locked.at[jnp.where(owns, own_slot, DROP)].set(True, mode="drop")
+
+    vacant = empty | expired
+    # Per-probe preference key (ascending better): vacant slots in probe order,
+    # then live foreign slots by soonest expiry, locked slots last.
+    pref_key = jnp.where(
+        vacant,
+        offs[None, :].astype(i64),
+        _KEY_EVICT + jnp.clip(slot_exp, 0, _KEY_EVICT - 1),
+    )
+    pref_key = jnp.where(locked[idx], _KEY_LOCKED + offs[None, :].astype(i64), pref_key)
+    order = jnp.argsort(pref_key, axis=1)  # (B, K) probe indices, best first
+    sorted_slots = jnp.take_along_axis(idx, order, axis=1)
+    sorted_keys = jnp.take_along_axis(pref_key, order, axis=1)
+
+    need = req.active & ~owns
+    ptr = jnp.zeros(B, dtype=jnp.int32)
+    assigned = jnp.where(owns, own_slot, DROP)
+    resolved = owns
+    taken = locked
+    # K rounds of claim-or-advance: each unresolved row bids its best remaining
+    # slot via a scatter-max of its fingerprint; the max fp wins the slot.
+    for _ in range(K):
+        cand_slot = jnp.take_along_axis(sorted_slots, ptr[:, None], axis=1)[:, 0]
+        cand_key = jnp.take_along_axis(sorted_keys, ptr[:, None], axis=1)[:, 0]
+        usable = cand_key < _KEY_LOCKED
+        free = ~taken[cand_slot]
+        trying = need & ~resolved & usable & free
+        bids = jnp.zeros(C, dtype=jnp.uint64)
+        bids = bids.at[jnp.where(trying, cand_slot, DROP)].max(req.fp, mode="drop")
+        won = trying & (bids[cand_slot] == req.fp)
+        assigned = jnp.where(won, cand_slot, assigned)
+        resolved = resolved | won
+        taken = taken.at[jnp.where(won, cand_slot, DROP)].set(True, mode="drop")
+        advance = need & ~resolved
+        ptr = jnp.minimum(ptr + advance.astype(jnp.int32), K - 1)
+
+    dropped = req.active & ~resolved
+    # Eviction of a live foreign slot (key ≥ _KEY_EVICT ⇒ the claimed slot was
+    # not vacant) — the reference's "unexpired evictions" alarm counter
+    # (lrucache.go:138-149).
+    claimed_key = jnp.take_along_axis(sorted_keys, ptr[:, None], axis=1)[:, 0]
+    evicted_unexpired = need & resolved & (claimed_key >= _KEY_EVICT)
+
+    safe_slot = jnp.minimum(assigned, C - 1)
+    exists = has_live  # live fp match ⇒ the reference's cache hit
+
+    # ---------------------------------------------------------------- gather
+    s_algo = table.algo[safe_slot]
+    s_status = table.status[safe_slot]
+    s_limit = table.limit[safe_slot]
+    s_duration = table.duration[safe_slot]
+    s_rem_i = table.remaining_i[safe_slot]
+    s_rem_f = table.remaining_f[safe_slot]
+    s_stamp = table.stamp[safe_slot]
+    s_burst = table.burst[safe_slot]
+    s_exp = table.expire_at[safe_slot]
+
+    is_greg = (req.behavior & int(Behavior.DURATION_IS_GREGORIAN)) != 0
+    is_reset = (req.behavior & int(Behavior.RESET_REMAINING)) != 0
+    is_drain = (req.behavior & int(Behavior.DRAIN_OVER_LIMIT)) != 0
+    is_token = req.algo == int(Algorithm.TOKEN_BUCKET)
+    h = req.hits
+
+    # Existing-item path applies only when algorithms agree; a stored item of
+    # the other algorithm is discarded and recreated ("client switched
+    # algorithms", reference algorithms.go:96-105,307-317).
+    algo_match = exists & (s_algo == req.algo)
+
+    # ==================================================== token bucket
+    # reference algorithms.go:37-252
+    OVER = jnp.int32(int(Status.OVER_LIMIT))
+    UNDER = jnp.int32(int(Status.UNDER_LIMIT))
+
+    # --- existing item (algorithms.go:107-194)
+    # limit change: add the delta to remaining, clamp at 0 (go:108-115)
+    t_rem = jnp.where(
+        s_limit != req.limit, jnp.maximum(s_rem_i + req.limit - s_limit, 0), s_rem_i
+    )
+    # duration change (go:125-146): recompute expiry from the item's CreatedAt;
+    # if that would place us already expired, renew the bucket.
+    dur_changed = s_duration != req.duration
+    expire_dc = jnp.where(is_greg, req.expire_new, s_stamp + req.duration)
+    renew = dur_changed & (expire_dc <= now)
+    expire_dc = jnp.where(renew, now + req.duration, expire_dc)
+    t_created = jnp.where(renew, now, s_stamp)
+    t_rem = jnp.where(renew, req.limit, t_rem)
+    t_exp = jnp.where(dur_changed, expire_dc, s_exp)
+    t_reset = t_exp
+
+    zero_hits = h == 0
+    at_limit = (t_rem == 0) & (h > 0)  # go:161-168
+    exact = ~zero_hits & ~at_limit & (t_rem == h)  # go:171-175
+    overask = ~zero_hits & ~at_limit & ~exact & (h > t_rem)  # go:179-190
+    consume = ~zero_hits & ~at_limit & ~exact & ~overask  # go:192-194
+
+    tok_rem_out = jnp.where(
+        exact | (overask & is_drain), i64(0), jnp.where(consume, t_rem - h, t_rem)
+    )
+    # response status starts from the stored (sticky) status (go:117-122); only
+    # the at-limit branch persists OVER back to the item (go:165-166).
+    tok_resp_status = jnp.where(at_limit | overask, OVER, s_status)
+    tok_stored_status = jnp.where(at_limit, OVER, s_status)
+    tok_resp_rem = tok_rem_out
+    tok_resp_reset = t_reset
+
+    # --- new item (algorithms.go:202-252)
+    new_over = h > req.limit
+    tokn_rem = jnp.where(new_over, req.limit, req.limit - h)
+    tokn_status = jnp.where(new_over, OVER, UNDER)
+    tokn_exp = req.expire_new
+
+    tok_is_new = ~algo_match
+    tok_fp_out = req.fp
+    tok_status_out = jnp.where(tok_is_new, UNDER, tok_stored_status)
+    tok_rem_store = jnp.where(tok_is_new, tokn_rem, tok_rem_out)
+    tok_created_out = jnp.where(tok_is_new, now, t_created)
+    tok_exp_out = jnp.where(tok_is_new, tokn_exp, t_exp)
+    tok_resp_status = jnp.where(tok_is_new, tokn_status, tok_resp_status)
+    tok_resp_rem = jnp.where(tok_is_new, tokn_rem, tok_resp_rem)
+    tok_resp_reset = jnp.where(tok_is_new, tokn_exp, tok_resp_reset)
+
+    # RESET_REMAINING on an existing item removes it outright and reports a
+    # full bucket (go:82-94) — modeled as writing back an empty slot.
+    tok_reset_rm = exists & is_reset
+    tok_fp_out = jnp.where(tok_reset_rm, jnp.uint64(0), tok_fp_out)
+    tok_resp_status = jnp.where(tok_reset_rm, UNDER, tok_resp_status)
+    tok_resp_rem = jnp.where(tok_reset_rm, req.limit, tok_resp_rem)
+    tok_resp_reset = jnp.where(tok_reset_rm, i64(0), tok_resp_reset)
+
+    # ==================================================== leaky bucket
+    # reference algorithms.go:255-492. Remaining is float64 (store.go:32);
+    # comparisons truncate toward zero exactly like Go's int64(float64).
+    f64 = jnp.float64
+    lk_is_new = ~algo_match
+    rate = jnp.where(is_greg, req.greg_interval, req.duration).astype(f64) / jnp.maximum(
+        req.limit, 1
+    ).astype(f64)
+    irate = rate.astype(i64)
+
+    # --- existing item (go:304-430)
+    b_rem = jnp.where(is_reset, s_burst.astype(f64), s_rem_f)  # go:319-321
+    burst_changed = s_burst != req.burst
+    b_rem = jnp.where(  # go:324-329
+        burst_changed & (req.burst > b_rem.astype(i64)), req.burst.astype(f64), b_rem
+    )
+    # leak since UpdatedAt; only applied once a whole token has leaked
+    # (go:359-366: `if int64(leak) > 0`)
+    elapsed = (now - s_stamp).astype(f64)
+    leak = elapsed / rate
+    leak_applies = leak.astype(i64) > 0
+    b_rem = jnp.where(leak_applies, b_rem + leak, b_rem)
+    lk_stamp = jnp.where(leak_applies, now, s_stamp)
+    # clamp to burst (go:368-370)
+    b_rem = jnp.where(b_rem.astype(i64) > req.burst, req.burst.astype(f64), b_rem)
+
+    lk_rem_now = b_rem.astype(i64)
+    lk_at_limit = (lk_rem_now == 0) & (h > 0)  # go:388-394
+    lk_exact = ~lk_at_limit & (lk_rem_now == h)  # go:397-402 (note: catches h==0,rem==0)
+    lk_overask = ~lk_at_limit & ~lk_exact & (h > lk_rem_now)  # go:406-419
+    lk_zero = ~lk_at_limit & ~lk_exact & ~lk_overask & (h == 0)  # go:422-424
+    lk_consume = ~lk_at_limit & ~lk_exact & ~lk_overask & ~lk_zero
+
+    lk_rem_out = jnp.where(
+        lk_exact | (lk_overask & is_drain),
+        f64(0.0),
+        jnp.where(lk_consume, b_rem - h.astype(f64), b_rem),
+    )
+    lk_resp_status = jnp.where(lk_at_limit | lk_overask, OVER, UNDER)
+    lk_resp_rem = jnp.where(lk_overask & ~is_drain, lk_rem_now, lk_rem_out.astype(i64))
+    # reset_time is computed from the PRE-hit remaining (go:372-377) and only
+    # recomputed by the exact/consume branches (go:400,428) — a DRAIN_OVER_LIMIT
+    # rejection keeps the pre-drain reset_time.
+    lk_reset_basis = jnp.where(
+        lk_exact, i64(0), jnp.where(lk_consume, lk_rem_out.astype(i64), lk_rem_now)
+    )
+    lk_resp_reset = now + (req.limit - lk_reset_basis) * irate
+    # hits≠0 refreshes expiry before any verdict (go:355-357)
+    lk_exp = jnp.where(h != 0, now + req.duration_eff, s_exp)
+
+    # --- new item (go:436-492)
+    lkn_over = h > req.burst
+    lkn_rem = jnp.where(lkn_over, f64(0.0), (req.burst - h).astype(f64))
+    lkn_resp_rem = jnp.where(lkn_over, i64(0), req.burst - h)
+    lkn_status = jnp.where(lkn_over, OVER, UNDER)
+    lkn_reset = now + (req.limit - lkn_resp_rem) * irate
+    lkn_exp = now + req.duration_eff
+
+    lk_fp_out = req.fp
+    lk_rem_store = jnp.where(lk_is_new, lkn_rem, lk_rem_out)
+    lk_stamp_out = jnp.where(lk_is_new, now, lk_stamp)
+    lk_exp_out = jnp.where(lk_is_new, lkn_exp, lk_exp)
+    # stored duration: new items persist the effective (Gregorian-resolved)
+    # duration (go:452-458); existing items persist the raw request duration
+    # (go:332).
+    lk_dur_out = jnp.where(lk_is_new, req.duration_eff, req.duration)
+    lk_resp_status = jnp.where(lk_is_new, lkn_status, lk_resp_status)
+    lk_resp_rem = jnp.where(lk_is_new, lkn_resp_rem, lk_resp_rem)
+    lk_resp_reset = jnp.where(lk_is_new, lkn_reset, lk_resp_reset)
+
+    # ==================================================== merge + scatter
+    fp_out = jnp.where(is_token, tok_fp_out, lk_fp_out)
+    status_out = jnp.where(is_token, tok_status_out, UNDER)
+    rem_i_out = jnp.where(is_token, tok_rem_store, i64(0))
+    rem_f_out = jnp.where(is_token, f64(0.0), lk_rem_store)
+    stamp_out = jnp.where(is_token, tok_created_out, lk_stamp_out)
+    dur_out = jnp.where(is_token, req.duration, lk_dur_out)
+    exp_out = jnp.where(is_token, tok_exp_out, lk_exp_out)
+    burst_out = jnp.where(is_token, i64(0), req.burst)
+
+    w = jnp.where(req.active & resolved, assigned, DROP)
+    table = table._replace(
+        fp=table.fp.at[w].set(fp_out, mode="drop"),
+        algo=table.algo.at[w].set(req.algo, mode="drop"),
+        status=table.status.at[w].set(status_out, mode="drop"),
+        limit=table.limit.at[w].set(req.limit, mode="drop"),
+        duration=table.duration.at[w].set(dur_out, mode="drop"),
+        remaining_i=table.remaining_i.at[w].set(rem_i_out, mode="drop"),
+        remaining_f=table.remaining_f.at[w].set(rem_f_out, mode="drop"),
+        stamp=table.stamp.at[w].set(stamp_out, mode="drop"),
+        burst=table.burst.at[w].set(burst_out, mode="drop"),
+        expire_at=table.expire_at.at[w].set(exp_out, mode="drop"),
+        invalid_at=table.invalid_at.at[w].set(i64(0), mode="drop"),
+    )
+
+    resp_status = jnp.where(is_token, tok_resp_status, lk_resp_status)
+    resp_rem = jnp.where(is_token, tok_resp_rem, lk_resp_rem)
+    resp_reset = jnp.where(is_token, tok_resp_reset, lk_resp_reset)
+
+    resp = RespBatch(
+        status=jnp.where(req.active, resp_status, UNDER),
+        limit=jnp.where(req.active, req.limit, i64(0)),
+        remaining=jnp.where(req.active, resp_rem, i64(0)),
+        reset_time=jnp.where(req.active, resp_reset, i64(0)),
+        cache_hit=exists,
+        dropped=dropped,
+    )
+    stats = BatchStats(
+        cache_hits=exists.sum(dtype=i64),
+        cache_misses=(req.active & ~exists).sum(dtype=i64),
+        over_limit=(req.active & (resp.status == OVER)).sum(dtype=i64),
+        evicted_unexpired=evicted_unexpired.sum(dtype=i64),
+        dropped=dropped.sum(dtype=i64),
+    )
+    return table, resp, stats

@@ -1,0 +1,109 @@
+"""Single-device engine: the TPU analog of the reference WorkerPool.
+
+Owns one HBM table and turns lists of RateLimitRequests into responses by
+packing → pass-planning → dispatching the decision kernel. Replaces the
+reference's WorkerPool.GetRateLimit channel machinery (workers.go:266-330);
+"worker goroutines" collapse into SIMD lanes of one kernel call.
+
+Batches are padded to bucketed static shapes so jit caches a handful of
+compiled kernels instead of one per batch size.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from gubernator_tpu.ops.batch import pack_requests, pad_batch, to_device
+from gubernator_tpu.ops.decide import decide
+from gubernator_tpu.ops.plan import plan_passes
+from gubernator_tpu.ops.table import Table, new_table
+from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
+
+
+def ms_now() -> int:
+    # reference store.go MillisecondNow()
+    return time.time_ns() // 1_000_000
+
+
+def _pad_size(n: int, floor: int = 16) -> int:
+    size = floor
+    while size < n:
+        size *= 2
+    return size
+
+
+@dataclass
+class EngineStats:
+    """Host-side accumulation of kernel BatchStats (→ Prometheus layer)."""
+
+    cache_hits: int = 0
+    cache_misses: int = 0
+    over_limit: int = 0
+    evicted_unexpired: int = 0
+    dropped: int = 0
+    checks: int = 0
+    dispatches: int = 0
+
+    def accumulate(self, stats) -> None:
+        self.cache_hits += int(stats.cache_hits)
+        self.cache_misses += int(stats.cache_misses)
+        self.over_limit += int(stats.over_limit)
+        self.evicted_unexpired += int(stats.evicted_unexpired)
+        self.dropped += int(stats.dropped)
+
+
+class LocalEngine:
+    """One device-resident rate-limit table + its dispatch loop."""
+
+    def __init__(self, capacity: int = 50_000, probes: int = 8, max_exact_passes: int = 8):
+        self.table: Table = new_table(capacity)
+        self.probes = probes
+        self.max_exact_passes = max_exact_passes
+        self.stats = EngineStats()
+
+    def check(
+        self,
+        requests: Sequence[RateLimitRequest],
+        now_ms: Optional[int] = None,
+    ) -> List[RateLimitResponse]:
+        """Apply a batch; responses come back in request order (the API
+        contract, reference gubernator.proto:58-61)."""
+        if not requests:
+            return []
+        now = now_ms if now_ms is not None else ms_now()
+        hb, errors = pack_requests(requests, now)
+        out: List[Optional[RateLimitResponse]] = [None] * len(requests)
+        # invalid items answer with a per-request error instead of failing the
+        # batch (reference gubernator.go:215-237)
+        for i, err in enumerate(errors):
+            if err is not None:
+                out[i] = RateLimitResponse(error=err)
+        for p in plan_passes(hb, max_exact=self.max_exact_passes):
+            n = len(p.rows)
+            batch = pad_batch(p.batch, _pad_size(n))
+            rb = to_device(batch)
+            self.table, resp, stats = decide(self.table, rb, probes=self.probes)
+            self.stats.accumulate(stats)
+            self.stats.dispatches += 1
+            status = np.asarray(resp.status)
+            limit = np.asarray(resp.limit)
+            remaining = np.asarray(resp.remaining)
+            reset = np.asarray(resp.reset_time)
+            for i in range(n):
+                r = RateLimitResponse(
+                    status=int(status[i]),
+                    limit=int(limit[i]),
+                    remaining=int(remaining[i]),
+                    reset_time=int(reset[i]),
+                )
+                if p.member_rows:
+                    for row in p.member_rows[i]:
+                        out[int(row)] = r
+                else:
+                    out[int(p.rows[i])] = r
+        self.stats.checks += len(requests)
+        return out  # type: ignore[return-value]

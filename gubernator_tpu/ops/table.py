@@ -1,0 +1,80 @@
+"""HBM-resident rate-limit state: a hash-slotted struct-of-arrays table.
+
+This replaces the reference's per-worker LRU caches (reference lrucache.go:32-178,
+workers.go:19-37): instead of N goroutine-private `map[string]*list.Element`
+shards, a single fixed-capacity SoA of per-slot fields lives in device HBM and
+is mutated in place by the vectorized decision kernel (ops/decide.py) with
+donated buffers.
+
+Design choices vs the reference:
+* LRU eviction → expiry-stamp eviction: a slot whose `expire_at` has passed is
+  dead (the reference removes expired items on read, lrucache.go:111-128) and
+  may be reclaimed by any key probing it. When all probe slots for a new key
+  are live, the slot with the soonest expiry is evicted; if that expiry is
+  still in the future we count an "unexpired eviction", mirroring the
+  reference's over-capacity alarm metric (lrucache.go:138-149).
+* Per-slot fields mirror TokenBucketItem/LeakyBucketItem (reference
+  store.go:29-43) plus CacheItem's ExpireAt/InvalidAt (reference cache.go:29-41).
+  One int64 `remaining_i` for token buckets and one float64 `remaining_f` for
+  leaky buckets (the reference keeps a float64 remainder, store.go:32).
+* `stamp` holds TokenBucketItem.CreatedAt for token slots and
+  LeakyBucketItem.UpdatedAt for leaky slots.
+* fp == 0 marks an empty slot; fingerprints are remapped away from 0
+  (hashing.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class Table(NamedTuple):
+    """Per-slot state arrays, each of shape (capacity,)."""
+
+    fp: jnp.ndarray  # uint64 key fingerprint; 0 == empty
+    algo: jnp.ndarray  # int32 Algorithm
+    status: jnp.ndarray  # int32 Status (token bucket only; sticky)
+    limit: jnp.ndarray  # int64
+    duration: jnp.ndarray  # int64 (raw request duration; drives change detection)
+    remaining_i: jnp.ndarray  # int64 token-bucket remaining
+    remaining_f: jnp.ndarray  # float64 leaky-bucket remaining
+    stamp: jnp.ndarray  # int64 token CreatedAt / leaky UpdatedAt (epoch ms)
+    burst: jnp.ndarray  # int64 leaky-bucket burst
+    expire_at: jnp.ndarray  # int64 epoch ms (CacheItem.ExpireAt)
+    invalid_at: jnp.ndarray  # int64 epoch ms; 0 = never (CacheItem.InvalidAt)
+
+    @property
+    def capacity(self) -> int:
+        return self.fp.shape[0]
+
+
+def new_table(capacity: int) -> Table:
+    """Fresh empty table. `capacity` is the hard slot count (the analog of the
+    reference's CacheSize, default 50_000, reference config.go:151); keep load
+    factor ≤ ~0.5 for healthy probe lengths."""
+    if capacity <= 0:
+        raise ValueError("capacity must be positive")
+    return Table(
+        fp=jnp.zeros(capacity, dtype=jnp.uint64),
+        algo=jnp.zeros(capacity, dtype=jnp.int32),
+        status=jnp.zeros(capacity, dtype=jnp.int32),
+        limit=jnp.zeros(capacity, dtype=jnp.int64),
+        duration=jnp.zeros(capacity, dtype=jnp.int64),
+        remaining_i=jnp.zeros(capacity, dtype=jnp.int64),
+        remaining_f=jnp.zeros(capacity, dtype=jnp.float64),
+        stamp=jnp.zeros(capacity, dtype=jnp.int64),
+        burst=jnp.zeros(capacity, dtype=jnp.int64),
+        expire_at=jnp.zeros(capacity, dtype=jnp.int64),
+        invalid_at=jnp.zeros(capacity, dtype=jnp.int64),
+    )
+
+
+def live_count(table: Table, now_ms: int) -> int:
+    """Number of live (non-empty, unexpired) slots — the analog of the
+    reference cache Size() (lrucache.go:152-157)."""
+    fp = np.asarray(table.fp)
+    exp = np.asarray(table.expire_at)
+    return int(((fp != 0) & (exp >= now_ms)).sum())

@@ -1,0 +1,111 @@
+"""Core enums and request/response types.
+
+API parity with the reference proto surface (reference gubernator.proto:63-210):
+same enum values, same field names (snake_case), same semantics. These are the
+host-side (Python) representations; the device-side batch layout lives in
+ops/batch.py.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+class Algorithm(enum.IntEnum):
+    # reference gubernator.proto:64-68
+    TOKEN_BUCKET = 0
+    LEAKY_BUCKET = 1
+
+
+class Behavior(enum.IntFlag):
+    """Bitflag behaviors (reference gubernator.proto:71-142).
+
+    BATCHING is the implicit default (value 0 — "here because proto requires
+    it"); NO_BATCHING opts a request out of the forwarding batch window.
+    """
+
+    BATCHING = 0
+    NO_BATCHING = 1
+    GLOBAL = 2
+    DURATION_IS_GREGORIAN = 4
+    RESET_REMAINING = 8
+    MULTI_REGION = 16
+    DRAIN_OVER_LIMIT = 32
+
+
+class Status(enum.IntEnum):
+    # reference gubernator.proto:192-195
+    UNDER_LIMIT = 0
+    OVER_LIMIT = 1
+
+
+class Gregorian(enum.IntEnum):
+    """Valid `duration` values when DURATION_IS_GREGORIAN is set
+    (reference interval.go:74-81)."""
+
+    MINUTES = 0
+    HOURS = 1
+    DAYS = 2
+    WEEKS = 3  # rejected, like the reference
+    MONTHS = 4
+    YEARS = 5
+
+
+def has_behavior(behavior: int, flag: int) -> bool:
+    """reference behavior.go HasBehavior equivalent."""
+    return (int(behavior) & int(flag)) != 0
+
+
+# Millisecond duration helpers (reference gubernator.proto:157-162).
+SECOND = 1000
+MINUTE = 60 * SECOND
+HOUR = 60 * MINUTE
+
+
+@dataclass
+class RateLimitRequest:
+    """One rate-limit check. Field-for-field parity with reference
+    RateLimitReq (gubernator.proto:144-190)."""
+
+    name: str = ""
+    unique_key: str = ""
+    hits: int = 1
+    limit: int = 0
+    duration: int = 0  # milliseconds, or a Gregorian enum when flagged
+    algorithm: int = Algorithm.TOKEN_BUCKET
+    behavior: int = 0
+    burst: int = 0  # leaky bucket burst; 0 → defaults to limit
+    metadata: Optional[Dict[str, str]] = None
+    created_at: Optional[int] = None  # epoch ms; stamped at ingress if unset
+
+    def hash_key(self) -> str:
+        # reference client.go:39-41 — cache key is name + "_" + unique_key
+        return self.name + "_" + self.unique_key
+
+
+@dataclass
+class RateLimitResponse:
+    """Field-for-field parity with reference RateLimitResp
+    (gubernator.proto:197-210)."""
+
+    status: int = Status.UNDER_LIMIT
+    limit: int = 0
+    remaining: int = 0
+    reset_time: int = 0  # epoch ms when the limit is reset
+    error: str = ""
+    metadata: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class PeerInfo:
+    """reference peers.go PeerInfo equivalent."""
+
+    grpc_address: str = ""
+    http_address: str = ""
+    data_center: str = ""
+    is_owner: bool = False
+
+    def hash_key(self) -> str:
+        return self.grpc_address

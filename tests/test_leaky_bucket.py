@@ -1,0 +1,153 @@
+"""Leaky-bucket semantic tests (reference TestLeakyBucket functional_test.go:478,
+negative hits :783, more-than-available :854, gregorian :712)."""
+
+import pytest
+
+from gubernator_tpu.ops.engine import LocalEngine
+from gubernator_tpu.types import (
+    Algorithm,
+    Behavior,
+    RateLimitRequest,
+    Status,
+    MINUTE,
+    SECOND,
+)
+
+
+def req(key="lk1", hits=1, limit=5, duration=5 * SECOND, burst=0, behavior=0, created_at=None):
+    return RateLimitRequest(
+        name="test",
+        unique_key=key,
+        hits=hits,
+        limit=limit,
+        duration=duration,
+        algorithm=Algorithm.LEAKY_BUCKET,
+        behavior=behavior,
+        burst=burst,
+        created_at=created_at,
+    )
+
+
+@pytest.fixture
+def eng():
+    return LocalEngine(capacity=1024)
+
+
+def test_drain_and_leak_refill(eng, frozen_now):
+    # limit 5 per 5s → one token per second
+    t = frozen_now
+    for i in range(5):
+        (r,) = eng.check([req(created_at=t)], now_ms=t)
+        assert r.status == Status.UNDER_LIMIT
+        assert r.remaining == 4 - i
+    (r,) = eng.check([req(created_at=t)], now_ms=t)
+    assert r.status == Status.OVER_LIMIT
+
+    # after one rate interval a whole token has leaked back
+    t2 = t + 1000
+    (r,) = eng.check([req(created_at=t2)], now_ms=t2)
+    assert r.status == Status.UNDER_LIMIT
+    assert r.remaining == 0  # the leaked token was immediately consumed
+
+    # sub-token elapsed time yields nothing (reference algorithms.go:363:
+    # `if int64(leak) > 0`)
+    t3 = t2 + 999
+    (r,) = eng.check([req(created_at=t3)], now_ms=t3)
+    assert r.status == Status.OVER_LIMIT
+
+
+def test_full_refill_caps_at_burst(eng, frozen_now):
+    t = frozen_now
+    for _ in range(5):
+        eng.check([req(created_at=t)], now_ms=t)
+    t2 = t + 60 * SECOND  # far more than needed to refill 5
+    (r,) = eng.check([req(hits=0, created_at=t2)], now_ms=t2)
+    assert r.remaining == 5  # clamped to burst (= limit)
+
+
+def test_burst_overrides_capacity(eng, frozen_now):
+    t = frozen_now
+    (r,) = eng.check([req(hits=8, limit=5, burst=10, created_at=t)], now_ms=t)
+    assert r.status == Status.UNDER_LIMIT
+    assert r.remaining == 2
+
+
+def test_over_ask_does_not_consume(eng, frozen_now):
+    t = frozen_now
+    (r,) = eng.check([req(hits=2, created_at=t)], now_ms=t)
+    assert r.remaining == 3
+    (r,) = eng.check([req(hits=4, created_at=t)], now_ms=t)
+    assert r.status == Status.OVER_LIMIT
+    assert r.remaining == 3
+    (r,) = eng.check([req(hits=3, created_at=t)], now_ms=t)
+    assert r.status == Status.UNDER_LIMIT
+    assert r.remaining == 0
+
+
+def test_drain_over_limit(eng, frozen_now):
+    t = frozen_now
+    (r,) = eng.check([req(hits=2, created_at=t)], now_ms=t)
+    assert r.remaining == 3
+    (r,) = eng.check(
+        [req(hits=4, behavior=Behavior.DRAIN_OVER_LIMIT, created_at=t)], now_ms=t
+    )
+    assert r.status == Status.OVER_LIMIT
+    assert r.remaining == 0
+    (r,) = eng.check([req(hits=1, created_at=t)], now_ms=t)
+    assert r.status == Status.OVER_LIMIT
+
+
+def test_first_request_over_burst(eng, frozen_now):
+    # new leaky item with hits > burst starts drained (reference
+    # algorithms.go:467-476)
+    t = frozen_now
+    (r,) = eng.check([req(hits=7, limit=5, created_at=t)], now_ms=t)
+    assert r.status == Status.OVER_LIMIT
+    assert r.remaining == 0
+    (r,) = eng.check([req(hits=1, created_at=t)], now_ms=t)
+    assert r.status == Status.OVER_LIMIT
+
+
+def test_negative_hits(eng, frozen_now):
+    t = frozen_now
+    (r,) = eng.check([req(hits=3, created_at=t)], now_ms=t)
+    assert r.remaining == 2
+    (r,) = eng.check([req(hits=-2, created_at=t)], now_ms=t)
+    assert r.remaining == 4
+
+
+def test_reset_remaining_refills(eng, frozen_now):
+    # leaky RESET_REMAINING refills to burst in place (reference
+    # algorithms.go:319-321) — unlike token bucket it does not remove the item
+    t = frozen_now
+    for _ in range(5):
+        eng.check([req(created_at=t)], now_ms=t)
+    (r,) = eng.check(
+        [req(hits=1, behavior=Behavior.RESET_REMAINING, created_at=t)], now_ms=t
+    )
+    assert r.status == Status.UNDER_LIMIT
+    assert r.remaining == 4
+
+
+def test_reset_time_tracks_deficit(eng, frozen_now):
+    t = frozen_now
+    (r,) = eng.check([req(hits=2, created_at=t)], now_ms=t)
+    # rate = 5000/5 = 1000 ms per token; 2 consumed → reset in 2 rate units
+    assert r.reset_time == t + 2 * 1000
+
+
+def test_exact_remainder(eng, frozen_now):
+    t = frozen_now
+    (r,) = eng.check([req(hits=2, created_at=t)], now_ms=t)
+    (r,) = eng.check([req(hits=3, created_at=t)], now_ms=t)
+    assert r.status == Status.UNDER_LIMIT
+    assert r.remaining == 0
+    assert r.reset_time == t + 5 * 1000
+
+
+def test_zero_hits_probe(eng, frozen_now):
+    t = frozen_now
+    eng.check([req(hits=2, created_at=t)], now_ms=t)
+    (r,) = eng.check([req(hits=0, created_at=t)], now_ms=t)
+    assert r.status == Status.UNDER_LIMIT
+    assert r.remaining == 3

@@ -1,0 +1,232 @@
+"""Token-bucket semantic tests.
+
+Scenario tables modeled on the reference's black-box functional suite
+(reference functional_test.go: TestTokenBucket:161, TestDrainOverLimit:369,
+more-than-available:435, TestChangeLimit:1344, TestResetRemaining:1439,
+negative hits:297) — behavior parity, not code parity.
+"""
+
+import pytest
+
+from gubernator_tpu.ops.engine import LocalEngine
+from gubernator_tpu.types import (
+    Algorithm,
+    Behavior,
+    RateLimitRequest,
+    Status,
+    MINUTE,
+    SECOND,
+)
+
+
+def req(key="k1", hits=1, limit=5, duration=MINUTE, behavior=0, created_at=None, name="test"):
+    return RateLimitRequest(
+        name=name,
+        unique_key=key,
+        hits=hits,
+        limit=limit,
+        duration=duration,
+        algorithm=Algorithm.TOKEN_BUCKET,
+        behavior=behavior,
+        created_at=created_at,
+    )
+
+
+@pytest.fixture
+def eng():
+    return LocalEngine(capacity=1024)
+
+
+def test_basic_decrement_and_over_limit(eng, frozen_now):
+    t = frozen_now
+    for i in range(5):
+        (r,) = eng.check([req(created_at=t)], now_ms=t)
+        assert r.status == Status.UNDER_LIMIT
+        assert r.remaining == 4 - i
+        assert r.limit == 5
+        assert r.reset_time == t + MINUTE
+    (r,) = eng.check([req(created_at=t)], now_ms=t)
+    assert r.status == Status.OVER_LIMIT
+    assert r.remaining == 0
+
+
+def test_expiry_renews_bucket(eng, frozen_now):
+    t = frozen_now
+    for _ in range(6):
+        (r,) = eng.check([req(created_at=t)], now_ms=t)
+    assert r.status == Status.OVER_LIMIT
+    t2 = t + MINUTE + 1  # ExpireAt < now → expired (reference cache.go:50-52)
+    (r,) = eng.check([req(created_at=t2)], now_ms=t2)
+    assert r.status == Status.UNDER_LIMIT
+    assert r.remaining == 4
+    assert r.reset_time == t2 + MINUTE
+
+
+def test_zero_hits_reports_without_consuming(eng, frozen_now):
+    t = frozen_now
+    (r,) = eng.check([req(hits=2, created_at=t)], now_ms=t)
+    assert r.remaining == 3
+    (r,) = eng.check([req(hits=0, created_at=t)], now_ms=t)
+    assert r.status == Status.UNDER_LIMIT
+    assert r.remaining == 3
+    (r,) = eng.check([req(hits=0, created_at=t)], now_ms=t)
+    assert r.remaining == 3
+
+
+def test_over_ask_does_not_consume(eng, frozen_now):
+    # reference semantics note algorithms.go:29-34 and functional_test.go:435
+    t = frozen_now
+    (r,) = eng.check([req(hits=20, limit=100, created_at=t)], now_ms=t)
+    assert r.remaining == 80
+    (r,) = eng.check([req(hits=81, limit=100, created_at=t)], now_ms=t)
+    assert r.status == Status.OVER_LIMIT
+    assert r.remaining == 80
+    (r,) = eng.check([req(hits=80, limit=100, created_at=t)], now_ms=t)
+    assert r.status == Status.UNDER_LIMIT
+    assert r.remaining == 0
+
+
+def test_first_request_over_limit(eng, frozen_now):
+    # new item with hits > limit answers OVER but keeps a full bucket
+    # (reference algorithms.go:236-243)
+    t = frozen_now
+    (r,) = eng.check([req(hits=10, limit=5, created_at=t)], now_ms=t)
+    assert r.status == Status.OVER_LIMIT
+    assert r.remaining == 5
+    (r,) = eng.check([req(hits=5, limit=5, created_at=t)], now_ms=t)
+    assert r.status == Status.UNDER_LIMIT
+    assert r.remaining == 0
+
+
+def test_drain_over_limit(eng, frozen_now):
+    # reference TestDrainOverLimit functional_test.go:369
+    t = frozen_now
+    (r,) = eng.check([req(hits=2, limit=10, created_at=t)], now_ms=t)
+    assert r.remaining == 8
+    (r,) = eng.check(
+        [req(hits=9, limit=10, behavior=Behavior.DRAIN_OVER_LIMIT, created_at=t)],
+        now_ms=t,
+    )
+    assert r.status == Status.OVER_LIMIT
+    assert r.remaining == 0
+    (r,) = eng.check([req(hits=1, limit=10, created_at=t)], now_ms=t)
+    assert r.status == Status.OVER_LIMIT
+
+
+def test_negative_hits_adds_back(eng, frozen_now):
+    # reference functional_test.go:297 — negative hits return tokens
+    t = frozen_now
+    (r,) = eng.check([req(hits=4, created_at=t)], now_ms=t)
+    assert r.remaining == 1
+    (r,) = eng.check([req(hits=-2, created_at=t)], now_ms=t)
+    assert r.remaining == 3
+    # and can exceed the limit (no top clamp, matching the reference)
+    (r,) = eng.check([req(hits=-10, created_at=t)], now_ms=t)
+    assert r.remaining == 13
+
+
+def test_reset_remaining(eng, frozen_now):
+    # reference TestResetRemaining functional_test.go:1439
+    t = frozen_now
+    for _ in range(5):
+        (r,) = eng.check([req(created_at=t)], now_ms=t)
+    assert r.remaining == 0
+    (r,) = eng.check(
+        [req(hits=0, behavior=Behavior.RESET_REMAINING, created_at=t)], now_ms=t
+    )
+    assert r.status == Status.UNDER_LIMIT
+    assert r.remaining == 5
+    assert r.reset_time == 0
+    (r,) = eng.check([req(created_at=t)], now_ms=t)
+    assert r.remaining == 4
+
+
+def test_change_limit_midflight(eng, frozen_now):
+    # reference TestChangeLimit functional_test.go:1344 — delta applied to
+    # remaining, clamped at zero (algorithms.go:108-115)
+    t = frozen_now
+    (r,) = eng.check([req(hits=5, limit=10, created_at=t)], now_ms=t)
+    assert r.remaining == 5
+    (r,) = eng.check([req(hits=1, limit=20, created_at=t)], now_ms=t)
+    assert r.remaining == 14  # 5 + (20-10) - 1
+    (r,) = eng.check([req(hits=1, limit=5, created_at=t)], now_ms=t)
+    # 15 + (5-20) = -10 → clamped to 0 → at limit
+    assert r.status == Status.OVER_LIMIT
+    assert r.remaining == 0
+
+
+def test_change_duration_extends_expiry(eng, frozen_now):
+    t = frozen_now
+    (r,) = eng.check([req(created_at=t)], now_ms=t)
+    assert r.reset_time == t + MINUTE
+    t2 = t + 10 * SECOND
+    (r,) = eng.check([req(duration=2 * MINUTE, created_at=t2)], now_ms=t2)
+    # new expiry anchored at the item's CreatedAt (reference algorithms.go:126)
+    assert r.reset_time == t + 2 * MINUTE
+    assert r.remaining == 3
+
+
+def test_change_duration_into_the_past_renews(eng, frozen_now):
+    # if CreatedAt + new duration is already past, the bucket renews
+    # (reference algorithms.go:134-141)
+    t = frozen_now
+    eng.check([req(hits=3, duration=MINUTE, created_at=t)], now_ms=t)
+    t2 = t + 10 * SECOND
+    (r,) = eng.check([req(hits=1, duration=5 * SECOND, created_at=t2)], now_ms=t2)
+    assert r.status == Status.UNDER_LIMIT
+    assert r.remaining == 4  # renewed to full, then one hit
+    assert r.reset_time == t2 + 5 * SECOND
+
+
+def test_sticky_over_status_on_status_read(eng, frozen_now):
+    # hitting the floor persists OVER into the item; a hits=0 probe then
+    # reports the stored status (reference algorithms.go:117-122,161-167)
+    t = frozen_now
+    eng.check([req(hits=5, created_at=t)], now_ms=t)
+    (r,) = eng.check([req(hits=1, created_at=t)], now_ms=t)
+    assert r.status == Status.OVER_LIMIT
+    (r,) = eng.check([req(hits=0, created_at=t)], now_ms=t)
+    assert r.status == Status.OVER_LIMIT
+    assert r.remaining == 0
+
+
+def test_algorithm_switch_recreates(eng, frozen_now):
+    t = frozen_now
+    eng.check([req(hits=3, created_at=t)], now_ms=t)
+    leaky = RateLimitRequest(
+        name="test",
+        unique_key="k1",
+        hits=1,
+        limit=5,
+        duration=MINUTE,
+        algorithm=Algorithm.LEAKY_BUCKET,
+        created_at=t,
+    )
+    (r,) = eng.check([leaky], now_ms=t)
+    # recreated as a fresh leaky bucket (reference algorithms.go:307-317)
+    assert r.status == Status.UNDER_LIMIT
+    assert r.remaining == 4
+    back = req(hits=1, created_at=t)
+    (r,) = eng.check([back], now_ms=t)
+    assert r.remaining == 4  # fresh token bucket again
+
+
+def test_batch_of_distinct_keys(eng, frozen_now):
+    t = frozen_now
+    rs = [req(key=f"k{i}", hits=1, limit=3, created_at=t) for i in range(50)]
+    out = eng.check(rs, now_ms=t)
+    assert all(r.status == Status.UNDER_LIMIT and r.remaining == 2 for r in out)
+
+
+def test_same_key_sequential_within_batch(eng, frozen_now):
+    # duplicate keys in one batch apply sequentially via planner passes
+    t = frozen_now
+    rs = [req(hits=2, limit=5, created_at=t), req(hits=2, limit=5, created_at=t),
+          req(hits=2, limit=5, created_at=t)]
+    out = eng.check(rs, now_ms=t)
+    assert [r.remaining for r in out] == [3, 1, 1]
+    assert [r.status for r in out] == [
+        Status.UNDER_LIMIT,
+        Status.UNDER_LIMIT,
+        Status.OVER_LIMIT,  # 2 > 1 remaining → rejected, not consumed
+    ]
