@@ -6,7 +6,7 @@ pick a worker shard (63-bit xxhash, reference workers.go:185-189). The TPU
 build collapses both into one 64-bit xxhash fingerprint computed host-side:
 
 * high bits select the owning device shard (parallel/, M3+);
-* `fp mod capacity` selects the HBM slot within a shard (ops/decide.py).
+* `fp mod capacity` selects the HBM slot within a shard (ops/kernel.py).
 
 Strings never reach the device — only fingerprints do. fp == 0 is reserved as
 the empty-slot sentinel, so real fingerprints are remapped away from 0.
@@ -17,10 +17,14 @@ from __future__ import annotations
 import xxhash
 
 _SEED = 0x6775626572  # arbitrary fixed seed; must be identical across peers
+_MASK63 = (1 << 63) - 1
 
 
 def fingerprint(name: str, unique_key: str) -> int:
-    """64-bit fingerprint of a rate limit's hash key (name + "_" + key,
-    composition per reference client.go:39-41). Never returns 0."""
-    h = xxhash.xxh64_intdigest(name + "_" + unique_key, seed=_SEED)
+    """63-bit fingerprint of a rate limit's hash key (name + "_" + key,
+    composition per reference client.go:39-41). 63 bits so it fits a
+    non-negative int64 — the TPU X64-emulation pass can't bitcast u64⇄s64, and
+    the reference itself uses a 63-bit xxhash for worker sharding
+    (workers.go:155-157). Never returns 0 (the empty-slot sentinel)."""
+    h = xxhash.xxh64_intdigest(name + "_" + unique_key, seed=_SEED) & _MASK63
     return h if h != 0 else 1

@@ -1,5 +1,5 @@
 from gubernator_tpu.ops.table import Table, new_table
 from gubernator_tpu.ops.batch import ReqBatch, RespBatch, BatchStats
-from gubernator_tpu.ops.decide import decide
+from gubernator_tpu.ops.kernel import decide
 
 __all__ = ["Table", "new_table", "ReqBatch", "RespBatch", "BatchStats", "decide"]

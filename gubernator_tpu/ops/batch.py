@@ -3,7 +3,7 @@
 A `ReqBatch` is the SoA form of a slice of RateLimitRequests after host-side
 resolution: strings → fingerprints, Gregorian durations → absolute expiries and
 interval lengths, leaky burst defaulting (burst==0 → limit, reference
-algorithms.go:259-261). The kernel (ops/decide.py) requires all fingerprints
+algorithms.go:259-261). The kernel (ops/kernel.py) requires all fingerprints
 within one batch to be distinct — the pass planner (ops/plan.py) guarantees
 that, reproducing the reference's per-key sequential semantics (the worker
 hash-ring serializes same-key requests, reference workers.go:185-189).
@@ -24,7 +24,7 @@ from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest, has_beha
 class ReqBatch(NamedTuple):
     """All arrays shape (B,). Fingerprints must be unique among active rows."""
 
-    fp: jnp.ndarray  # uint64
+    fp: jnp.ndarray  # int64 (63-bit fingerprint; 0 reserved)
     algo: jnp.ndarray  # int32
     behavior: jnp.ndarray  # int32 bitflags
     hits: jnp.ndarray  # int64
@@ -109,7 +109,7 @@ def pack_requests(
         raise ValueError("pad_to smaller than batch")
     errors: List[Optional[str]] = [None] * n
     b = HostBatch(
-        fp=np.zeros(size, dtype=np.uint64),
+        fp=np.zeros(size, dtype=np.int64),
         algo=np.zeros(size, dtype=np.int32),
         behavior=np.zeros(size, dtype=np.int32),
         hits=np.zeros(size, dtype=np.int64),

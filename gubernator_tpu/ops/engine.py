@@ -17,8 +17,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from gubernator_tpu.ops.batch import pack_requests, pad_batch, to_device
-from gubernator_tpu.ops.decide import decide
+from gubernator_tpu.ops.batch import HostBatch, pack_requests, pad_batch, to_device
+from gubernator_tpu.ops.kernel import decide
 from gubernator_tpu.ops.plan import plan_passes
 from gubernator_tpu.ops.table import Table, new_table
 from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
@@ -48,21 +48,24 @@ class EngineStats:
     checks: int = 0
     dispatches: int = 0
 
-    def accumulate(self, stats) -> None:
+    def accumulate(self, stats, count_dropped: bool = True) -> None:
         self.cache_hits += int(stats.cache_hits)
         self.cache_misses += int(stats.cache_misses)
         self.over_limit += int(stats.over_limit)
         self.evicted_unexpired += int(stats.evicted_unexpired)
-        self.dropped += int(stats.dropped)
+        if count_dropped:
+            self.dropped += int(stats.dropped)
 
 
 class LocalEngine:
     """One device-resident rate-limit table + its dispatch loop."""
 
     def __init__(self, capacity: int = 50_000, probes: int = 8, max_exact_passes: int = 8):
-        self.table: Table = new_table(capacity)
+        # `probes` is the bucket width K (the probe-window analog)
+        self.table: Table = new_table(capacity, k=probes)
         self.probes = probes
         self.max_exact_passes = max_exact_passes
+        self.max_claim_retries = 3
         self.stats = EngineStats()
 
     def check(
@@ -85,14 +88,7 @@ class LocalEngine:
         for p in plan_passes(hb, max_exact=self.max_exact_passes):
             n = len(p.rows)
             batch = pad_batch(p.batch, _pad_size(n))
-            rb = to_device(batch)
-            self.table, resp, stats = decide(self.table, rb, probes=self.probes)
-            self.stats.accumulate(stats)
-            self.stats.dispatches += 1
-            status = np.asarray(resp.status)
-            limit = np.asarray(resp.limit)
-            remaining = np.asarray(resp.remaining)
-            reset = np.asarray(resp.reset_time)
+            status, limit, remaining, reset = self._dispatch_with_retry(batch, n)
             for i in range(n):
                 r = RateLimitResponse(
                     status=int(status[i]),
@@ -107,3 +103,38 @@ class LocalEngine:
                     out[int(p.rows[i])] = r
         self.stats.checks += len(requests)
         return out  # type: ignore[return-value]
+
+    def _dispatch_with_retry(self, batch, n: int):
+        """Run one unique-fp pass; rows the claim auction dropped (contended
+        bucket within a single dispatch) are re-dispatched — the decision is
+        only authoritative once persisted."""
+        rb = to_device(batch)
+        self.table, resp, stats = decide(self.table, rb)
+        self.stats.accumulate(stats, count_dropped=False)
+        self.stats.dispatches += 1
+        status = np.asarray(resp.status)[:n].copy()
+        limit = np.asarray(resp.limit)[:n].copy()
+        remaining = np.asarray(resp.remaining)[:n].copy()
+        reset = np.asarray(resp.reset_time)[:n].copy()
+        retries = 0
+        dropped = np.asarray(resp.dropped)[:n]
+        while dropped.any() and retries < self.max_claim_retries:
+            rows = np.nonzero(dropped)[0]
+            sub = HostBatch(*[f[:n][rows] for f in batch])
+            sub = pad_batch(sub, _pad_size(len(rows)))
+            rb = to_device(sub)
+            self.table, resp, stats = decide(self.table, rb)
+            self.stats.dispatches += 1
+            self.stats.evicted_unexpired += int(stats.evicted_unexpired)
+            m = len(rows)
+            status[rows] = np.asarray(resp.status)[:m]
+            limit[rows] = np.asarray(resp.limit)[:m]
+            remaining[rows] = np.asarray(resp.remaining)[:m]
+            reset[rows] = np.asarray(resp.reset_time)[:m]
+            nd = np.zeros(n, dtype=bool)
+            nd[rows] = np.asarray(resp.dropped)[:m]
+            dropped = nd
+            retries += 1
+        # only rows still unpersisted after retries count as dropped
+        self.stats.dropped += int(dropped.sum())
+        return status, limit, remaining, reset

@@ -1,0 +1,405 @@
+"""The vectorized rate-limit decision kernel.
+
+One call replaces the reference's whole per-request inner stack — worker
+channel → LRU map lookup → token/leaky bucket state machine (reference
+workers.go:195-330 → lrucache.go:88-128 → algorithms.go:37-492) — with a single
+jitted batch update over the HBM table:
+
+    table', responses, stats = decide(table, batch)
+
+Memory-op discipline: on TPU under the X64-emulation pass, 64-bit and
+row-scatter memory ops serialize (≈20 ms per 128K rows), while 32-bit flat
+scatters and narrow row gathers vectorize (<2 ms). The kernel therefore touches
+HBM only through:
+ 1. probe  — three (B, K) row gathers of the bucket's probe plane (fingerprint
+             halves + coarse expiry); all classification is fused elementwise.
+ 2. claim  — an auction over bucket lanes: each inserting row bids an int32
+             priority ``(round ⋅ 2^24) | perm(row)`` on one free lane per round
+             (lane choice hashed per row to spread contention), with owner rows
+             pre-stamping their lanes at top priority. One flat scatter-max and
+             one row gather per round; priorities are unique (odd-multiplier
+             bijection on row ids) and monotone in round, so winners are exact
+             and never displaced. Rows that lose every round are answered but
+             not persisted (stats.dropped — the engine retries them in a
+             follow-up dispatch; the reference's LRU would thrash instead,
+             lrucache.go:138-149).
+ 3. apply  — twelve flat f32-carrier gathers of the winning slot's state;
+             branchless token + leaky bucket math under masks, reproducing the
+             exact decision tables of reference algorithms.go (per-step
+             citations inline).
+ 4. write  — fifteen flat f32-carrier scatters (probe + apply planes).
+
+Eviction: when a bucket has no vacant lane, the soonest-expiring lane (coarse
+expiry order) is the bid target — expiry-stamp eviction, counted as the
+reference's "unexpired eviction" alarm (lrucache.go:138-149).
+
+Expiry: the probe plane's coarse (~1 s) expiry is used only conservatively
+(reclaim clearly-dead lanes, order evictions); the authoritative
+millisecond-exact `ExpireAt < now` check (reference cache.go:43-57) happens in
+apply against the exact stored expiry, with `created_at` as "now" — the front
+door stamps it at ingress, and tests get frozen time for free.
+
+Correctness contract: fingerprints must be unique among active rows (the pass
+planner, ops/plan.py, guarantees it). This reproduces the reference's per-key
+serialization: gubernator's worker hash-ring ensures same-key requests apply
+sequentially (workers.go:185-189); here "sequentially" = "in separate passes".
+
+Deliberate divergences from the reference (documented, not cargo-culted):
+* New-item leaky-bucket rate under DURATION_IS_GREGORIAN uses the Gregorian
+  interval length, where the reference divides by the raw enum value
+  (algorithms.go:438-449) yielding a nonsense reset_time (SURVEY.md §7).
+* `limit`/`burst` must fit int32 (validated at the front door); stored token
+  remaining saturates at int32.
+* The leaky float64 remainder is stored double-single (two f32, ~48-bit
+  mantissa) — exact for any realistic token count.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from gubernator_tpu.ops.batch import BatchStats, ReqBatch, RespBatch
+from gubernator_tpu.ops.table import EXPC_SHIFT, Table
+from gubernator_tpu.types import Algorithm, Behavior, Status
+
+_CLAIM_ROUNDS = 2  # bidding rounds; engine retries dropped rows host-side
+_MIX = 2654435761  # odd ⇒ (row * _MIX) mod 2^24 is a bijection (unique prios)
+
+i64 = jnp.int64
+i32 = jnp.int32
+f64 = jnp.float64
+f32 = jnp.float32
+
+
+def _as_i32(x):
+    return jax.lax.bitcast_convert_type(x, i32)
+
+
+def _as_f32(x):
+    return jax.lax.bitcast_convert_type(x, f32)
+
+
+def _join64(lo32, hi32):
+    return (hi32.astype(i64) << 32) | (lo32.astype(i64) & 0xFFFFFFFF)
+
+
+def _lo32(x):
+    return (x & 0xFFFFFFFF).astype(i32)
+
+
+def _hi32(x):
+    return (x >> 32).astype(i32)
+
+
+def decide_impl(table: Table, req: ReqBatch) -> Tuple[Table, RespBatch, BatchStats]:
+    """Un-jitted kernel body — call through `decide` (jitted, donating) on a
+    single device, or directly inside shard_map (parallel/sharded.py)."""
+    NB, K = table.pfp_lo.shape
+    C = NB * K
+    B = req.fp.shape[0]
+    if B > (1 << 20):
+        raise ValueError("batch larger than 2^20 rows")
+
+    now = req.created_at  # per-row "now" (epoch ms)
+    active = req.active
+
+    # ------------------------------------------------------------------ probe
+    bucket = (req.fp % NB).astype(i32)
+    my_lo = _lo32(req.fp)
+    my_hi = _hi32(req.fp)
+    bfp_lo = _as_i32(table.pfp_lo[bucket])  # (B, K) row gathers
+    bfp_hi = _as_i32(table.pfp_hi[bucket])
+    bexp_c = _as_i32(table.pexp_c[bucket])
+
+    offs = jnp.arange(K, dtype=i32)
+    rows = jnp.arange(B, dtype=i32)
+    emptyK = (bfp_lo == 0) & (bfp_hi == 0)
+    fpm = (
+        (bfp_lo == my_lo[:, None])
+        & (bfp_hi == my_hi[:, None])
+        & ~emptyK
+        & active[:, None]
+    )
+    owns = fpm.any(axis=1)
+    own_j = jnp.argmax(fpm, axis=1)
+
+    now_c = (now >> EXPC_SHIFT).astype(i32)
+    # conservative: only clearly-dead lanes count as vacant at probe level;
+    # the exact ms expiry check happens in apply.
+    probe_dead = bexp_c < (now_c[:, None] - 1)
+    vacantK = emptyK | probe_dead
+
+    # ------------------------------------------------------------------ claim
+    DROPC = jnp.int32(C)
+    need = active & ~owns
+    mix24 = ((rows.astype(i64) * _MIX) & 0xFFFFFF).astype(i32)
+    bids = jnp.zeros(C, dtype=i32)
+    own_slot = bucket * K + own_j
+    prio_own = ((_CLAIM_ROUNDS + 1) << 24) | mix24
+    bids = bids.at[jnp.where(owns, own_slot, DROPC)].max(prio_own, mode="drop")
+
+    evict_j = jnp.argmin(bexp_c, axis=1)
+    any_vacant = vacantK.any(axis=1)
+
+    lane_sel = own_j
+    resolved = owns
+    won_evict = jnp.zeros(B, dtype=bool)
+    pending = jnp.zeros(B, dtype=bool)
+    pend_lane = jnp.zeros(B, dtype=i32)
+    pend_prio = jnp.zeros(B, dtype=i32)
+    pend_evict = jnp.zeros(B, dtype=bool)
+    # hashed lane preference spreads same-bucket contenders across lanes
+    lane_score = ((rows[:, None] * _MIX + (offs[None, :] + 1) * 40503) & 0x7FFF) + 1
+    for r in range(_CLAIM_ROUNDS + 1):
+        bids_row = bids.reshape(NB, K)[bucket]  # (B, K) row gather
+        if r > 0:
+            at = jnp.take_along_axis(bids_row, pend_lane[:, None], axis=1)[:, 0]
+            win = pending & (at == pend_prio)
+            lane_sel = jnp.where(win, pend_lane, lane_sel)
+            resolved = resolved | win
+            won_evict = won_evict | (win & pend_evict)
+        if r < _CLAIM_ROUNDS:
+            free = vacantK & (bids_row == 0)
+            has_free = free.any(axis=1)
+            pick = jnp.argmax(jnp.where(free, lane_score, 0), axis=1)
+            evict_bid = jnp.take_along_axis(bids_row, evict_j[:, None], axis=1)[:, 0]
+            can_evict = ~any_vacant & (evict_bid == 0)
+            lane = jnp.where(has_free, pick, evict_j)
+            trying = need & ~resolved & (has_free | can_evict)
+            prio = ((_CLAIM_ROUNDS - r) << 24) | mix24
+            bids = bids.at[jnp.where(trying, bucket * K + lane, DROPC)].max(
+                prio, mode="drop"
+            )
+            pending = trying
+            pend_lane = lane
+            pend_prio = prio
+            pend_evict = trying & ~has_free
+
+    slot = bucket * K + lane_sel  # always in range; meaningless if unresolved
+    dropped = active & ~resolved
+
+    # ------------------------------------------------------------------ apply
+    g32 = lambda arr: _as_i32(arr[slot])  # flat f32-carrier gather + bitcast
+    s_limit = g32(table.limit).astype(i64)
+    s_burst = g32(table.burst).astype(i64)
+    s_rem_i = g32(table.rem_i).astype(i64)
+    s_flags = g32(table.flags)
+    s_duration = _join64(g32(table.dur_lo), g32(table.dur_hi))
+    s_stamp = _join64(g32(table.stamp_lo), g32(table.stamp_hi))
+    s_exp = _join64(g32(table.exp_lo), g32(table.exp_hi))
+    s_rem_f = table.remf_hi[slot].astype(f64) + table.remf_lo[slot].astype(f64)
+    s_algo = s_flags & 0xFF
+    s_status = s_flags >> 8
+
+    # the reference's lazy expiry-on-read (cache.go:43-57), ms-exact
+    exists = owns & (s_exp >= now)
+    # an eviction only alarms if the victim was genuinely still live
+    # (reference "unexpired evictions", lrucache.go:138-149) — won_evict rows
+    # gathered the victim's state at `slot` before overwriting it
+    evicted_unexpired = won_evict & (s_exp >= now)
+
+    is_greg = (req.behavior & int(Behavior.DURATION_IS_GREGORIAN)) != 0
+    is_reset = (req.behavior & int(Behavior.RESET_REMAINING)) != 0
+    is_drain = (req.behavior & int(Behavior.DRAIN_OVER_LIMIT)) != 0
+    is_token = req.algo == int(Algorithm.TOKEN_BUCKET)
+    h = req.hits
+
+    # Existing-item path applies only when algorithms agree; a stored item of
+    # the other algorithm is discarded and recreated ("client switched
+    # algorithms", reference algorithms.go:96-105,307-317).
+    algo_match = exists & (s_algo == req.algo)
+
+    # ==================================================== token bucket
+    # reference algorithms.go:37-252
+    OVER = jnp.int32(int(Status.OVER_LIMIT))
+    UNDER = jnp.int32(int(Status.UNDER_LIMIT))
+
+    # --- existing item (algorithms.go:107-194)
+    # limit change: add the delta to remaining, clamp at 0 (go:108-115)
+    t_rem = jnp.where(
+        s_limit != req.limit, jnp.maximum(s_rem_i + req.limit - s_limit, 0), s_rem_i
+    )
+    # duration change (go:125-146): recompute expiry from the item's CreatedAt;
+    # if that would place us already expired, renew the bucket.
+    dur_changed = s_duration != req.duration
+    expire_dc = jnp.where(is_greg, req.expire_new, s_stamp + req.duration)
+    renew = dur_changed & (expire_dc <= now)
+    expire_dc = jnp.where(renew, now + req.duration, expire_dc)
+    t_created = jnp.where(renew, now, s_stamp)
+    t_rem = jnp.where(renew, req.limit, t_rem)
+    t_exp = jnp.where(dur_changed, expire_dc, s_exp)
+    t_reset = t_exp
+
+    zero_hits = h == 0
+    at_limit = (t_rem == 0) & (h > 0)  # go:161-168
+    exact = ~zero_hits & ~at_limit & (t_rem == h)  # go:171-175
+    overask = ~zero_hits & ~at_limit & ~exact & (h > t_rem)  # go:179-190
+    consume = ~zero_hits & ~at_limit & ~exact & ~overask  # go:192-194
+
+    tok_rem_out = jnp.where(
+        exact | (overask & is_drain), i64(0), jnp.where(consume, t_rem - h, t_rem)
+    )
+    # response status starts from the stored (sticky) status (go:117-122); only
+    # the at-limit branch persists OVER back to the item (go:165-166).
+    tok_resp_status = jnp.where(at_limit | overask, OVER, s_status)
+    tok_stored_status = jnp.where(at_limit, OVER, s_status)
+    tok_resp_rem = tok_rem_out
+    tok_resp_reset = t_reset
+
+    # --- new item (algorithms.go:202-252)
+    new_over = h > req.limit
+    tokn_rem = jnp.where(new_over, req.limit, req.limit - h)
+    tokn_status = jnp.where(new_over, OVER, UNDER)
+    tokn_exp = req.expire_new
+
+    tok_is_new = ~algo_match
+    tok_status_out = jnp.where(tok_is_new, UNDER, tok_stored_status)
+    tok_rem_store = jnp.where(tok_is_new, tokn_rem, tok_rem_out)
+    tok_created_out = jnp.where(tok_is_new, now, t_created)
+    tok_exp_out = jnp.where(tok_is_new, tokn_exp, t_exp)
+    tok_resp_status = jnp.where(tok_is_new, tokn_status, tok_resp_status)
+    tok_resp_rem = jnp.where(tok_is_new, tokn_rem, tok_resp_rem)
+    tok_resp_reset = jnp.where(tok_is_new, tokn_exp, tok_resp_reset)
+
+    # RESET_REMAINING on an existing item removes it outright and reports a
+    # full bucket (go:82-94) — modeled as writing back an empty slot.
+    tok_reset_rm = exists & is_reset
+    tok_resp_status = jnp.where(tok_reset_rm, UNDER, tok_resp_status)
+    tok_resp_rem = jnp.where(tok_reset_rm, req.limit, tok_resp_rem)
+    tok_resp_reset = jnp.where(tok_reset_rm, i64(0), tok_resp_reset)
+
+    # ==================================================== leaky bucket
+    # reference algorithms.go:255-492. Remaining is float64 (store.go:32);
+    # comparisons truncate toward zero exactly like Go's int64(float64).
+    lk_is_new = ~algo_match
+    rate = jnp.where(is_greg, req.greg_interval, req.duration).astype(
+        f64
+    ) / jnp.maximum(req.limit, 1).astype(f64)
+    irate = rate.astype(i64)
+
+    # --- existing item (go:304-430)
+    b_rem = jnp.where(is_reset, s_burst.astype(f64), s_rem_f)  # go:319-321
+    burst_changed = s_burst != req.burst
+    b_rem = jnp.where(  # go:324-329
+        burst_changed & (req.burst > b_rem.astype(i64)), req.burst.astype(f64), b_rem
+    )
+    # leak since UpdatedAt; only applied once a whole token has leaked
+    # (go:359-366: `if int64(leak) > 0`)
+    elapsed = (now - s_stamp).astype(f64)
+    leak = elapsed / rate
+    leak_applies = leak.astype(i64) > 0
+    b_rem = jnp.where(leak_applies, b_rem + leak, b_rem)
+    lk_stamp = jnp.where(leak_applies, now, s_stamp)
+    # clamp to burst (go:368-370)
+    b_rem = jnp.where(b_rem.astype(i64) > req.burst, req.burst.astype(f64), b_rem)
+
+    lk_rem_now = b_rem.astype(i64)
+    lk_at_limit = (lk_rem_now == 0) & (h > 0)  # go:388-394
+    lk_exact = ~lk_at_limit & (lk_rem_now == h)  # go:397-402 (catches h==0,rem==0)
+    lk_overask = ~lk_at_limit & ~lk_exact & (h > lk_rem_now)  # go:406-419
+    lk_zero = ~lk_at_limit & ~lk_exact & ~lk_overask & (h == 0)  # go:422-424
+    lk_consume = ~lk_at_limit & ~lk_exact & ~lk_overask & ~lk_zero
+
+    lk_rem_out = jnp.where(
+        lk_exact | (lk_overask & is_drain),
+        f64(0.0),
+        jnp.where(lk_consume, b_rem - h.astype(f64), b_rem),
+    )
+    lk_resp_status = jnp.where(lk_at_limit | lk_overask, OVER, UNDER)
+    lk_resp_rem = jnp.where(lk_overask & ~is_drain, lk_rem_now, lk_rem_out.astype(i64))
+    # reset_time is computed from the PRE-hit remaining (go:372-377) and only
+    # recomputed by the exact/consume branches (go:400,428) — a DRAIN_OVER_LIMIT
+    # rejection keeps the pre-drain reset_time.
+    lk_reset_basis = jnp.where(
+        lk_exact, i64(0), jnp.where(lk_consume, lk_rem_out.astype(i64), lk_rem_now)
+    )
+    lk_resp_reset = now + (req.limit - lk_reset_basis) * irate
+    # hits≠0 refreshes expiry before any verdict (go:355-357)
+    lk_exp = jnp.where(h != 0, now + req.duration_eff, s_exp)
+
+    # --- new item (go:436-492)
+    lkn_over = h > req.burst
+    lkn_rem = jnp.where(lkn_over, f64(0.0), (req.burst - h).astype(f64))
+    lkn_resp_rem = jnp.where(lkn_over, i64(0), req.burst - h)
+    lkn_status = jnp.where(lkn_over, OVER, UNDER)
+    lkn_reset = now + (req.limit - lkn_resp_rem) * irate
+    lkn_exp = now + req.duration_eff
+
+    lk_rem_store = jnp.where(lk_is_new, lkn_rem, lk_rem_out)
+    lk_stamp_out = jnp.where(lk_is_new, now, lk_stamp)
+    lk_exp_out = jnp.where(lk_is_new, lkn_exp, lk_exp)
+    # stored duration: new items persist the effective (Gregorian-resolved)
+    # duration (go:452-458); existing items persist the raw request duration
+    # (go:332).
+    lk_dur_out = jnp.where(lk_is_new, req.duration_eff, req.duration)
+    lk_resp_status = jnp.where(lk_is_new, lkn_status, lk_resp_status)
+    lk_resp_rem = jnp.where(lk_is_new, lkn_resp_rem, lk_resp_rem)
+    lk_resp_reset = jnp.where(lk_is_new, lkn_reset, lk_resp_reset)
+
+    # ==================================================== merge + write
+    status_out = jnp.where(is_token, tok_status_out, UNDER)
+    rem_i_out = jnp.where(is_token, tok_rem_store, i64(0))
+    rem_f_out = jnp.where(is_token, f64(0.0), lk_rem_store)
+    stamp_out = jnp.where(is_token, tok_created_out, lk_stamp_out)
+    dur_out = jnp.where(is_token, req.duration, lk_dur_out)
+    exp_out = jnp.where(is_token, tok_exp_out, lk_exp_out)
+    burst_out = jnp.where(is_token, i64(0), req.burst)
+    flags_out = req.algo | (status_out << 8)
+
+    # token RESET_REMAINING removes the item: write back an empty slot
+    fp_lo_out = jnp.where(tok_reset_rm & is_token, 0, my_lo)
+    fp_hi_out = jnp.where(tok_reset_rm & is_token, 0, my_hi)
+    expc_out = jnp.where(
+        tok_reset_rm & is_token, 0, (exp_out >> EXPC_SHIFT).astype(i32)
+    )
+
+    w = jnp.where(active & resolved, slot, DROPC)
+    sat32 = lambda x: jnp.clip(x, -(2**31), 2**31 - 1).astype(i32)
+    remf_hi_out = rem_f_out.astype(f32)
+    remf_lo_out = (rem_f_out - remf_hi_out.astype(f64)).astype(f32)
+    put = lambda arr, v: arr.reshape(-1).at[w].set(v, mode="drop").reshape(arr.shape)
+    table = Table(
+        pfp_lo=put(table.pfp_lo, _as_f32(fp_lo_out)),
+        pfp_hi=put(table.pfp_hi, _as_f32(fp_hi_out)),
+        pexp_c=put(table.pexp_c, _as_f32(expc_out)),
+        limit=put(table.limit, _as_f32(sat32(req.limit))),
+        burst=put(table.burst, _as_f32(sat32(burst_out))),
+        rem_i=put(table.rem_i, _as_f32(sat32(rem_i_out))),
+        flags=put(table.flags, _as_f32(flags_out)),
+        dur_lo=put(table.dur_lo, _as_f32(_lo32(dur_out))),
+        dur_hi=put(table.dur_hi, _as_f32(_hi32(dur_out))),
+        stamp_lo=put(table.stamp_lo, _as_f32(_lo32(stamp_out))),
+        stamp_hi=put(table.stamp_hi, _as_f32(_hi32(stamp_out))),
+        exp_lo=put(table.exp_lo, _as_f32(_lo32(exp_out))),
+        exp_hi=put(table.exp_hi, _as_f32(_hi32(exp_out))),
+        remf_hi=put(table.remf_hi, remf_hi_out),
+        remf_lo=put(table.remf_lo, remf_lo_out),
+    )
+
+    resp_status = jnp.where(is_token, tok_resp_status, lk_resp_status)
+    resp_rem = jnp.where(is_token, tok_resp_rem, lk_resp_rem)
+    resp_reset = jnp.where(is_token, tok_resp_reset, lk_resp_reset)
+
+    resp = RespBatch(
+        status=jnp.where(active, resp_status, UNDER),
+        limit=jnp.where(active, req.limit, i64(0)),
+        remaining=jnp.where(active, resp_rem, i64(0)),
+        reset_time=jnp.where(active, resp_reset, i64(0)),
+        cache_hit=exists,
+        dropped=dropped,
+    )
+    stats = BatchStats(
+        cache_hits=exists.sum(dtype=i64),
+        cache_misses=(active & ~exists).sum(dtype=i64),
+        over_limit=(active & (resp.status == OVER)).sum(dtype=i64),
+        evicted_unexpired=evicted_unexpired.sum(dtype=i64),
+        dropped=dropped.sum(dtype=i64),
+    )
+    return table, resp, stats
+
+
+decide = partial(jax.jit, donate_argnums=(0,))(decide_impl)

@@ -1,26 +1,42 @@
-"""HBM-resident rate-limit state: a hash-slotted struct-of-arrays table.
+"""HBM-resident rate-limit state: a bucketized hash table tuned for TPU.
 
 This replaces the reference's per-worker LRU caches (reference lrucache.go:32-178,
 workers.go:19-37): instead of N goroutine-private `map[string]*list.Element`
-shards, a single fixed-capacity SoA of per-slot fields lives in device HBM and
-is mutated in place by the vectorized decision kernel (ops/decide.py) with
-donated buffers.
+shards, a fixed-capacity slot table lives in device HBM and is mutated in place
+by the vectorized decision kernel (ops/kernel.py) with donated buffers.
 
-Design choices vs the reference:
-* LRU eviction → expiry-stamp eviction: a slot whose `expire_at` has passed is
-  dead (the reference removes expired items on read, lrucache.go:111-128) and
-  may be reclaimed by any key probing it. When all probe slots for a new key
-  are live, the slot with the soonest expiry is evicted; if that expiry is
-  still in the future we count an "unexpired eviction", mirroring the
-  reference's over-capacity alarm metric (lrucache.go:138-149).
-* Per-slot fields mirror TokenBucketItem/LeakyBucketItem (reference
-  store.go:29-43) plus CacheItem's ExpireAt/InvalidAt (reference cache.go:29-41).
-  One int64 `remaining_i` for token buckets and one float64 `remaining_f` for
-  leaky buckets (the reference keeps a float64 remainder, store.go:32).
-* `stamp` holds TokenBucketItem.CreatedAt for token slots and
-  LeakyBucketItem.UpdatedAt for leaky slots.
-* fp == 0 marks an empty slot; fingerprints are remapped away from 0
-  (hashing.py).
+Layout is dictated by measured TPU memory-op costs (see kernel.py): 32-bit flat
+scatters and narrow row gathers vectorize; anything 64-bit or row-scattered
+serializes under the X64-emulation pass. Hence:
+
+* capacity C is divided into NB = C/K **buckets** of K slots; a key hashes to
+  one bucket and may occupy any lane in it (the probe window of the reference's
+  worker-cache probing becomes one contiguous bucket row).
+* the **probe plane** is three (NB, K) float32-carrier arrays — fp_lo, fp_hi
+  (the 63-bit fingerprint split in halves) and exp_c (expiry in ~1s coarse
+  units) — so one probe is three vectorized row gathers.
+* the **apply plane** is twelve flat (C,) float32-carrier arrays holding the
+  full per-slot state; int32 values travel bitcast inside float32 (TPU's fast
+  path), int64 millisecond timestamps are split lo/hi, and the leaky-bucket
+  float64 remainder (reference store.go:32) is stored double-single as
+  (remf_hi, remf_lo) float32 with ~48-bit effective mantissa.
+
+Field semantics mirror TokenBucketItem/LeakyBucketItem (reference store.go:29-43)
+plus CacheItem.ExpireAt (reference cache.go:29-41). ``stamp`` holds
+TokenBucketItem.CreatedAt for token slots and LeakyBucketItem.UpdatedAt for
+leaky slots. fp == 0 marks an empty slot (fingerprints are remapped away from
+0, hashing.py). CacheItem.InvalidAt (persistent-store revalidation) is handled
+by the host Store layer, not the device table.
+
+Eviction is expiry-stamp based rather than LRU: a slot whose expiry has passed
+is dead (the reference removes expired items on read, lrucache.go:111-128) and
+may be reclaimed by any key probing its bucket; when a bucket is full of live
+slots the soonest-expiring lane is evicted and counted as an "unexpired
+eviction" (reference alarm metric, lrucache.go:138-149).
+
+Documented range limits vs the reference's int64 fields: `limit` and `burst`
+must fit int32 (|v| < 2^31); the front door rejects larger values with a
+per-request error. Stored token `remaining` saturates at int32 range.
 """
 
 from __future__ import annotations
@@ -30,51 +46,78 @@ from typing import NamedTuple
 import jax.numpy as jnp
 import numpy as np
 
+# apply-plane array names, in Table field order (all (C,) float32 carriers)
+APPLY_FIELDS = (
+    "limit",  # int32 bitcast
+    "burst",  # int32 bitcast
+    "rem_i",  # int32 bitcast (token remaining, saturating)
+    "flags",  # int32 bitcast: algo | status << 8
+    "dur_lo",  # int64 duration split lo/hi (raw request duration, ms)
+    "dur_hi",
+    "stamp_lo",  # int64 CreatedAt/UpdatedAt epoch ms split
+    "stamp_hi",
+    "exp_lo",  # int64 ExpireAt epoch ms split (exact; reset_time source)
+    "exp_hi",
+    "remf_hi",  # float64 leaky remainder, double-single hi part (true f32)
+    "remf_lo",  # double-single lo part (true f32)
+)
+
+# coarse expiry shift: probe-plane expiry is (ms >> EXPC_SHIFT) ≈ 1.024 s units
+EXPC_SHIFT = 10
+
 
 class Table(NamedTuple):
-    """Per-slot state arrays, each of shape (capacity,)."""
+    # probe plane (NB, K) f32 carriers
+    pfp_lo: jnp.ndarray
+    pfp_hi: jnp.ndarray
+    pexp_c: jnp.ndarray
+    # apply plane (C,) f32 carriers, order = APPLY_FIELDS
+    limit: jnp.ndarray
+    burst: jnp.ndarray
+    rem_i: jnp.ndarray
+    flags: jnp.ndarray
+    dur_lo: jnp.ndarray
+    dur_hi: jnp.ndarray
+    stamp_lo: jnp.ndarray
+    stamp_hi: jnp.ndarray
+    exp_lo: jnp.ndarray
+    exp_hi: jnp.ndarray
+    remf_hi: jnp.ndarray
+    remf_lo: jnp.ndarray
 
-    fp: jnp.ndarray  # uint64 key fingerprint; 0 == empty
-    algo: jnp.ndarray  # int32 Algorithm
-    status: jnp.ndarray  # int32 Status (token bucket only; sticky)
-    limit: jnp.ndarray  # int64
-    duration: jnp.ndarray  # int64 (raw request duration; drives change detection)
-    remaining_i: jnp.ndarray  # int64 token-bucket remaining
-    remaining_f: jnp.ndarray  # float64 leaky-bucket remaining
-    stamp: jnp.ndarray  # int64 token CreatedAt / leaky UpdatedAt (epoch ms)
-    burst: jnp.ndarray  # int64 leaky-bucket burst
-    expire_at: jnp.ndarray  # int64 epoch ms (CacheItem.ExpireAt)
-    invalid_at: jnp.ndarray  # int64 epoch ms; 0 = never (CacheItem.InvalidAt)
+    @property
+    def bucket_k(self) -> int:
+        return self.pfp_lo.shape[-1]
 
     @property
     def capacity(self) -> int:
-        return self.fp.shape[0]
+        return self.pfp_lo.shape[-2] * self.pfp_lo.shape[-1]
 
 
-def new_table(capacity: int) -> Table:
-    """Fresh empty table. `capacity` is the hard slot count (the analog of the
-    reference's CacheSize, default 50_000, reference config.go:151); keep load
-    factor ≤ ~0.5 for healthy probe lengths."""
+def new_table(capacity: int, k: int = 8) -> Table:
+    """Fresh empty table. `capacity` is rounded up to a multiple of the bucket
+    width `k` (the analog of the reference's CacheSize, default 50_000,
+    reference config.go:151); keep load factor ≤ ~0.5 for healthy buckets."""
     if capacity <= 0:
         raise ValueError("capacity must be positive")
+    nb = max(1, -(-capacity // k))
+    probe = lambda: jnp.zeros((nb, k), dtype=jnp.float32)
+    flat = lambda: jnp.zeros(nb * k, dtype=jnp.float32)
     return Table(
-        fp=jnp.zeros(capacity, dtype=jnp.uint64),
-        algo=jnp.zeros(capacity, dtype=jnp.int32),
-        status=jnp.zeros(capacity, dtype=jnp.int32),
-        limit=jnp.zeros(capacity, dtype=jnp.int64),
-        duration=jnp.zeros(capacity, dtype=jnp.int64),
-        remaining_i=jnp.zeros(capacity, dtype=jnp.int64),
-        remaining_f=jnp.zeros(capacity, dtype=jnp.float64),
-        stamp=jnp.zeros(capacity, dtype=jnp.int64),
-        burst=jnp.zeros(capacity, dtype=jnp.int64),
-        expire_at=jnp.zeros(capacity, dtype=jnp.int64),
-        invalid_at=jnp.zeros(capacity, dtype=jnp.int64),
+        pfp_lo=probe(),
+        pfp_hi=probe(),
+        pexp_c=probe(),
+        **{f: flat() for f in APPLY_FIELDS},
     )
 
 
 def live_count(table: Table, now_ms: int) -> int:
     """Number of live (non-empty, unexpired) slots — the analog of the
-    reference cache Size() (lrucache.go:152-157)."""
-    fp = np.asarray(table.fp)
-    exp = np.asarray(table.expire_at)
-    return int(((fp != 0) & (exp >= now_ms)).sum())
+    reference cache Size() (lrucache.go:152-157). Uses the exact expiry from
+    the apply plane."""
+    lo = np.asarray(table.pfp_lo).view(np.int32).reshape(-1)
+    hi = np.asarray(table.pfp_hi).view(np.int32).reshape(-1)
+    exp = np.asarray(table.exp_lo).view(np.int32).astype(np.int64) & 0xFFFFFFFF
+    exp |= np.asarray(table.exp_hi).view(np.int32).astype(np.int64) << 32
+    nonempty = (lo != 0) | (hi != 0)
+    return int((nonempty & (exp >= now_ms)).sum())

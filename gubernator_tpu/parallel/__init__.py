@@ -1,0 +1,4 @@
+from gubernator_tpu.parallel.mesh import make_mesh
+from gubernator_tpu.parallel.sharded import ShardedEngine
+
+__all__ = ["make_mesh", "ShardedEngine"]

@@ -1,0 +1,35 @@
+"""Device mesh construction.
+
+The TPU mesh replaces the reference's cluster of gRPC peers for key ownership:
+where gubernator consistent-hashes each key to one of N nodes
+(reference replicated_hash.go:104-119), we hash each key to one of D devices on
+a 1-D mesh axis "shard". Multi-host TPU slices extend the same axis across
+hosts over ICI; cross-region stays on the host peer plane (peers/).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over `n_devices` (default: all local devices)."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (SHARD_AXIS,))
+
+
+def shard_of(fp: np.ndarray, n_shards: int) -> np.ndarray:
+    """Owning shard for each fingerprint. Uses high bits so the shard choice is
+    independent of the in-table slot (fp mod capacity uses low bits) — the
+    analog of the reference using separate hashes for peer ownership and
+    worker sharding (replicated_hash.go:78-91 vs workers.go:185-189)."""
+    return ((fp >> 32) % n_shards).astype(np.int64)

@@ -15,6 +15,13 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/gubernator_tpu_jit_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
+import jax  # noqa: E402
+
+# The axon bootstrap (sitecustomize in /root/.axon_site) force-sets
+# jax_platforms to the TPU tunnel; tests run on the virtual CPU mesh, so
+# override it back *after* jax import, before any backend initialization.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

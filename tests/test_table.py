@@ -1,0 +1,109 @@
+"""Hash-table mechanics: probing, collisions, expiry reclaim, eviction.
+
+The analog of the reference's cache tests (lrucache_test.go) — but eviction here
+is expiry-stamp-based (SURVEY.md §7) rather than LRU, so the assertions target:
+slots reclaimed after expiry, soonest-expiring victim chosen when full, and the
+unexpired-eviction alarm counter (reference lrucache.go:138-149).
+"""
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.ops.engine import LocalEngine
+from gubernator_tpu.ops.table import live_count
+from gubernator_tpu.types import RateLimitRequest, Status, MINUTE, SECOND
+
+
+def req(key, hits=1, limit=10, duration=MINUTE, created_at=None, name="tbl"):
+    return RateLimitRequest(
+        name=name, unique_key=key, hits=hits, limit=limit, duration=duration,
+        created_at=created_at,
+    )
+
+
+def test_many_keys_one_by_one_fill_and_persist(frozen_now):
+    eng = LocalEngine(capacity=128)
+    t = frozen_now
+    for i in range(60):
+        (r,) = eng.check([req(f"k{i}", created_at=t)], now_ms=t)
+        assert r.remaining == 9
+    # all 60 keys retained; second round decrements each
+    for i in range(60):
+        (r,) = eng.check([req(f"k{i}", created_at=t)], now_ms=t)
+        assert r.remaining == 8, f"key k{i} lost state"
+    assert live_count(eng.table, t) == 60
+
+
+def test_expired_slots_are_reclaimed(frozen_now):
+    eng = LocalEngine(capacity=64)
+    t = frozen_now
+    out = eng.check([req(f"a{i}", duration=SECOND, created_at=t) for i in range(30)], now_ms=t)
+    assert all(r.status == Status.UNDER_LIMIT for r in out)
+    assert live_count(eng.table, t) == 30
+    # a second wave after expiry reuses the dead slots: no drops, no unexpired
+    # evictions, and the old keys are gone
+    t2 = t + 2 * SECOND
+    out = eng.check([req(f"b{i}", duration=SECOND, created_at=t2) for i in range(30)], now_ms=t2)
+    assert all(r.status == Status.UNDER_LIMIT for r in out)
+    assert eng.stats.dropped == 0
+    assert eng.stats.evicted_unexpired == 0
+    (r,) = eng.check([req("a0", duration=SECOND, created_at=t2)], now_ms=t2)
+    assert r.remaining == 9  # fresh bucket — original a0 state expired
+
+
+def test_unexpired_eviction_when_full(frozen_now):
+    # capacity 8: fill it with live keys, then insert more one at a time —
+    # each new key must evict a live victim and count it
+    eng = LocalEngine(capacity=8)
+    t = frozen_now
+    for i in range(8):
+        eng.check([req(f"full{i}", created_at=t)], now_ms=t)
+    assert live_count(eng.table, t) == 8
+    before = eng.stats.evicted_unexpired
+    for i in range(4):
+        (r,) = eng.check([req(f"extra{i}", created_at=t)], now_ms=t)
+        assert r.status == Status.UNDER_LIMIT
+    assert eng.stats.evicted_unexpired == before + 4
+    assert live_count(eng.table, t) == 8  # still full, evictions replaced
+
+
+def test_colliding_keys_coexist_via_probing(frozen_now):
+    # with capacity C, keys whose fingerprints share fp % C land in the same
+    # probe window; linear probing must keep them all live. Use a tiny table
+    # and enough keys that collisions are guaranteed.
+    eng = LocalEngine(capacity=16, probes=8)
+    t = frozen_now
+    keys = [f"c{i}" for i in range(12)]
+    for k in keys:
+        eng.check([req(k, created_at=t)], now_ms=t)
+    # every key retained despite shared windows
+    for k in keys:
+        (r,) = eng.check([req(k, hits=0, created_at=t)], now_ms=t)
+        assert r.remaining == 9, f"key {k} lost"
+
+
+def test_oversubscribed_single_batch_answers_all(frozen_now):
+    # 64 inserts into 16 slots in one call: every request gets a correct
+    # decision; the engine's claim-retry loop persists what fits, later
+    # inserts evict earlier ones (expiry-stamp eviction ≈ the reference's LRU
+    # thrash under over-capacity), and the alarm counter fires.
+    eng = LocalEngine(capacity=16)
+    t = frozen_now
+    out = eng.check([req(f"x{i}", created_at=t) for i in range(64)], now_ms=t)
+    assert all(r.status == Status.UNDER_LIMIT for r in out)
+    assert live_count(eng.table, t) == 16  # table full, not corrupted
+    assert eng.stats.evicted_unexpired > 0
+
+
+def test_store_and_reread_across_many_batches(frozen_now):
+    # steady-state churn: repeated mixed batches keep per-key counters exact
+    eng = LocalEngine(capacity=512)
+    t = frozen_now
+    rng = np.random.default_rng(7)
+    counts = {}
+    for _ in range(20):
+        ks = rng.choice(100, size=32, replace=False)
+        out = eng.check([req(f"m{k}", limit=1000, created_at=t) for k in ks], now_ms=t)
+        for k, r in zip(ks, out):
+            counts[k] = counts.get(k, 0) + 1
+            assert r.remaining == 1000 - counts[k]
