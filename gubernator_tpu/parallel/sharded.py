@@ -119,9 +119,10 @@ class ShardedEngine:
         self.stats.checks += len(requests)
         return out  # type: ignore[return-value]
 
-    def _dispatch(self, batch: HostBatch):
+    def _dispatch(self, batch: HostBatch, depth: int = 0):
         """Route one unique-fp pass across shards, run, and un-route responses
-        back to pass-row order."""
+        back to pass-row order. Rows dropped by the claim auction are
+        re-dispatched (cf. LocalEngine._dispatch_with_retry)."""
         D = self.n_shards
         n = batch.fp.shape[0]
         shard = shard_of(batch.fp, D)
@@ -152,9 +153,21 @@ class ShardedEngine:
         limit = np.asarray(resp.limit)[shard[order], offset_in_shard]
         remaining = np.asarray(resp.remaining)[shard[order], offset_in_shard]
         reset = np.asarray(resp.reset_time)[shard[order], offset_in_shard]
+        dropped = np.asarray(resp.dropped)[shard[order], offset_in_shard]
         inv = np.empty(n, dtype=np.int64)
         inv[order] = np.arange(n)
-        return order, (status[inv], limit[inv], remaining[inv], reset[inv])
+        status, limit, remaining, reset, dropped = (
+            status[inv], limit[inv], remaining[inv], reset[inv], dropped[inv]
+        )
+        if dropped.any() and depth < 3:
+            rows = np.nonzero(dropped)[0]
+            _, (s2, l2, r2, t2) = self._dispatch(
+                _subset(batch, rows), depth=depth + 1
+            )
+            status = status.copy(); limit = limit.copy()
+            remaining = remaining.copy(); reset = reset.copy()
+            status[rows], limit[rows], remaining[rows], reset[rows] = s2, l2, r2, t2
+        return np.arange(n), (status, limit, remaining, reset)
 
 
 def _to_grid(field: np.ndarray, shard_sorted, offset, D: int, b_local: int) -> np.ndarray:
