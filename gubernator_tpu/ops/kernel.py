@@ -57,7 +57,7 @@ Deliberate divergences from the reference (documented, not cargo-culted):
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -95,22 +95,20 @@ def _hi32(x):
     return (x >> 32).astype(i32)
 
 
-def decide_impl(table: Table, req: ReqBatch) -> Tuple[Table, RespBatch, BatchStats]:
-    """Un-jitted kernel body — call through `decide` (jitted, donating) on a
-    single device, or directly inside shard_map (parallel/sharded.py)."""
+def _probe_claim(table: Table, fp, now, active):
+    """Shared probe + claim phases: find each row's slot (existing fingerprint
+    match, vacant lane, or eviction victim). Returns
+    (slot, owns, resolved, dropped, won_evict, my_lo, my_hi)."""
     NB, K = table.pfp_lo.shape
     C = NB * K
-    B = req.fp.shape[0]
+    B = fp.shape[0]
     if B > (1 << 20):
         raise ValueError("batch larger than 2^20 rows")
 
-    now = req.created_at  # per-row "now" (epoch ms)
-    active = req.active
-
     # ------------------------------------------------------------------ probe
-    bucket = (req.fp % NB).astype(i32)
-    my_lo = _lo32(req.fp)
-    my_hi = _hi32(req.fp)
+    bucket = (fp % NB).astype(i32)
+    my_lo = _lo32(fp)
+    my_hi = _hi32(fp)
     bfp_lo = _as_i32(table.pfp_lo[bucket])  # (B, K) row gathers
     bfp_hi = _as_i32(table.pfp_hi[bucket])
     bexp_c = _as_i32(table.pexp_c[bucket])
@@ -181,6 +179,22 @@ def decide_impl(table: Table, req: ReqBatch) -> Tuple[Table, RespBatch, BatchSta
 
     slot = bucket * K + lane_sel  # always in range; meaningless if unresolved
     dropped = active & ~resolved
+    return slot, owns, resolved, dropped, won_evict, my_lo, my_hi
+
+
+def decide_impl(table: Table, req: ReqBatch) -> Tuple[Table, RespBatch, BatchStats]:
+    """Un-jitted kernel body — call through `decide` (jitted, donating) on a
+    single device, or directly inside shard_map (parallel/sharded.py)."""
+    NB, K = table.pfp_lo.shape
+    C = NB * K
+    B = req.fp.shape[0]
+    DROPC = jnp.int32(C)
+
+    now = req.created_at  # per-row "now" (epoch ms)
+    active = req.active
+    slot, owns, resolved, dropped, won_evict, my_lo, my_hi = _probe_claim(
+        table, req.fp, now, active
+    )
 
     # ------------------------------------------------------------------ apply
     g32 = lambda arr: _as_i32(arr[slot])  # flat f32-carrier gather + bitcast
@@ -403,3 +417,71 @@ def decide_impl(table: Table, req: ReqBatch) -> Tuple[Table, RespBatch, BatchSta
 
 
 decide = partial(jax.jit, donate_argnums=(0,))(decide_impl)
+
+
+def install_impl(table: Table, inst: "InstallBatch") -> Tuple[Table, jnp.ndarray]:
+    """Install owner-authoritative statuses into a (replica) table — the
+    analog of UpdatePeerGlobals (reference gubernator.go:434-474): each entry
+    unconditionally becomes a fresh item with ExpireAt = reset_time; token
+    items keep the owner's remaining/status with CreatedAt = now; leaky items
+    take Remaining = remaining, Burst = Limit, UpdatedAt = now.
+
+    Returns (table', installed_mask)."""
+    now = inst.now
+    active = inst.active
+    slot, owns, resolved, dropped, _evict, my_lo, my_hi = _probe_claim(
+        table, inst.fp, now, active
+    )
+    NB, K = table.pfp_lo.shape
+    DROPC = jnp.int32(NB * K)
+
+    is_token = inst.algo == int(Algorithm.TOKEN_BUCKET)
+    status_out = inst.status
+    flags_out = inst.algo | (status_out << 8)
+    rem_i_out = jnp.where(is_token, inst.remaining, i64(0))
+    rem_f_out = jnp.where(is_token, f64(0.0), inst.remaining.astype(f64))
+    burst_out = jnp.where(is_token, i64(0), inst.limit)
+    exp_out = inst.reset_time
+
+    w = jnp.where(active & resolved, slot, DROPC)
+    sat32 = lambda x: jnp.clip(x, -(2**31), 2**31 - 1).astype(i32)
+    put = lambda arr, v: arr.reshape(-1).at[w].set(v, mode="drop").reshape(arr.shape)
+    table = Table(
+        pfp_lo=put(table.pfp_lo, _as_f32(my_lo)),
+        pfp_hi=put(table.pfp_hi, _as_f32(my_hi)),
+        pexp_c=put(table.pexp_c, _as_f32((exp_out >> EXPC_SHIFT).astype(i32))),
+        limit=put(table.limit, _as_f32(sat32(inst.limit))),
+        burst=put(table.burst, _as_f32(sat32(burst_out))),
+        rem_i=put(table.rem_i, _as_f32(sat32(rem_i_out))),
+        flags=put(table.flags, _as_f32(flags_out)),
+        dur_lo=put(table.dur_lo, _as_f32(_lo32(inst.duration))),
+        dur_hi=put(table.dur_hi, _as_f32(_hi32(inst.duration))),
+        stamp_lo=put(table.stamp_lo, _as_f32(_lo32(now))),
+        stamp_hi=put(table.stamp_hi, _as_f32(_hi32(now))),
+        exp_lo=put(table.exp_lo, _as_f32(_lo32(exp_out))),
+        exp_hi=put(table.exp_hi, _as_f32(_hi32(exp_out))),
+        remf_hi=put(table.remf_hi, rem_f_out.astype(f32)),
+        remf_lo=put(
+            table.remf_lo, (rem_f_out - rem_f_out.astype(f32).astype(f64)).astype(f32)
+        ),
+    )
+    return table, active & resolved
+
+
+class InstallBatch(NamedTuple):
+    """SoA of authoritative global statuses (one owner-broadcast entry per
+    row): what UpdatePeerGlobalsReq.Globals carries (reference peers.proto:50-73)."""
+
+    fp: jnp.ndarray  # int64
+    algo: jnp.ndarray  # int32
+    status: jnp.ndarray  # int32
+    limit: jnp.ndarray  # int64
+    remaining: jnp.ndarray  # int64
+    reset_time: jnp.ndarray  # int64
+    duration: jnp.ndarray  # int64
+    now: jnp.ndarray  # int64 (B,)
+    active: jnp.ndarray  # bool
+
+
+install = partial(jax.jit, donate_argnums=(0,))(install_impl)
+

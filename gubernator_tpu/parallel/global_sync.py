@@ -1,0 +1,324 @@
+"""GLOBAL behavior as mesh collectives — the reference's globalManager
+(reference global.go:31-307) re-designed for the TPU interconnect.
+
+In the reference, a GLOBAL rate limit has one owning node; every other node
+answers from a local read-replica immediately and asynchronously ships its
+accumulated hits to the owner (runAsyncHits, 100 ms cadence), which applies
+them with DRAIN_OVER_LIMIT forced and broadcasts the authoritative status to
+every peer (runBroadcasts → UpdatePeerGlobals). Worst case 3+N gRPC messages
+per hit, amortized by two batching stages (docs/architecture.md:84-105).
+
+Here the mesh replaces the peer group: every device keeps
+* its authoritative table shard (ShardedEngine), and
+* a **replica table** holding installed statuses of remote-owned GLOBAL keys,
+* a host-side pending-hit accumulator per device (sum hits, OR RESET_REMAINING
+  — exactly the reference aggregation, global.go:109-123).
+
+`sync()` is ONE jitted collective step (the 3+N message dance collapses into
+two all_gathers over ICI):
+ 1. all_gather every device's outbox of aggregated hits;
+ 2. each device filters entries it owns, segment-aggregates duplicates from
+    different devices, applies them through the decision kernel with
+    DRAIN_OVER_LIMIT forced (reference gubernator.go:526-532);
+ 3. all_gather the resulting authoritative statuses; every device installs
+    entries it does NOT own into its replica table (install kernel =
+    UpdatePeerGlobals semantics, reference gubernator.go:434-474).
+
+GLOBAL requests are answered from the home device's replica table immediately
+("process like we own it" with GLOBAL stripped and NO_BATCHING forced,
+reference gubernator.go:401-429) — eventual consistency bounded by the sync
+cadence, identical to the reference's contract.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from gubernator_tpu.ops.batch import HostBatch, ReqBatch, pack_requests, pad_batch
+from gubernator_tpu.ops.kernel import InstallBatch, decide_impl, install_impl
+from gubernator_tpu.ops.plan import plan_passes, _subset
+from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_of
+from gubernator_tpu.parallel.sharded import ShardedEngine, new_sharded_table
+from gubernator_tpu.types import (
+    Behavior,
+    RateLimitRequest,
+    RateLimitResponse,
+    has_behavior,
+)
+from gubernator_tpu.ops.engine import _pad_size, ms_now
+
+
+@dataclass
+class GlobalStats:
+    """Counters mirroring the reference's global-behavior metric family
+    (global.go:53-79) — load-bearing for convergence tests (§4 SURVEY.md)."""
+
+    hits_queued: int = 0
+    sync_rounds: int = 0
+    broadcasts_applied: int = 0  # entries applied+broadcast as owner
+    updates_installed: int = 0  # entries installed into replica tables
+    send_queue_length: int = 0
+
+
+def _mk_sync_step(mesh, n_shards: int, out_size: int):
+    """Build the jitted collective sync step."""
+    D = n_shards
+    DROP_FP = jnp.int64(1) << 62
+    RESET = int(Behavior.RESET_REMAINING)
+    DRAIN = int(Behavior.DRAIN_OVER_LIMIT)
+
+    def per_device(primary, replica, outbox: ReqBatch):
+        primary = jax.tree.map(lambda x: x[0], primary)
+        replica = jax.tree.map(lambda x: x[0], replica)
+        outbox = jax.tree.map(lambda x: x[0], outbox)
+        me = jax.lax.axis_index(SHARD_AXIS)
+
+        # ---- stage 1: exchange hit outboxes (runAsyncHits → sendHits analog)
+        gath = jax.lax.all_gather(outbox, SHARD_AXIS)  # leaves (D, OUT)
+        flat = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), gath)
+        N = flat.fp.shape[0]
+        owner = ((flat.fp >> 32) % D).astype(jnp.int32)
+        mine = flat.active & (owner == me)
+
+        # ---- stage 2: aggregate same-key hits from different devices
+        key = jnp.where(mine, flat.fp, DROP_FP)
+        order = jnp.argsort(key)
+        sfp = key[order]
+        first = jnp.concatenate(
+            [jnp.ones((1,), dtype=bool), sfp[1:] != sfp[:-1]]
+        )
+        seg = jnp.cumsum(first) - 1
+        hits = jax.ops.segment_sum(flat.hits[order], seg, num_segments=N)
+        reset_bit = jax.ops.segment_max(
+            (flat.behavior[order] & RESET), seg, num_segments=N
+        )
+        pos = jnp.arange(N)
+        # config carrier = newest contributing entry of the segment
+        carrier_pos = jax.ops.segment_max(
+            jnp.where(mine[order], pos, -1), seg, num_segments=N
+        )
+        valid = carrier_pos >= 0
+        carrier = order[jnp.clip(carrier_pos, 0, N - 1)]
+        cfg = jax.tree.map(lambda x: x[carrier], flat)
+        agg = cfg._replace(
+            hits=hits,
+            # owner applies accumulated global hits with DRAIN forced
+            # (reference gubernator.go:526-532) and RESET OR-ed in
+            behavior=cfg.behavior | DRAIN | reset_bit,
+            active=valid,
+        )
+        primary, resp, stats = decide_impl(primary, agg)
+
+        # ---- stage 3: broadcast authoritative statuses (runBroadcasts analog)
+        bc = InstallBatch(
+            fp=jnp.where(valid, agg.fp, jnp.int64(0)),
+            algo=agg.algo,
+            status=resp.status,
+            limit=resp.limit,
+            remaining=resp.remaining,
+            reset_time=resp.reset_time,
+            duration=agg.duration,
+            now=agg.created_at,
+            active=valid,
+        )
+        bc_all = jax.lax.all_gather(bc, SHARD_AXIS)
+        bc_flat = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), bc_all)
+        bc_owner = ((bc_flat.fp >> 32) % D).astype(jnp.int32)
+        theirs = bc_flat.active & (bc_owner != me)
+        inst = bc_flat._replace(active=theirs)
+        replica, installed = install_impl(replica, inst)
+
+        counters = jnp.stack(
+            [
+                valid.sum(dtype=jnp.int64),  # broadcasts applied as owner
+                installed.sum(dtype=jnp.int64),  # replica installs
+            ]
+        )
+        expand = lambda t: jax.tree.map(lambda x: x[None], t)
+        return expand(primary), expand(replica), counters[None]
+
+    spec = P(SHARD_AXIS)
+    fn = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=(spec, spec, spec),
+    )
+    return jax.jit(fn, donate_argnums=(0, 1))
+
+
+class GlobalShardedEngine(ShardedEngine):
+    """ShardedEngine + GLOBAL-behavior replicas and collective sync.
+
+    `home_shard` models which node a client connected to (the reference's
+    non-owner): GLOBAL requests are answered from that device's replica table
+    and their hits accumulate until the next sync tick (GlobalSyncWait analog,
+    default 100 ms, reference config.go:142-146)."""
+
+    def __init__(
+        self,
+        mesh,
+        capacity_per_shard: int = 50_000,
+        probes: int = 8,
+        max_exact_passes: int = 8,
+        sync_out: int = 256,
+    ):
+        super().__init__(
+            mesh,
+            capacity_per_shard=capacity_per_shard,
+            probes=probes,
+            max_exact_passes=max_exact_passes,
+        )
+        self.replica = new_sharded_table(mesh, capacity_per_shard, k=probes)
+        self.sync_out = sync_out
+        self.pending: List[Dict[int, dict]] = [dict() for _ in range(self.n_shards)]
+        self._sync_step = _mk_sync_step(mesh, self.n_shards, sync_out)
+        self.global_stats = GlobalStats()
+
+    # ------------------------------------------------------------------ check
+    def check(
+        self,
+        requests: Sequence[RateLimitRequest],
+        now_ms: Optional[int] = None,
+        home_shard: int = 0,
+    ) -> List[RateLimitResponse]:
+        now = now_ms if now_ms is not None else ms_now()
+        glob = [
+            i
+            for i, r in enumerate(requests)
+            if has_behavior(r.behavior, Behavior.GLOBAL)
+        ]
+        if not glob:
+            return super().check(requests, now_ms=now)
+        rest = [i for i in range(len(requests)) if i not in set(glob)]
+        out: List[Optional[RateLimitResponse]] = [None] * len(requests)
+        if rest:
+            sub = super().check([requests[i] for i in rest], now_ms=now)
+            for i, r in zip(rest, sub):
+                out[i] = r
+        gsub = self._check_global([requests[i] for i in glob], now, home_shard)
+        for i, r in zip(glob, gsub):
+            out[i] = r
+        return out  # type: ignore[return-value]
+
+    def _queue(self, hb: HostBatch, i: int, home: int, hits: int) -> None:
+        fp = int(hb.fp[i])
+        agg = self.pending[home].get(fp)
+        if agg is None:
+            self.pending[home][fp] = {
+                "row": _subset(hb, np.array([i])),
+                "hits": hits,
+                "reset": int(hb.behavior[i]) & int(Behavior.RESET_REMAINING),
+            }
+        else:
+            agg["hits"] += hits
+            agg["reset"] |= int(hb.behavior[i]) & int(Behavior.RESET_REMAINING)
+            agg["row"] = _subset(hb, np.array([i]))  # newest config wins
+
+    def _check_global(
+        self, requests: Sequence[RateLimitRequest], now: int, home: int
+    ) -> List[RateLimitResponse]:
+        """GLOBAL dispatch. Requests whose owner shard IS the home device run
+        the owner path against the authoritative table and queue a broadcast
+        (reference getLocalRateLimit + QueueUpdate, gubernator.go:653-690);
+        everything else is answered from the home replica and its hits are
+        queued for the owner (getGlobalRateLimit, gubernator.go:401-429)."""
+        hb, errors = pack_requests(requests, now)
+        out: List[Optional[RateLimitResponse]] = [None] * len(requests)
+        for i, err in enumerate(errors):
+            if err is not None:
+                out[i] = RateLimitResponse(error=err)
+        owner = shard_of(hb.fp, self.n_shards)
+        is_owner_here = (owner == home) & hb.active
+
+        for i in range(len(requests)):
+            if not hb.active[i] or hb.hits[i] == 0:
+                continue  # zero-hit requests are never queued (global.go:85-95)
+            if is_owner_here[i]:
+                # owner-side hit: applied directly below; queue a broadcast of
+                # the updated status (QueueUpdate → runBroadcasts)
+                self._queue(hb, i, home, hits=0)
+            else:
+                self._queue(hb, i, home, hits=int(hb.hits[i]))
+                self.global_stats.hits_queued += 1
+        self.global_stats.send_queue_length = sum(len(p) for p in self.pending)
+
+        # non-owner rows answer from the home replica: strip GLOBAL, force
+        # NO_BATCHING (reference gubernator.go:416-422)
+        hb2 = hb._replace(
+            behavior=(hb.behavior & ~np.int32(Behavior.GLOBAL))
+            | np.int32(Behavior.NO_BATCHING),
+            active=hb.active & ~is_owner_here,
+        )
+        self._global_passes(hb2, out, table_attr="replica", home=home)
+        # owner rows run the authoritative path on the primary shard
+        hb3 = hb._replace(active=is_owner_here)
+        self._global_passes(hb3, out, table_attr="table", home=None)
+        self.stats.checks += len(requests)
+        return out  # type: ignore[return-value]
+
+    def _global_passes(self, hb: HostBatch, out, table_attr: str, home) -> None:
+        if not hb.active.any():
+            return
+        for p in plan_passes(hb, max_exact=self.max_exact_passes):
+            nrows = len(p.rows)
+            batch = pad_batch(p.batch, _pad_size(nrows))
+            shard = (
+                np.full(batch.fp.shape[0], home, dtype=np.int64)
+                if home is not None
+                else None
+            )
+            _, (status, limit, remaining, reset) = self._dispatch(
+                batch, shard=shard, table_attr=table_attr
+            )
+            for bi, orig in enumerate(p.rows):
+                r = RateLimitResponse(
+                    status=int(status[bi]),
+                    limit=int(limit[bi]),
+                    remaining=int(remaining[bi]),
+                    reset_time=int(reset[bi]),
+                )
+                if p.member_rows:
+                    for row in p.member_rows[bi]:
+                        out[int(row)] = r
+                else:
+                    out[int(orig)] = r
+
+    # ------------------------------------------------------------------- sync
+    def sync(self, now_ms: Optional[int] = None) -> None:
+        """One collective hit-sync + broadcast round (the 100 ms tick)."""
+        now = now_ms if now_ms is not None else ms_now()
+        OUT = self.sync_out
+        boxes = []
+        for d in range(self.n_shards):
+            entries = list(self.pending[d].items())[:OUT]
+            rows = [e[1]["row"] for e in entries]
+            if rows:
+                box = HostBatch(*[np.concatenate([r[k] for r in rows]) for k in range(len(rows[0]))])
+            else:
+                box = HostBatch(*[np.zeros(0, dtype=f.dtype) for f in pack_requests([], now)[0]])
+            box = pad_batch(box, OUT)
+            for j, (fp, agg) in enumerate(entries):
+                box.hits[j] = agg["hits"]
+                box.behavior[j] |= agg["reset"]
+                box.created_at[j] = now
+            boxes.append(box)
+            self.pending[d] = dict(list(self.pending[d].items())[OUT:])
+        stacked = HostBatch(*[np.stack([b[k] for b in boxes]) for k in range(len(boxes[0]))])
+        dev_box = jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding), stacked
+        )
+        self.table, self.replica, counters = self._sync_step(
+            self.table, self.replica, dev_box
+        )
+        c = np.asarray(counters)
+        self.global_stats.sync_rounds += 1
+        self.global_stats.broadcasts_applied += int(c[:, 0].sum())
+        self.global_stats.updates_installed += int(c[:, 1].sum())
+        self.global_stats.send_queue_length = sum(len(p) for p in self.pending)

@@ -119,41 +119,53 @@ class ShardedEngine:
         self.stats.checks += len(requests)
         return out  # type: ignore[return-value]
 
-    def _dispatch(self, batch: HostBatch, depth: int = 0):
+    def _dispatch(
+        self,
+        batch: HostBatch,
+        depth: int = 0,
+        shard: Optional[np.ndarray] = None,
+        table_attr: str = "table",
+    ):
         """Route one unique-fp pass across shards, run, and un-route responses
         back to pass-row order. Rows dropped by the claim auction are
-        re-dispatched (cf. LocalEngine._dispatch_with_retry)."""
+        re-dispatched (cf. LocalEngine._dispatch_with_retry).
+
+        `shard` overrides ownership routing (used by the GLOBAL path to pin
+        requests to their home device's replica table); `table_attr` picks the
+        state table ("table" = authoritative shards, "replica" = GLOBAL
+        read-replicas)."""
         D = self.n_shards
         n = batch.fp.shape[0]
-        shard = shard_of(batch.fp, D)
-        order = np.argsort(shard, kind="stable")  # rows grouped by shard
-        counts = np.bincount(shard, minlength=D)
+        routed = shard if shard is not None else shard_of(batch.fp, D)
+        order = np.argsort(routed, kind="stable")  # rows grouped by shard
+        counts = np.bincount(routed, minlength=D)
         b_local = _pad_size(int(counts.max()))
         # scatter rows into (D, b_local) position grid
         grouped = _subset(batch, order)
         offset_in_shard = np.arange(n) - np.searchsorted(
-            shard[order], shard[order]
+            routed[order], routed[order]
         )
         stacked = HostBatch(
             *[
-                _to_grid(f, shard[order], offset_in_shard, D, b_local)
+                _to_grid(f, routed[order], offset_in_shard, D, b_local)
                 for f in grouped
             ]
         )
         dev_batch = jax.tree.map(
             lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding), stacked
         )
-        self.table, resp, stats = self._decide(self.table, dev_batch)
+        table, resp, stats = self._decide(getattr(self, table_attr), dev_batch)
+        setattr(self, table_attr, table)
         self.stats.dispatches += 1
         self.stats.accumulate(
-            jax.tree.map(lambda x: x.sum(), stats)
+            jax.tree.map(lambda x: x.sum(), stats), count_dropped=False
         )
-        # gather responses back: row i lives at (shard[order][i], offset[i])
-        status = np.asarray(resp.status)[shard[order], offset_in_shard]
-        limit = np.asarray(resp.limit)[shard[order], offset_in_shard]
-        remaining = np.asarray(resp.remaining)[shard[order], offset_in_shard]
-        reset = np.asarray(resp.reset_time)[shard[order], offset_in_shard]
-        dropped = np.asarray(resp.dropped)[shard[order], offset_in_shard]
+        # gather responses back: row i lives at (routed[order][i], offset[i])
+        status = np.asarray(resp.status)[routed[order], offset_in_shard]
+        limit = np.asarray(resp.limit)[routed[order], offset_in_shard]
+        remaining = np.asarray(resp.remaining)[routed[order], offset_in_shard]
+        reset = np.asarray(resp.reset_time)[routed[order], offset_in_shard]
+        dropped = np.asarray(resp.dropped)[routed[order], offset_in_shard]
         inv = np.empty(n, dtype=np.int64)
         inv[order] = np.arange(n)
         status, limit, remaining, reset, dropped = (
@@ -162,11 +174,16 @@ class ShardedEngine:
         if dropped.any() and depth < 3:
             rows = np.nonzero(dropped)[0]
             _, (s2, l2, r2, t2) = self._dispatch(
-                _subset(batch, rows), depth=depth + 1
+                _subset(batch, rows),
+                depth=depth + 1,
+                shard=routed[rows] if shard is not None else None,
+                table_attr=table_attr,
             )
             status = status.copy(); limit = limit.copy()
             remaining = remaining.copy(); reset = reset.copy()
             status[rows], limit[rows], remaining[rows], reset[rows] = s2, l2, r2, t2
+        elif dropped.any():
+            self.stats.dropped += int(dropped.sum())
         return np.arange(n), (status, limit, remaining, reset)
 
 

@@ -1,0 +1,152 @@
+"""GLOBAL-behavior convergence tests — the analog of the reference's
+TestGlobalBehavior suite (functional_test.go:1760-2167), which asserts exact
+broadcast/update counts via metrics scraping and verifies every peer converges
+to the same remaining."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from gubernator_tpu.parallel import make_mesh
+from gubernator_tpu.parallel.global_sync import GlobalShardedEngine
+from gubernator_tpu.parallel.mesh import shard_of
+from gubernator_tpu.hashing import fingerprint
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest, Status, MINUTE
+
+
+def greq(key, hits=1, limit=100, behavior=Behavior.GLOBAL, created_at=None,
+         algorithm=Algorithm.TOKEN_BUCKET):
+    return RateLimitRequest(
+        name="glob", unique_key=key, hits=hits, limit=limit, duration=MINUTE,
+        algorithm=algorithm, behavior=behavior, created_at=created_at,
+    )
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+def owner_of(key: str, n: int = 8) -> int:
+    return int(shard_of(np.array([fingerprint("glob", key)], dtype=np.int64), n)[0])
+
+
+def non_owner_of(key: str, n: int = 8) -> int:
+    return (owner_of(key, n) + 1) % n
+
+
+def test_global_hits_flow_to_owner_and_broadcast_back(mesh, frozen_now):
+    eng = GlobalShardedEngine(mesh, capacity_per_shard=1024, sync_out=64)
+    t = frozen_now
+    key = "gk1"
+    home = non_owner_of(key)
+
+    # 5 hits arrive at a NON-owner: answered locally, queued for the owner
+    for i in range(5):
+        (r,) = eng.check([greq(key, created_at=t)], now_ms=t, home_shard=home)
+        assert r.status == Status.UNDER_LIMIT
+    assert eng.global_stats.hits_queued == 5
+    assert eng.global_stats.send_queue_length == 1  # aggregated per key
+
+    # sync tick: owner applies the aggregated 5 hits, broadcasts to replicas
+    eng.sync(now_ms=t)
+    assert eng.global_stats.sync_rounds == 1
+    assert eng.global_stats.broadcasts_applied == 1
+    assert eng.global_stats.updates_installed == 7  # every non-owner installs
+    assert eng.global_stats.send_queue_length == 0
+
+    # the authoritative state on the owner reflects all 5 hits: a zero-hit
+    # probe routed through the normal (owner) path reports remaining 95
+    (r,) = eng.check([greq(key, hits=0, behavior=0, created_at=t)], now_ms=t)
+    assert r.remaining == 95
+
+    # every replica converges: a GLOBAL read at ANY home shard sees 95
+    for home2 in range(8):
+        (r,) = eng.check([greq(key, hits=0, created_at=t)], now_ms=t, home_shard=home2)
+        assert r.remaining == 95, f"replica at shard {home2} did not converge"
+
+
+def test_global_over_limit_converges(mesh, frozen_now):
+    # reference TestGlobalRateLimitsPeerOverLimit (functional_test.go:1094):
+    # spend within the limit, sync, then over-ask — the owner applies the
+    # accumulated hits with DRAIN_OVER_LIMIT forced (gubernator.go:526-532)
+    eng = GlobalShardedEngine(mesh, capacity_per_shard=1024, sync_out=64)
+    t = frozen_now
+    key = "gk-over"
+    home = non_owner_of(key)
+    (r,) = eng.check([greq(key, hits=3, limit=5, created_at=t)], now_ms=t,
+                     home_shard=home)
+    assert r.remaining == 2 and r.status == Status.UNDER_LIMIT
+    eng.sync(now_ms=t)
+    # replica over-ask: rejected locally without consuming, hits still queued
+    (r,) = eng.check([greq(key, hits=3, limit=5, created_at=t)], now_ms=t,
+                     home_shard=home)
+    assert r.status == Status.OVER_LIMIT and r.remaining == 2
+    eng.sync(now_ms=t)
+    # owner applied 3 > 2 with DRAIN forced → drained to 0, everywhere
+    for home2 in range(8):
+        (r,) = eng.check([greq(key, hits=0, limit=5, created_at=t)], now_ms=t,
+                         home_shard=home2)
+        assert r.remaining == 0, f"shard {home2}"
+    (r,) = eng.check([greq(key, hits=1, limit=5, created_at=t)], now_ms=t,
+                     home_shard=home)
+    assert r.status == Status.OVER_LIMIT
+
+
+def test_global_hits_from_multiple_homes_aggregate(mesh, frozen_now):
+    eng = GlobalShardedEngine(mesh, capacity_per_shard=1024, sync_out=64)
+    t = frozen_now
+    key = "gk-multi"
+    # hits land on several different non-owner homes before one sync
+    homes = [h for h in range(8) if h != owner_of(key)][:4]
+    for h in homes:
+        eng.check([greq(key, hits=2, created_at=t)], now_ms=t, home_shard=h)
+    eng.sync(now_ms=t)
+    # owner must have applied 4 homes x 2 hits = 8
+    (r,) = eng.check([greq(key, hits=0, behavior=0, created_at=t)], now_ms=t)
+    assert r.remaining == 92
+
+
+def test_global_leaky_bucket(mesh, frozen_now):
+    eng = GlobalShardedEngine(mesh, capacity_per_shard=1024, sync_out=64)
+    t = frozen_now
+    key = "gk-leaky"
+    home = non_owner_of(key)
+    (r,) = eng.check(
+        [greq(key, hits=4, limit=10, algorithm=Algorithm.LEAKY_BUCKET, created_at=t)],
+        now_ms=t, home_shard=home,
+    )
+    assert r.remaining == 6
+    eng.sync(now_ms=t)
+    for home2 in range(8):
+        (r,) = eng.check(
+            [greq(key, hits=0, limit=10, algorithm=Algorithm.LEAKY_BUCKET,
+                  created_at=t)],
+            now_ms=t, home_shard=home2,
+        )
+        assert r.remaining == 6
+
+
+def test_zero_hit_global_not_queued(mesh, frozen_now):
+    # reference global.go:85-89: Hits == 0 is never queued
+    eng = GlobalShardedEngine(mesh, capacity_per_shard=1024, sync_out=64)
+    t = frozen_now
+    eng.check([greq("gk-z", hits=0, created_at=t)], now_ms=t, home_shard=1)
+    assert eng.global_stats.hits_queued == 0
+    assert eng.global_stats.send_queue_length == 0
+
+
+def test_mixed_global_and_plain(mesh, frozen_now):
+    eng = GlobalShardedEngine(mesh, capacity_per_shard=1024, sync_out=64)
+    t = frozen_now
+    out = eng.check(
+        [greq("gm1", created_at=t),
+         RateLimitRequest(name="glob", unique_key="plain1", hits=1, limit=7,
+                          duration=MINUTE, created_at=t),
+         greq("gm2", created_at=t)],
+        now_ms=t, home_shard=2,
+    )
+    assert out[0].remaining == 99
+    assert out[1].remaining == 6
+    assert out[2].remaining == 99
