@@ -1,0 +1,4 @@
+from gubernator_tpu.peers.hash_ring import ReplicatedConsistentHash
+from gubernator_tpu.peers.picker import RegionPicker
+
+__all__ = ["ReplicatedConsistentHash", "RegionPicker"]

@@ -1,0 +1,95 @@
+"""Replicated consistent hash ring — peer-level key ownership.
+
+The cluster analog of the reference's LocalPicker (reference
+replicated_hash.go:36-119): 512 virtual replicas per peer placed on a 32-bit
+ring; a key's owner is the first replica clockwise from the key's hash
+(binary search). The ring is rebuilt from scratch on every peer-set change
+(reference gubernator.go:694-746) — cheap and simple.
+
+Within a host, device-shard ownership uses fingerprint high bits
+(parallel/mesh.py); this ring decides which HOST owns a key across the
+cluster, exactly like the reference decides which node does.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Callable, Dict, List, Optional
+
+import xxhash
+
+from gubernator_tpu.types import PeerInfo
+
+DEFAULT_REPLICAS = 512  # reference replicated_hash.go:29
+
+
+def _hash32(data: bytes) -> int:
+    return xxhash.xxh32_intdigest(data)
+
+
+def fnv1a_32(data: bytes) -> int:
+    h = 0x811C9DC5
+    for b in data:
+        h = ((h ^ b) * 0x01000193) & 0xFFFFFFFF
+    return h
+
+
+def fnv1_32(data: bytes) -> int:
+    h = 0x811C9DC5
+    for b in data:
+        h = ((h * 0x01000193) & 0xFFFFFFFF) ^ b
+    return h
+
+
+HASH_FUNCTIONS: Dict[str, Callable[[bytes], int]] = {
+    # the reference offers fnv1a (default) and fnv1 (config.go:479-502)
+    "fnv1a": fnv1a_32,
+    "fnv1": fnv1_32,
+    "xxhash": _hash32,
+}
+
+
+class ReplicatedConsistentHash:
+    """Peer picker with virtual-replica consistent hashing."""
+
+    def __init__(
+        self,
+        hash_fn: Optional[Callable[[bytes], int]] = None,
+        replicas: int = DEFAULT_REPLICAS,
+    ):
+        self.hash_fn = hash_fn or fnv1a_32
+        self.replicas = replicas
+        self._peers: Dict[str, PeerInfo] = {}
+        self._ring: List[tuple] = []  # sorted (point, PeerInfo)
+
+    def peers(self) -> List[PeerInfo]:
+        return list(self._peers.values())
+
+    def add(self, peer: PeerInfo) -> None:
+        """Place `replicas` points for the peer; the replica key mixes the
+        replica index with an md5 of the address (reference
+        replicated_hash.go:78-91)."""
+        self._peers[peer.grpc_address] = peer
+        digest = hashlib.md5(peer.grpc_address.encode()).hexdigest()
+        for i in range(self.replicas):
+            point = self.hash_fn(f"{i}{digest}".encode())
+            self._ring.append((point, peer))
+        self._ring.sort(key=lambda t: t[0])
+
+    def get(self, key: str) -> PeerInfo:
+        """Owner of `key` — first ring point at or after hash(key), wrapping
+        (reference replicated_hash.go:104-119)."""
+        if not self._ring:
+            raise RuntimeError("unable to pick a peer; pool is empty")
+        point = self.hash_fn(key.encode())
+        idx = bisect.bisect_left(self._ring, (point,))
+        if idx == len(self._ring):
+            idx = 0
+        return self._ring[idx][1]
+
+    def size(self) -> int:
+        return len(self._peers)
+
+    def get_by_address(self, address: str) -> Optional[PeerInfo]:
+        return self._peers.get(address)

@@ -1,0 +1,19 @@
+"""Generated protobuf message modules (wire-compatible with the reference
+pb.gubernator package). Regenerate with:
+
+    cd gubernator_tpu/proto && protoc --python_out=. -I. gubernator.proto peers.proto
+"""
+
+import os
+import sys
+
+# protoc-generated modules use absolute imports (peers_pb2 imports
+# gubernator_pb2); make them resolvable from inside the package.
+_here = os.path.dirname(__file__)
+if _here not in sys.path:
+    sys.path.insert(0, _here)
+
+import gubernator_pb2  # noqa: E402
+import peers_pb2  # noqa: E402
+
+__all__ = ["gubernator_pb2", "peers_pb2"]
