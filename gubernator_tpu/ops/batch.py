@@ -20,6 +20,18 @@ from gubernator_tpu import gregorian
 from gubernator_tpu.hashing import fingerprint
 from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest, has_behavior
 
+# front-door bound on limit/burst: stored table fields are int32 carriers
+# (ops/table.py docstring); larger values get a per-request error instead of
+# silently saturating device state.
+INT32_MAX = 2**31 - 1
+# client-supplied created_at is accepted (reference gubernator.go:225-227 only
+# stamps when unset) but clamped to ingress now ± tolerance: the reference
+# checks item expiry against the *server* clock (lrucache.go GetItem), so an
+# arbitrarily skewed client timestamp must not be able to renew or expire live
+# buckets. Frozen-time tests pass an explicit now_ms and matching created_at,
+# which never clamps.
+CREATED_AT_TOLERANCE_MS = 5 * 60 * 1000
+
 
 class ReqBatch(NamedTuple):
     """All arrays shape (B,). Fingerprints must be unique among active rows."""
@@ -129,7 +141,17 @@ def pack_requests(
         if r.name == "":
             errors[i] = "field 'namespace' cannot be empty"
             continue
+        if not (-INT32_MAX <= r.limit <= INT32_MAX):
+            errors[i] = "field 'limit' must fit int32"
+            continue
+        if not (-INT32_MAX <= r.burst <= INT32_MAX):
+            errors[i] = "field 'burst' must fit int32"
+            continue
         created = r.created_at if r.created_at is not None and r.created_at != 0 else now_ms
+        if created > now_ms + CREATED_AT_TOLERANCE_MS:
+            created = now_ms + CREATED_AT_TOLERANCE_MS
+        elif created < now_ms - CREATED_AT_TOLERANCE_MS:
+            created = now_ms - CREATED_AT_TOLERANCE_MS
         b.fp[i] = fingerprint(r.name, r.unique_key)
         b.algo[i] = int(r.algorithm)
         b.behavior[i] = int(r.behavior)

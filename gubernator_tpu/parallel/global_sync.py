@@ -69,7 +69,10 @@ class GlobalStats:
 def _mk_sync_step(mesh, n_shards: int, out_size: int):
     """Build the jitted collective sync step."""
     D = n_shards
-    DROP_FP = jnp.int64(1) << 62
+    # sentinel OUTSIDE the fingerprint domain (real fps are in [1, 2^63-1],
+    # hashing.py): non-owned/inactive outbox rows sort into their own leading
+    # segment and can never merge with a real key's aggregation
+    DROP_FP = jnp.int64(-1)
     RESET = int(Behavior.RESET_REMAINING)
     DRAIN = int(Behavior.DRAIN_OVER_LIMIT)
 

@@ -157,9 +157,15 @@ class ShardedEngine:
         table, resp, stats = self._decide(getattr(self, table_attr), dev_batch)
         setattr(self, table_attr, table)
         self.stats.dispatches += 1
-        self.stats.accumulate(
-            jax.tree.map(lambda x: x.sum(), stats), count_dropped=False
-        )
+        if depth == 0:
+            # retries re-run rows the claim auction dropped; accumulating their
+            # hit/miss/over_limit again would double-count (cf. LocalEngine
+            # _dispatch_with_retry's retry accounting)
+            self.stats.accumulate(
+                jax.tree.map(lambda x: x.sum(), stats), count_dropped=False
+            )
+        else:
+            self.stats.evicted_unexpired += int(stats.evicted_unexpired.sum())
         # gather responses back: row i lives at (routed[order][i], offset[i])
         status = np.asarray(resp.status)[routed[order], offset_in_shard]
         limit = np.asarray(resp.limit)[routed[order], offset_in_shard]

@@ -112,3 +112,69 @@ def test_drain_over_limit_keeps_predrain_reset_time(frozen_now):
     assert r.remaining == 0
     # rate = 1000 ms/token; pre-drain remaining 5 → reset = t + (10-5)*1000
     assert r.reset_time == t + 5_000
+
+
+def test_oversized_limit_burst_rejected(frozen_now):
+    # table stores int32 carriers; the front door must reject larger values
+    # with a per-request error instead of silently saturating device state
+    eng = LocalEngine(capacity=256)
+    out = eng.check(
+        [
+            req("big", limit=2**31 + 1000),
+            RateLimitRequest(
+                name="t", unique_key="bb", hits=1, limit=10, burst=2**40,
+                duration=MINUTE, algorithm=Algorithm.LEAKY_BUCKET,
+            ),
+            req("fine", limit=2**31 - 1),
+        ],
+        now_ms=frozen_now,
+    )
+    assert out[0].error == "field 'limit' must fit int32"
+    assert out[1].error == "field 'burst' must fit int32"
+    assert out[2].error == "" and out[2].status == Status.UNDER_LIMIT
+
+
+def test_created_at_clamped_to_ingress_tolerance(frozen_now):
+    # a client-supplied created_at far in the future must not renew/expire
+    # live buckets (the reference checks expiry against the server clock,
+    # lrucache.go GetItem); deviations clamp to now ± tolerance
+    from gubernator_tpu.ops.batch import CREATED_AT_TOLERANCE_MS
+
+    b, errs = pack_requests(
+        [
+            RateLimitRequest(
+                name="t", unique_key="skew", hits=1, limit=10, duration=MINUTE,
+                created_at=frozen_now + 10 * CREATED_AT_TOLERANCE_MS,
+            ),
+            RateLimitRequest(
+                name="t", unique_key="stale", hits=1, limit=10, duration=MINUTE,
+                created_at=frozen_now - 10 * CREATED_AT_TOLERANCE_MS,
+            ),
+            RateLimitRequest(
+                name="t", unique_key="ok", hits=1, limit=10, duration=MINUTE,
+                created_at=frozen_now + 1000,
+            ),
+        ],
+        frozen_now,
+    )
+    assert errs == [None, None, None]
+    assert b.created_at[0] == frozen_now + CREATED_AT_TOLERANCE_MS
+    assert b.created_at[1] == frozen_now - CREATED_AT_TOLERANCE_MS
+    assert b.created_at[2] == frozen_now + 1000  # within tolerance: untouched
+
+
+def test_peers_package_imports():
+    # regression: peers/__init__ imported a module that didn't exist, leaving
+    # the whole subpackage dead on arrival
+    from gubernator_tpu.peers import RegionPicker, ReplicatedConsistentHash
+    from gubernator_tpu.types import PeerInfo
+
+    rp = RegionPicker()
+    rp.add(PeerInfo(grpc_address="10.0.0.1:81", data_center="dc-a"))
+    rp.add(PeerInfo(grpc_address="10.0.0.2:81", data_center="dc-a"))
+    rp.add(PeerInfo(grpc_address="10.0.1.1:81", data_center="dc-b"))
+    owners = rp.get_clients("some_key")
+    assert len(owners) == 2  # one owner per region
+    assert {o.data_center for o in owners} == {"dc-a", "dc-b"}
+    assert rp.get_by_address("10.0.1.1:81").data_center == "dc-b"
+    assert rp.size() == 3
