@@ -1,0 +1,94 @@
+"""decide2 perf on real TPU: seeded table, steady-state dispatch timing."""
+
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+
+import gubernator_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from gubernator_tpu.ops.batch import ReqBatch
+from gubernator_tpu.ops.kernel2 import decide2
+from gubernator_tpu.ops.table2 import new_table2
+from gubernator_tpu.types import Algorithm
+
+CAPACITY = 1 << 24
+LIVE_KEYS = 10_000_000
+BATCH = 1 << 17
+N_STAGED = 8
+
+
+def make_batches(rng, now, batch=BATCH):
+    keyspace = rng.integers(1, (1 << 63) - 1, size=LIVE_KEYS, dtype=np.int64)
+    perm = rng.permutation(LIVE_KEYS)
+    batches = []
+    zeros = np.zeros(batch, dtype=np.int64)
+    for i in range(N_STAGED):
+        fps = keyspace[perm[i * batch : (i + 1) * batch]]
+        rb = ReqBatch(
+            fp=jnp.asarray(fps),
+            algo=jnp.full(batch, int(Algorithm.TOKEN_BUCKET), dtype=jnp.int32),
+            behavior=jnp.zeros(batch, dtype=jnp.int32),
+            hits=jnp.ones(batch, dtype=jnp.int64),
+            limit=jnp.full(batch, 1000, dtype=jnp.int64),
+            burst=jnp.asarray(zeros),
+            duration=jnp.full(batch, 60_000, dtype=jnp.int64),
+            created_at=jnp.full(batch, now, dtype=jnp.int64),
+            expire_new=jnp.full(batch, now + 60_000, dtype=jnp.int64),
+            greg_interval=jnp.asarray(zeros),
+            duration_eff=jnp.full(batch, 60_000, dtype=jnp.int64),
+            active=jnp.ones(batch, dtype=bool),
+        )
+        batches.append(jax.device_put(rb))
+    return batches
+
+
+def main():
+    print(f"device: {jax.devices()[0]}", file=sys.stderr)
+    now = 1_700_000_000_000
+    rng = np.random.default_rng(42)
+    table = new_table2(CAPACITY)
+    print(f"table: {table.rows.shape} = {table.rows.size*4/2**30:.2f} GiB", file=sys.stderr)
+    batches = make_batches(rng, now)
+
+    t0 = time.perf_counter()
+    for i in range(3):
+        table, resp, stats = decide2(table, batches[i % N_STAGED], write="sweep")
+    _ = int(stats.cache_hits)
+    print(f"compile+warmup: {time.perf_counter()-t0:.1f}s", file=sys.stderr)
+
+    # seed all staged batches (≈1M live keys… seed full 10M via more batches)
+    seed_reps = LIVE_KEYS // (N_STAGED * BATCH) + 1
+    # reuse the 8 staged batches only — keys repeat, fine for perf measurement
+    for b in batches:
+        table, resp, stats = decide2(table, b, write="sweep")
+    _ = int(stats.cache_hits)
+
+    def run(n):
+        nonlocal table
+        t0 = time.perf_counter()
+        stats = None
+        for i in range(n):
+            table, resp, stats = decide2(table, batches[i % N_STAGED], write="sweep")
+        _ = int(stats.cache_hits)
+        return time.perf_counter() - t0
+
+    run(2)
+    ts = min(run(4) for _ in range(3))
+    tl = min(run(52) for _ in range(3))
+    dt = tl - ts
+    dps = 48 * BATCH / dt
+    print(
+        f"steady state: 48 x {BATCH} in {dt:.3f}s = {dps/1e6:.2f}M decisions/s "
+        f"({dt/48*1e3:.2f} ms/dispatch)", file=sys.stderr,
+    )
+    print(f"hits={int(stats.cache_hits)} miss={int(stats.cache_misses)} dropped={int(stats.dropped)}", file=sys.stderr)
+    print(f"vs per-chip baseline (6.25M/s): {dps/6.25e6:.2f}x", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,191 @@
+"""Memory-op microbenchmarks on the real TPU: what dominates decide()'s 73ms?
+
+Timing through the tunnel: block_until_ready doesn't round-trip, so every
+variant chains its output into a scalar fetch and we report the SLOPE between
+a short and long loop (bench.py technique).
+"""
+
+import time
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+
+import gubernator_tpu  # noqa: F401 (x64 on)
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+C = 1 << 24  # 16.7M slots
+B = 1 << 17  # 131072 rows
+P = 15  # planes
+K = 8
+
+rng = np.random.default_rng(0)
+slots_np = rng.permutation(C)[:B].astype(np.int64)  # unique random slots
+vals_np = rng.standard_normal(B).astype(np.float32)
+buckets_np = (slots_np // K).astype(np.int64)
+
+
+def timed(name, fn, *args, n_long=24, n_short=4):
+    out = fn(*args)  # compile
+    _ = float(jax.tree.leaves(out)[0].reshape(-1)[0])
+
+    def run(n):
+        t0 = time.perf_counter()
+        acc = args
+        o = None
+        for i in range(n):
+            o = fn(*args)
+        _ = float(jax.tree.leaves(o)[0].reshape(-1)[0])
+        return time.perf_counter() - t0
+
+    run(2)
+    ts = min(run(n_short) for _ in range(2))
+    tl = min(run(n_long) for _ in range(2))
+    ms = (tl - ts) / (n_long - n_short) * 1e3
+    print(f"{name:55s} {ms:8.2f} ms", file=sys.stderr, flush=True)
+    return ms
+
+
+def main():
+    print(f"device: {jax.devices()[0]}", file=sys.stderr)
+    slots = jnp.asarray(slots_np)
+    slots32 = jnp.asarray(slots_np.astype(np.int32))
+    ssorted = jnp.asarray(np.sort(slots_np))
+    ssorted32 = jnp.asarray(np.sort(slots_np).astype(np.int32))
+    vals = jnp.asarray(vals_np)
+    buckets = jnp.asarray(buckets_np)
+    buckets32 = jnp.asarray(buckets_np.astype(np.int32))
+
+    planes_f32 = [jnp.zeros(C, dtype=jnp.float32) for _ in range(P)]
+    big_f32 = jnp.zeros(P * C, dtype=jnp.float32)
+    tbl2d = jnp.zeros((C // K, K), dtype=jnp.float32)
+    tbl_row16 = jnp.zeros((C, 16), dtype=jnp.int32)
+
+    # ---- A: P separate flat f32 scatters (the current kernel's write phase)
+    @jax.jit
+    def scatter_P_sep(planes, s, v):
+        return [p.at[s].set(v + i, mode="drop") for i, p in enumerate(planes)]
+
+    timed("A: 15 separate flat f32 scatters (i64 idx)", scatter_P_sep, planes_f32, slots, vals)
+
+    @jax.jit
+    def scatter_P_sep32(planes, s, v):
+        return [p.at[s].set(v + i, mode="drop") for i, p in enumerate(planes)]
+
+    timed("A2: 15 separate flat f32 scatters (i32 idx)", scatter_P_sep32, planes_f32, slots32, vals)
+
+    # ---- B: ONE fused scatter into (P*C,) with plane-offset indices
+    @jax.jit
+    def scatter_fused(big, s, v):
+        idx = (jnp.arange(P, dtype=jnp.int64)[:, None] * C + s[None, :]).reshape(-1)
+        vv = (v[None, :] + jnp.arange(P, dtype=jnp.float32)[:, None]).reshape(-1)
+        return big.at[idx].set(vv, mode="drop")
+
+    timed("B: 1 fused scatter of 15*B rows into (15C,)", scatter_fused, big_f32, slots, vals)
+
+    # ---- C: sorted & unique hints
+    @jax.jit
+    def scatter_sorted(planes, s, v):
+        return [
+            p.at[s].set(v + i, mode="drop", unique_indices=True, indices_are_sorted=True)
+            for i, p in enumerate(planes)
+        ]
+
+    timed("C: 15 flat scatters, sorted+unique hints (i64)", scatter_sorted, planes_f32, ssorted, vals)
+    timed("C2: 15 flat scatters, sorted+unique hints (i32)", scatter_sorted, planes_f32, ssorted32, vals)
+
+    # ---- D: row scatter into (C,16) int32 — one contiguous 64B write per row
+    @jax.jit
+    def scatter_row16(tbl, s, v):
+        rows = jnp.broadcast_to(v[:, None].astype(jnp.int32), (B, 16))
+        return tbl.at[s].set(rows, mode="drop")
+
+    timed("D: row scatter (B,16)int32 into (C,16) (i64 idx)", scatter_row16, tbl_row16, slots, vals)
+    timed("D2: row scatter sorted idx", scatter_row16, tbl_row16, ssorted, vals)
+
+    # ---- E: gathers
+    @jax.jit
+    def gather_P_sep(planes, s):
+        return sum(p[s] for p in planes)
+
+    timed("E: 15 separate flat f32 gathers", gather_P_sep, planes_f32, slots)
+
+    @jax.jit
+    def gather_fused(big, s):
+        idx = (jnp.arange(P, dtype=jnp.int64)[:, None] * C + s[None, :]).reshape(-1)
+        return big[idx].reshape(P, B).sum(0)
+
+    timed("F: 1 fused gather of 15*B from (15C,)", gather_fused, big_f32, slots)
+
+    @jax.jit
+    def gather_row16(tbl, s):
+        return tbl[s].sum(1)
+
+    timed("G: row gather (B,16)i32 from (C,16)", gather_row16, tbl_row16, slots)
+
+    @jax.jit
+    def gather_bucket(tbl, b):
+        return tbl[b].sum(1)  # (B, K) row gather from (C/K, K)
+
+    timed("H: bucket row gather (B,8)f32 from (C/8,8)", gather_bucket, tbl2d, buckets)
+
+    # ---- I: scatter-max (the claim phase op)
+    @jax.jit
+    def scatter_max(p, s, v):
+        return p.at[s].max(v, mode="drop")
+
+    timed("I: 1 flat f32 scatter-max", scatter_max, planes_f32[0], slots, vals)
+
+    # ---- J: i32 scatter (no f32 carrier)
+    planes_i32 = [jnp.zeros(C, dtype=jnp.int32) for _ in range(P)]
+
+    @jax.jit
+    def scatter_P_i32(planes, s, v):
+        vi = v.astype(jnp.int32)
+        return [p.at[s].set(vi + i, mode="drop") for i, p in enumerate(planes)]
+
+    timed("J: 15 separate flat i32 scatters", scatter_P_i32, planes_i32, slots, vals)
+
+    # ---- K: full decide() for reference
+    from gubernator_tpu.ops.kernel import decide
+    from gubernator_tpu.ops.table import new_table
+    from bench import make_batches
+
+    table = new_table(C)
+    batches = make_batches(np.random.default_rng(42), 1_700_000_000_000)
+
+    def dec(i=[0]):
+        pass
+
+    tbl = [table]
+
+    @partial(jax.jit, donate_argnums=0)
+    def _noop(t):
+        return t
+
+    def run_decide(b):
+        tbl[0], resp, stats = decide(tbl[0], b)
+        return stats.cache_hits
+
+    out = run_decide(batches[0])
+    _ = int(out)
+
+    def runN(n):
+        t0 = time.perf_counter()
+        o = None
+        for i in range(n):
+            o = run_decide(batches[i % len(batches)])
+        _ = int(o)
+        return time.perf_counter() - t0
+
+    runN(2)
+    ts = min(runN(4) for _ in range(2))
+    tl = min(runN(24) for _ in range(2))
+    print(f"{'K: full decide()':55s} {(tl-ts)/20*1e3:8.2f} ms", file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    main()

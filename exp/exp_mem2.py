@@ -1,0 +1,230 @@
+"""Round 2 microbenchmarks: validate the decide_v2 design on real TPU.
+
+1. (B,128) row gather from (NB,128) i32/f32 — the fused probe+apply fetch
+2. XLA sort of B i64 keys (+payload) — the claim-by-rank prerequisite
+3. Pallas sweep skeleton: DMA-only pass over the whole (NB,128) table
+4. Pallas sweep with int8 one-hot matmul scatter of updates
+"""
+
+import time
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+
+import gubernator_tpu  # noqa: F401 (x64 on)
+import jax
+import jax.numpy as jnp
+from functools import partial
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NB = 1 << 21  # 2M buckets (= 16.7M slots at K=8)
+ROW = 128  # 8 slots x 16 fields
+B = 1 << 17
+
+rng = np.random.default_rng(0)
+buckets_np = rng.integers(0, NB, size=B).astype(np.int64)
+
+
+def timed(name, fn, *args, n_long=24, n_short=4):
+    out = fn(*args)
+    _ = np.asarray(jax.tree.leaves(out)[0].reshape(-1)[0])
+
+    def run(n):
+        t0 = time.perf_counter()
+        o = None
+        for i in range(n):
+            o = fn(*args)
+        _ = np.asarray(jax.tree.leaves(o)[0].reshape(-1)[0])
+        return time.perf_counter() - t0
+
+    run(2)
+    ts = min(run(n_short) for _ in range(2))
+    tl = min(run(n_long) for _ in range(2))
+    ms = (tl - ts) / (n_long - n_short) * 1e3
+    print(f"{name:55s} {ms:8.2f} ms", file=sys.stderr, flush=True)
+    return ms
+
+
+def main():
+    print(f"device: {jax.devices()[0]}", file=sys.stderr)
+    tbl_i32 = jnp.zeros((NB, ROW), dtype=jnp.int32)
+    tbl_f32 = jnp.zeros((NB, ROW), dtype=jnp.float32)
+    buckets = jnp.asarray(buckets_np)
+    buckets32 = jnp.asarray(buckets_np.astype(np.int32))
+    keys = jnp.asarray(rng.integers(1, 1 << 62, size=B, dtype=np.int64))
+    keys32pair = (jnp.asarray(buckets_np.astype(np.int32)), jnp.asarray(np.arange(B, dtype=np.int32)))
+
+    @jax.jit
+    def g_i32(t, b):
+        return t[b]
+
+    timed("G1: (B,128) i32 row gather from (2M,128)", g_i32, tbl_i32, buckets)
+    timed("G2: (B,128) f32 row gather from (2M,128)", g_i32, tbl_f32, buckets)
+    timed("G3: same, i32 idx", g_i32, tbl_i32, buckets32)
+
+    @jax.jit
+    def g_take(t, b):
+        return jnp.take(t, b, axis=0)
+
+    timed("G4: jnp.take rows", g_take, tbl_i32, buckets)
+
+    # sort experiments
+    @jax.jit
+    def sort_i64(k):
+        return jnp.sort(k)
+
+    timed("S1: sort B i64 keys", sort_i64, keys)
+
+    @jax.jit
+    def argsort_i64(k):
+        return jnp.argsort(k)
+
+    timed("S2: argsort B i64 keys", argsort_i64, keys)
+
+    @jax.jit
+    def sort_pair32(kv):
+        k, v = kv
+        return jax.lax.sort((k, v), num_keys=1)
+
+    timed("S3: lax.sort (i32 key, i32 payload)", sort_pair32, keys32pair)
+
+    with jax.enable_x64(False):
+        # pallas sweep skeleton: copy table through VMEM, blockwise
+        BLK = 2048  # bucket rows per block → (2048, 128) i32 = 1MB
+
+        def copy_kernel(in_ref, out_ref):
+            out_ref[:] = in_ref[:]
+
+        @jax.jit
+        def sweep_copy(t):
+            return pl.pallas_call(
+                copy_kernel,
+                out_shape=jax.ShapeDtypeStruct(t.shape, t.dtype),
+                grid=(NB // BLK,),
+                in_specs=[pl.BlockSpec((BLK, ROW), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((BLK, ROW), lambda i: (i, 0)),
+            )(t)
+
+        timed("P1: pallas sweep copy (2M,128) i32 blocks=1MB", sweep_copy, tbl_i32)
+
+        # pallas sweep + int8 one-hot matmul scatter
+        U = 64  # updates per block window
+
+        upd_rows = jnp.zeros((NB // BLK * U, ROW), dtype=jnp.int32)  # payload rows
+        upd_mask = jnp.zeros((NB // BLK * U, ROW), dtype=jnp.int8)  # lane masks
+        upd_bucket = jnp.tile(jnp.arange(U, dtype=jnp.int32), NB // BLK)  # local bucket ids
+
+        def scat_kernel(rows_ref, mask_ref, bkt_ref, in_ref, out_ref):
+            blk = in_ref[:]  # (BLK, ROW) i32
+            rows = rows_ref[:]  # (U, ROW) i32
+            mask = mask_ref[:]  # (U, ROW) i8
+            bkt = bkt_ref[:]  # (U, 1) i32 local bucket row of each update
+            U_loc = rows.shape[0]
+            # one-hot (BLK, U) int8
+            iot = jax.lax.broadcasted_iota(jnp.int32, (BLK, U_loc), 0)
+            onehot = (iot == bkt[:, 0][None, :]).astype(jnp.int8)
+            # mask matmul: which (row, lane) positions are written
+            written = jax.lax.dot_general(
+                onehot, mask, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32
+            )
+            # payload: 4x i8 planes matmul
+            acc = []
+            for s in range(4):
+                plane = ((rows >> (8 * s)) & 0xFF).astype(jnp.int8)
+                p = jax.lax.dot_general(
+                    onehot, plane, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32
+                )
+                acc.append(p << (8 * s))
+            scat = acc[0] | acc[1] | acc[2] | acc[3]
+            out_ref[:] = jnp.where(written > 0, scat, blk)
+
+        @jax.jit
+        def sweep_scatter(t, rows, mask, bkt):
+            return pl.pallas_call(
+                scat_kernel,
+                out_shape=jax.ShapeDtypeStruct(t.shape, t.dtype),
+                grid=(NB // BLK,),
+                in_specs=[
+                    pl.BlockSpec((U, ROW), lambda i: (i, 0)),
+                    pl.BlockSpec((U, ROW), lambda i: (i, 0)),
+                    pl.BlockSpec((U, 1), lambda i: (i, 0)),
+                    pl.BlockSpec((BLK, ROW), lambda i: (i, 0)),
+                ],
+                out_specs=pl.BlockSpec((BLK, ROW), lambda i: (i, 0)),
+            )(rows, mask, bkt.reshape(-1, 1), t)
+
+        timed(
+            "P2: pallas sweep + i8 onehot matmul scatter U=64",
+            sweep_scatter, tbl_i32, upd_rows, upd_mask, upd_bucket,
+        )
+
+        # P3: same with U=16 (B=131k over 1024 blocks → avg 16/2048-bucket block... actually 131k/1024=128)
+        # try BLK=2048, U=128: matches B=131k uniform on 2M buckets → 131k/1024 blocks = 128/blk
+        U2 = 128
+        upd_rows2 = jnp.zeros((NB // BLK * U2, ROW), dtype=jnp.int32)
+        upd_mask2 = jnp.zeros((NB // BLK * U2, ROW), dtype=jnp.int8)
+        upd_bucket2 = jnp.tile(jnp.arange(U2, dtype=jnp.int32), NB // BLK)
+
+        def scat_kernel2(rows_ref, mask_ref, bkt_ref, in_ref, out_ref):
+            scat_kernel(rows_ref, mask_ref, bkt_ref, in_ref, out_ref)
+
+        @jax.jit
+        def sweep_scatter2(t, rows, mask, bkt):
+            return pl.pallas_call(
+                scat_kernel2,
+                out_shape=jax.ShapeDtypeStruct(t.shape, t.dtype),
+                grid=(NB // BLK,),
+                in_specs=[
+                    pl.BlockSpec((U2, ROW), lambda i: (i, 0)),
+                    pl.BlockSpec((U2, ROW), lambda i: (i, 0)),
+                    pl.BlockSpec((U2, 1), lambda i: (i, 0)),
+                    pl.BlockSpec((BLK, ROW), lambda i: (i, 0)),
+                ],
+                out_specs=pl.BlockSpec((BLK, ROW), lambda i: (i, 0)),
+            )(rows, mask, bkt.reshape(-1, 1), t)
+
+        timed(
+            "P3: pallas sweep + i8 onehot matmul scatter U=128",
+            sweep_scatter2, tbl_i32, upd_rows2, upd_mask2, upd_bucket2,
+        )
+
+        # P4: input_output_aliasing (donate table) — avoids one allocation
+        @partial(jax.jit, donate_argnums=0)
+        def sweep_scatter_alias(t, rows, mask, bkt):
+            return pl.pallas_call(
+                scat_kernel,
+                out_shape=jax.ShapeDtypeStruct(t.shape, t.dtype),
+                grid=(NB // BLK,),
+                in_specs=[
+                    pl.BlockSpec((U, ROW), lambda i: (i, 0)),
+                    pl.BlockSpec((U, ROW), lambda i: (i, 0)),
+                    pl.BlockSpec((U, 1), lambda i: (i, 0)),
+                    pl.BlockSpec((BLK, ROW), lambda i: (i, 0)),
+                ],
+                out_specs=pl.BlockSpec((BLK, ROW), lambda i: (i, 0)),
+                input_output_aliases={3: 0},
+            )(rows, mask, bkt.reshape(-1, 1), t)
+
+        t_alias = jnp.zeros((NB, ROW), dtype=jnp.int32)
+        out = sweep_scatter_alias(t_alias, upd_rows, upd_mask, upd_bucket)
+        _ = np.asarray(out[0, 0])
+
+        def runA(n):
+            nonlocal out
+            t0 = time.perf_counter()
+            for i in range(n):
+                out = sweep_scatter_alias(out, upd_rows, upd_mask, upd_bucket)
+            _ = np.asarray(out[0, 0])
+            return time.perf_counter() - t0
+
+        runA(2)
+        ts = min(runA(4) for _ in range(2))
+        tl = min(runA(24) for _ in range(2))
+        print(f"{'P4: sweep scatter U=64 + io alias (donated)':55s} {(tl-ts)/20*1e3:8.2f} ms", file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -17,11 +17,20 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+import jax
+
 from gubernator_tpu.ops.batch import HostBatch, pack_requests, pad_batch, to_device
 from gubernator_tpu.ops.kernel import decide
+from gubernator_tpu.ops.kernel2 import decide2
 from gubernator_tpu.ops.plan import plan_passes
 from gubernator_tpu.ops.table import Table, new_table
+from gubernator_tpu.ops.table2 import new_table2
 from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
+
+
+def default_write_mode() -> str:
+    """Pallas sweep write on real TPU; XLA scatter on CPU (test meshes)."""
+    return "xla" if jax.default_backend() == "cpu" else "sweep"
 
 
 def ms_now() -> int:
@@ -60,13 +69,32 @@ class EngineStats:
 class LocalEngine:
     """One device-resident rate-limit table + its dispatch loop."""
 
-    def __init__(self, capacity: int = 50_000, probes: int = 8, max_exact_passes: int = 8):
-        # `probes` is the bucket width K (the probe-window analog)
-        self.table: Table = new_table(capacity, k=probes)
+    def __init__(
+        self,
+        capacity: int = 50_000,
+        probes: int = 8,
+        max_exact_passes: int = 8,
+        kernel: int = 2,
+        write_mode: Optional[str] = None,
+    ):
+        # `probes` is the bucket width K (the probe-window analog); the v2
+        # packed-row table is fixed at K=8 (one bucket per 128-lane row)
+        self.kernel = kernel
+        if kernel == 2:
+            self.table = new_table2(capacity)
+            self.write_mode = write_mode or default_write_mode()
+        else:
+            self.table = new_table(capacity, k=probes)
+            self.write_mode = "planes"
         self.probes = probes
         self.max_exact_passes = max_exact_passes
         self.max_claim_retries = 3
         self.stats = EngineStats()
+
+    def _decide(self, rb):
+        if self.kernel == 2:
+            return decide2(self.table, rb, write=self.write_mode)
+        return decide(self.table, rb)
 
     def check(
         self,
@@ -109,7 +137,7 @@ class LocalEngine:
         bucket within a single dispatch) are re-dispatched — the decision is
         only authoritative once persisted."""
         rb = to_device(batch)
-        self.table, resp, stats = decide(self.table, rb)
+        self.table, resp, stats = self._decide(rb)
         self.stats.accumulate(stats, count_dropped=False)
         self.stats.dispatches += 1
         status = np.asarray(resp.status)[:n].copy()
@@ -123,7 +151,7 @@ class LocalEngine:
             sub = HostBatch(*[f[:n][rows] for f in batch])
             sub = pad_batch(sub, _pad_size(len(rows)))
             rb = to_device(sub)
-            self.table, resp, stats = decide(self.table, rb)
+            self.table, resp, stats = self._decide(rb)
             self.stats.dispatches += 1
             self.stats.evicted_unexpired += int(stats.evicted_unexpired)
             m = len(rows)

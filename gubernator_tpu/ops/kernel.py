@@ -63,6 +63,7 @@ import jax
 import jax.numpy as jnp
 
 from gubernator_tpu.ops.batch import BatchStats, ReqBatch, RespBatch
+from gubernator_tpu.ops.math import StoredState, bucket_math
 from gubernator_tpu.ops.table import EXPC_SHIFT, Table
 from gubernator_tpu.types import Algorithm, Behavior, Status
 
@@ -216,160 +217,26 @@ def decide_impl(table: Table, req: ReqBatch) -> Tuple[Table, RespBatch, BatchSta
     # gathered the victim's state at `slot` before overwriting it
     evicted_unexpired = won_evict & (s_exp >= now)
 
-    is_greg = (req.behavior & int(Behavior.DURATION_IS_GREGORIAN)) != 0
-    is_reset = (req.behavior & int(Behavior.RESET_REMAINING)) != 0
-    is_drain = (req.behavior & int(Behavior.DRAIN_OVER_LIMIT)) != 0
-    is_token = req.algo == int(Algorithm.TOKEN_BUCKET)
-    h = req.hits
-
-    # Existing-item path applies only when algorithms agree; a stored item of
-    # the other algorithm is discarded and recreated ("client switched
-    # algorithms", reference algorithms.go:96-105,307-317).
-    algo_match = exists & (s_algo == req.algo)
-
-    # ==================================================== token bucket
-    # reference algorithms.go:37-252
+    # branchless decision table (shared with kernel2) — ops/math.py
+    d = bucket_math(
+        StoredState(
+            limit=s_limit, burst=s_burst, rem_i=s_rem_i, algo=s_algo,
+            status=s_status, duration=s_duration, stamp=s_stamp, exp=s_exp,
+            rem_f=s_rem_f,
+        ),
+        req,
+        exists,
+    )
+    rem_i_out, rem_f_out = d.rem_i_out, d.rem_f_out
+    stamp_out, dur_out, exp_out = d.stamp_out, d.dur_out, d.exp_out
+    burst_out, flags_out = d.burst_out, d.flags_out
     OVER = jnp.int32(int(Status.OVER_LIMIT))
     UNDER = jnp.int32(int(Status.UNDER_LIMIT))
 
-    # --- existing item (algorithms.go:107-194)
-    # limit change: add the delta to remaining, clamp at 0 (go:108-115)
-    t_rem = jnp.where(
-        s_limit != req.limit, jnp.maximum(s_rem_i + req.limit - s_limit, 0), s_rem_i
-    )
-    # duration change (go:125-146): recompute expiry from the item's CreatedAt;
-    # if that would place us already expired, renew the bucket.
-    dur_changed = s_duration != req.duration
-    expire_dc = jnp.where(is_greg, req.expire_new, s_stamp + req.duration)
-    renew = dur_changed & (expire_dc <= now)
-    expire_dc = jnp.where(renew, now + req.duration, expire_dc)
-    t_created = jnp.where(renew, now, s_stamp)
-    t_rem = jnp.where(renew, req.limit, t_rem)
-    t_exp = jnp.where(dur_changed, expire_dc, s_exp)
-    t_reset = t_exp
-
-    zero_hits = h == 0
-    at_limit = (t_rem == 0) & (h > 0)  # go:161-168
-    exact = ~zero_hits & ~at_limit & (t_rem == h)  # go:171-175
-    overask = ~zero_hits & ~at_limit & ~exact & (h > t_rem)  # go:179-190
-    consume = ~zero_hits & ~at_limit & ~exact & ~overask  # go:192-194
-
-    tok_rem_out = jnp.where(
-        exact | (overask & is_drain), i64(0), jnp.where(consume, t_rem - h, t_rem)
-    )
-    # response status starts from the stored (sticky) status (go:117-122); only
-    # the at-limit branch persists OVER back to the item (go:165-166).
-    tok_resp_status = jnp.where(at_limit | overask, OVER, s_status)
-    tok_stored_status = jnp.where(at_limit, OVER, s_status)
-    tok_resp_rem = tok_rem_out
-    tok_resp_reset = t_reset
-
-    # --- new item (algorithms.go:202-252)
-    new_over = h > req.limit
-    tokn_rem = jnp.where(new_over, req.limit, req.limit - h)
-    tokn_status = jnp.where(new_over, OVER, UNDER)
-    tokn_exp = req.expire_new
-
-    tok_is_new = ~algo_match
-    tok_status_out = jnp.where(tok_is_new, UNDER, tok_stored_status)
-    tok_rem_store = jnp.where(tok_is_new, tokn_rem, tok_rem_out)
-    tok_created_out = jnp.where(tok_is_new, now, t_created)
-    tok_exp_out = jnp.where(tok_is_new, tokn_exp, t_exp)
-    tok_resp_status = jnp.where(tok_is_new, tokn_status, tok_resp_status)
-    tok_resp_rem = jnp.where(tok_is_new, tokn_rem, tok_resp_rem)
-    tok_resp_reset = jnp.where(tok_is_new, tokn_exp, tok_resp_reset)
-
-    # RESET_REMAINING on an existing item removes it outright and reports a
-    # full bucket (go:82-94) — modeled as writing back an empty slot.
-    tok_reset_rm = exists & is_reset
-    tok_resp_status = jnp.where(tok_reset_rm, UNDER, tok_resp_status)
-    tok_resp_rem = jnp.where(tok_reset_rm, req.limit, tok_resp_rem)
-    tok_resp_reset = jnp.where(tok_reset_rm, i64(0), tok_resp_reset)
-
-    # ==================================================== leaky bucket
-    # reference algorithms.go:255-492. Remaining is float64 (store.go:32);
-    # comparisons truncate toward zero exactly like Go's int64(float64).
-    lk_is_new = ~algo_match
-    rate = jnp.where(is_greg, req.greg_interval, req.duration).astype(
-        f64
-    ) / jnp.maximum(req.limit, 1).astype(f64)
-    irate = rate.astype(i64)
-
-    # --- existing item (go:304-430)
-    b_rem = jnp.where(is_reset, s_burst.astype(f64), s_rem_f)  # go:319-321
-    burst_changed = s_burst != req.burst
-    b_rem = jnp.where(  # go:324-329
-        burst_changed & (req.burst > b_rem.astype(i64)), req.burst.astype(f64), b_rem
-    )
-    # leak since UpdatedAt; only applied once a whole token has leaked
-    # (go:359-366: `if int64(leak) > 0`)
-    elapsed = (now - s_stamp).astype(f64)
-    leak = elapsed / rate
-    leak_applies = leak.astype(i64) > 0
-    b_rem = jnp.where(leak_applies, b_rem + leak, b_rem)
-    lk_stamp = jnp.where(leak_applies, now, s_stamp)
-    # clamp to burst (go:368-370)
-    b_rem = jnp.where(b_rem.astype(i64) > req.burst, req.burst.astype(f64), b_rem)
-
-    lk_rem_now = b_rem.astype(i64)
-    lk_at_limit = (lk_rem_now == 0) & (h > 0)  # go:388-394
-    lk_exact = ~lk_at_limit & (lk_rem_now == h)  # go:397-402 (catches h==0,rem==0)
-    lk_overask = ~lk_at_limit & ~lk_exact & (h > lk_rem_now)  # go:406-419
-    lk_zero = ~lk_at_limit & ~lk_exact & ~lk_overask & (h == 0)  # go:422-424
-    lk_consume = ~lk_at_limit & ~lk_exact & ~lk_overask & ~lk_zero
-
-    lk_rem_out = jnp.where(
-        lk_exact | (lk_overask & is_drain),
-        f64(0.0),
-        jnp.where(lk_consume, b_rem - h.astype(f64), b_rem),
-    )
-    lk_resp_status = jnp.where(lk_at_limit | lk_overask, OVER, UNDER)
-    lk_resp_rem = jnp.where(lk_overask & ~is_drain, lk_rem_now, lk_rem_out.astype(i64))
-    # reset_time is computed from the PRE-hit remaining (go:372-377) and only
-    # recomputed by the exact/consume branches (go:400,428) — a DRAIN_OVER_LIMIT
-    # rejection keeps the pre-drain reset_time.
-    lk_reset_basis = jnp.where(
-        lk_exact, i64(0), jnp.where(lk_consume, lk_rem_out.astype(i64), lk_rem_now)
-    )
-    lk_resp_reset = now + (req.limit - lk_reset_basis) * irate
-    # hits≠0 refreshes expiry before any verdict (go:355-357)
-    lk_exp = jnp.where(h != 0, now + req.duration_eff, s_exp)
-
-    # --- new item (go:436-492)
-    lkn_over = h > req.burst
-    lkn_rem = jnp.where(lkn_over, f64(0.0), (req.burst - h).astype(f64))
-    lkn_resp_rem = jnp.where(lkn_over, i64(0), req.burst - h)
-    lkn_status = jnp.where(lkn_over, OVER, UNDER)
-    lkn_reset = now + (req.limit - lkn_resp_rem) * irate
-    lkn_exp = now + req.duration_eff
-
-    lk_rem_store = jnp.where(lk_is_new, lkn_rem, lk_rem_out)
-    lk_stamp_out = jnp.where(lk_is_new, now, lk_stamp)
-    lk_exp_out = jnp.where(lk_is_new, lkn_exp, lk_exp)
-    # stored duration: new items persist the effective (Gregorian-resolved)
-    # duration (go:452-458); existing items persist the raw request duration
-    # (go:332).
-    lk_dur_out = jnp.where(lk_is_new, req.duration_eff, req.duration)
-    lk_resp_status = jnp.where(lk_is_new, lkn_status, lk_resp_status)
-    lk_resp_rem = jnp.where(lk_is_new, lkn_resp_rem, lk_resp_rem)
-    lk_resp_reset = jnp.where(lk_is_new, lkn_reset, lk_resp_reset)
-
-    # ==================================================== merge + write
-    status_out = jnp.where(is_token, tok_status_out, UNDER)
-    rem_i_out = jnp.where(is_token, tok_rem_store, i64(0))
-    rem_f_out = jnp.where(is_token, f64(0.0), lk_rem_store)
-    stamp_out = jnp.where(is_token, tok_created_out, lk_stamp_out)
-    dur_out = jnp.where(is_token, req.duration, lk_dur_out)
-    exp_out = jnp.where(is_token, tok_exp_out, lk_exp_out)
-    burst_out = jnp.where(is_token, i64(0), req.burst)
-    flags_out = req.algo | (status_out << 8)
-
     # token RESET_REMAINING removes the item: write back an empty slot
-    fp_lo_out = jnp.where(tok_reset_rm & is_token, 0, my_lo)
-    fp_hi_out = jnp.where(tok_reset_rm & is_token, 0, my_hi)
-    expc_out = jnp.where(
-        tok_reset_rm & is_token, 0, (exp_out >> EXPC_SHIFT).astype(i32)
-    )
+    fp_lo_out = jnp.where(d.remove, 0, my_lo)
+    fp_hi_out = jnp.where(d.remove, 0, my_hi)
+    expc_out = jnp.where(d.remove, 0, (exp_out >> EXPC_SHIFT).astype(i32))
 
     w = jnp.where(active & resolved, slot, DROPC)
     sat32 = lambda x: jnp.clip(x, -(2**31), 2**31 - 1).astype(i32)
@@ -394,15 +261,11 @@ def decide_impl(table: Table, req: ReqBatch) -> Tuple[Table, RespBatch, BatchSta
         remf_lo=put(table.remf_lo, remf_lo_out),
     )
 
-    resp_status = jnp.where(is_token, tok_resp_status, lk_resp_status)
-    resp_rem = jnp.where(is_token, tok_resp_rem, lk_resp_rem)
-    resp_reset = jnp.where(is_token, tok_resp_reset, lk_resp_reset)
-
     resp = RespBatch(
-        status=jnp.where(active, resp_status, UNDER),
+        status=jnp.where(active, d.resp_status, UNDER),
         limit=jnp.where(active, req.limit, i64(0)),
-        remaining=jnp.where(active, resp_rem, i64(0)),
-        reset_time=jnp.where(active, resp_reset, i64(0)),
+        remaining=jnp.where(active, d.resp_rem, i64(0)),
+        reset_time=jnp.where(active, d.resp_reset, i64(0)),
         cache_hit=exists,
         dropped=dropped,
     )

@@ -1,0 +1,476 @@
+"""Decision kernel v2: packed-row table, sort-based claim, Pallas sweep write.
+
+Replaces the v1 kernel's memory strategy (ops/kernel.py — 15 f32-carrier plane
+scatters + 12 flat gathers + a multi-round scatter-max claim auction, ~74 ms
+per 131K-row dispatch on v5e) with the design measured fastest on real TPU
+(exp/exp_mem*.py):
+
+  1. **fetch** — ONE (B, 128) row gather brings each request's whole bucket
+     (all 8 slots, full state) into registers: ~1.3 ms.
+  2. **claim** — pure vector math, no device auction: requests are sorted by
+     bucket (lax.sort of int32 operands, ~0.1 ms); each inserting row takes a
+     rank among its bucket's inserters via segmented prefix sums, and rank r
+     picks the r-th lane in (vacant-first, then soonest-expiring) order.
+     Insert-vs-owner lane collisions are resolved by a second sort over target
+     slots (owners win; losers are answered but flagged dropped → the engine
+     retries them, cf. v1's auction losers).
+  3. **apply** — the shared branchless decision table (ops/math.py) on the
+     chosen lane's state.
+  4. **write** — the update set becomes (payload, lane-mask) rows composed into
+     bucket rows by a **Pallas sweep**: the table streams through VMEM in
+     (BLK, 128) blocks while int8 one-hot matmuls on the MXU scatter each
+     block's updates into place (~3.3 ms for a 1 GB table — the DMA fully hides
+     the matmuls). XLA scatter fallback (`write="xla"`) keeps identical
+     semantics for CPU meshes/tests.
+
+Same decision semantics as v1 (reference algorithms.go:37-492 via
+ops/math.py). Documented divergence from v1: slot-vacancy uses the exact
+millisecond expiry (the whole bucket is already in registers) instead of v1's
+conservative coarse-expiry probe plane, and a burst of inserts into one full
+bucket may evict several soonest-expiring lanes at once (v1 evicted at most
+one per dispatch round; the reference's LRU evicts as many as needed,
+lrucache.go:138-149).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from gubernator_tpu.ops.batch import BatchStats, ReqBatch, RespBatch
+from gubernator_tpu.ops.math import StoredState, bucket_math
+from gubernator_tpu.ops.table2 import (
+    BURST,
+    DUR_HI,
+    DUR_LO,
+    EXP_HI,
+    EXP_LO,
+    F,
+    FLAGS,
+    FP_HI,
+    FP_LO,
+    K,
+    LIMIT,
+    REM_I,
+    REMF_HI,
+    REMF_LO,
+    ROW,
+    STAMP_HI,
+    STAMP_LO,
+    Table2,
+)
+from gubernator_tpu.types import Status
+
+i64 = jnp.int64
+i32 = jnp.int32
+f64 = jnp.float64
+f32 = jnp.float32
+
+
+def _lo32(x):
+    return (x & 0xFFFFFFFF).astype(i32)
+
+
+def _hi32(x):
+    return (x >> 32).astype(i32)
+
+
+def _join64(lo32, hi32):
+    return (hi32.astype(i64) << 32) | (lo32.astype(i64) & 0xFFFFFFFF)
+
+
+def _biased(x_i32):
+    """Map int32 bit patterns to an order-preserving signed key for the
+    unsigned value (flip the sign bit): sorting the result as int32 sorts the
+    original as uint32."""
+    return x_i32 ^ jnp.int32(-0x80000000)
+
+
+def _cummax(x):
+    return jax.lax.cummax(x, axis=0)
+
+
+def sweep_geometry(n_buckets: int, batch: int) -> Tuple[int, int]:
+    """(BLK bucket-rows per Pallas block, U update window per block).
+
+    U covers the expected per-block update count plus a ~5-sigma Poisson tail
+    (overflow rows are dropped → engine retry, so the tail bound is a perf
+    knob, not correctness). BLK shrinks until the (BLK, U) one-hot operand
+    fits VMEM comfortably."""
+    blk = min(2048, n_buckets)
+    while True:
+        nblk = n_buckets // blk
+        mean = batch / nblk
+        u = int(mean + 5.0 * mean**0.5) + 64
+        u = -(-u // 64) * 64  # lane-friendly multiple
+        u = min(u, -(-batch // 64) * 64)
+        if blk * u <= (1 << 21) or blk <= 256:
+            return blk, u
+        blk //= 2
+
+
+class Claim2(NamedTuple):
+    bucket: jnp.ndarray  # (B,) i32
+    chosen: jnp.ndarray  # (B,) i32 lane in [0, K)
+    got: jnp.ndarray  # (B,) bool — row has a lane (pre-dedup)
+    owns: jnp.ndarray  # (B,) bool — lane holds this row's fp
+    written: jnp.ndarray  # (B,) bool — row survives dedup (+ window overflow)
+    evict_live: jnp.ndarray  # (B,) bool — claimed lane held a live item
+    slots: jnp.ndarray  # (B, K, F) i32 — the gathered bucket contents
+    # sweep-write routing (sorted-by-target domain)
+    order: jnp.ndarray  # (B,) i32 original index at each sorted position
+    tgt_sorted: jnp.ndarray  # (B,) i32 target slot at each sorted position
+
+
+def _probe_claim2(
+    rows_tbl: jnp.ndarray, fp, now, active, blk: int, u: int
+) -> Claim2:
+    NB = rows_tbl.shape[0]
+    B = fp.shape[0]
+    if NB * K * 2 >= 2**31:
+        raise ValueError("table too large for int32 slot ids")
+
+    bucket = (fp % NB).astype(i32)
+    my_lo = _lo32(fp)
+    my_hi = _hi32(fp)
+
+    rows = rows_tbl[bucket]  # (B, 128) row gather — the only table read
+    slots = rows.reshape(B, K, F)
+    s_fp_lo = slots[:, :, FP_LO]
+    s_fp_hi = slots[:, :, FP_HI]
+    s_exp = _join64(slots[:, :, EXP_LO], slots[:, :, EXP_HI])  # (B, K)
+
+    empty = (s_fp_lo == 0) & (s_fp_hi == 0)
+    match = (s_fp_lo == my_lo[:, None]) & (s_fp_hi == my_hi[:, None]) & ~empty
+    match = match & active[:, None]
+    owns = match.any(axis=1)
+    own_j = jnp.argmax(match, axis=1).astype(i32)
+
+    # exact lazy expiry (reference lrucache.go:111-128): expired slots are
+    # reclaimable by any key probing the bucket
+    dead = ~empty & (s_exp < now[:, None])
+    vacant = empty | dead
+    live = ~vacant
+
+    # ---- rank among inserting rows of the same bucket (sorted domain)
+    need = active & ~owns
+    NBs = jnp.int32(NB)
+    bkey = jnp.where(active, bucket, NBs)
+    idx = jnp.arange(B, dtype=i32)
+    bkey_s, need_s, idx_s1 = jax.lax.sort(
+        (bkey, need.astype(i32), idx), num_keys=1
+    )
+    first = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), bkey_s[1:] != bkey_s[:-1]]
+    )
+    csum = jnp.cumsum(need_s)
+    c_excl = csum - need_s
+    seg_base = _cummax(jnp.where(first, c_excl, -1))
+    rank_s = (c_excl - seg_base).astype(i32)
+    # un-sort: rank back to original row order
+    _, rank = jax.lax.sort((idx_s1, rank_s), num_keys=1)
+
+    # ---- candidate lane order: vacant lanes first (by index), then live
+    # lanes by soonest expiry — expiry-stamp eviction, v1 semantics
+    lane_iota = jnp.broadcast_to(jnp.arange(K, dtype=i32), (B, K))
+    exp_hi_k = slots[:, :, EXP_HI]
+    exp_lo_k = _biased(slots[:, :, EXP_LO])
+    _, _, _, cand = jax.lax.sort(
+        (live.astype(i32), exp_hi_k, exp_lo_k, lane_iota), num_keys=3, dimension=1
+    )
+    rank_c = jnp.clip(rank, 0, K - 1)
+    ins_lane = jnp.take_along_axis(cand, rank_c[:, None], axis=1)[:, 0]
+    claim_ok = need & (rank < K)
+
+    chosen = jnp.where(owns, own_j, ins_lane)
+    got = active & (owns | claim_ok)
+    lane_live = jnp.take_along_axis(live, chosen[:, None], axis=1)[:, 0]
+    evict_live = claim_ok & lane_live
+
+    # ---- conflict dedup + sweep window assignment over target slots
+    NBK = jnp.int32(NB * K)
+    target = jnp.where(got, bucket * K + chosen, NBK)
+    # owners sort ahead of inserters on equal targets, so dedup keeps them
+    skey = target * 2 + jnp.where(owns, 0, 1).astype(i32)
+    skey_s, idx_s2 = jax.lax.sort((skey, idx), num_keys=1)
+    tgt_s = skey_s >> 1
+    dup = jnp.concatenate([jnp.zeros((1,), dtype=bool), tgt_s[1:] == tgt_s[:-1]])
+
+    # window overflow: position within the target's sweep block run
+    pos_i = jnp.arange(B, dtype=i32)
+    blk_of = tgt_s // jnp.int32(K * blk)
+    first_blk = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), blk_of[1:] != blk_of[:-1]]
+    )
+    blk_start = _cummax(jnp.where(first_blk, pos_i, -1))
+    overflow = (pos_i - blk_start) >= u
+
+    written_s = (tgt_s < NBK) & ~dup & ~overflow
+    _, written_i = jax.lax.sort((idx_s2, written_s.astype(i32)), num_keys=1)
+    written = written_i.astype(bool)
+
+    return Claim2(
+        bucket=bucket,
+        chosen=chosen,
+        got=got,
+        owns=owns,
+        written=written,
+        evict_live=evict_live & written,
+        slots=slots,
+        order=idx_s2,
+        tgt_sorted=tgt_s,
+    )
+
+
+# --------------------------------------------------------------------- write
+
+
+def _sweep_kernel(new16_ref, slot_ref, bkt_ref, in_ref, out_ref):
+    """One table block: compose update rows into bucket rows via int8 one-hot
+    matmuls (MXU) — the scatter-as-matmul trick. All update (row, lane)
+    targets are unique (claim dedup), so the sums place, never add.
+
+    Inputs are slot-granular (16 lanes of payload + slot index within the
+    bucket); the 128-lane expansion and lane mask are derived here — keeping
+    the host-side window gathers narrow (measured: gathering pre-expanded
+    (·,128) payload + int8 masks cost more than the whole table sweep)."""
+    blk_rows = in_ref[:]  # (BLK, 128) i32
+    new16 = new16_ref[:]  # (U, 16) i32 slot payload
+    slot = slot_ref[:]  # (U, 1) i32 slot-in-bucket, or -1 inactive
+    lb = bkt_ref[:]  # (U, 1) i32 local bucket row, or -1 inactive
+    BLK = blk_rows.shape[0]
+    U = new16.shape[0]
+    # 128-lane expansion: lane l belongs to slot l//16 and field l%16
+    lane_slot = jax.lax.broadcasted_iota(jnp.int32, (U, ROW), 1) // F
+    upd = jnp.concatenate([new16] * K, axis=1)  # (U, 128): field pattern x8
+    msk = (lane_slot == slot).astype(jnp.int8)  # (U, 128)
+    iot = jax.lax.broadcasted_iota(jnp.int32, (BLK, U), 0)
+    onehot = (iot == lb[:, 0][None, :]).astype(jnp.int8)
+    written = jax.lax.dot_general(
+        onehot, msk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32
+    )
+    acc = None
+    for s in range(4):
+        plane = (((upd >> (8 * s)) & 0xFF) * msk.astype(jnp.int32)).astype(jnp.int8)
+        p = jax.lax.dot_general(
+            onehot, plane, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32
+        )
+        # targets are unique → p holds exactly one (sign-extended) byte;
+        # re-mask before reassembly
+        p = (p & 0xFF) << (8 * s)
+        acc = p if acc is None else acc | p
+    out_ref[:] = jnp.where(written > 0, acc, blk_rows)
+
+
+def _write_sweep(rows_tbl, new16, c: Claim2, blk: int, u: int):
+    """Pallas sweep write: route sorted updates into per-block windows and
+    stream the table through VMEM once."""
+    NB = rows_tbl.shape[0]
+    B = new16.shape[0]
+    nblk = NB // blk
+
+    # per-block run starts in the sorted-target order
+    starts = jnp.searchsorted(
+        c.tgt_sorted, (jnp.arange(nblk, dtype=i32) * (K * blk)).astype(i32)
+    ).astype(i32)
+    win = starts[:, None] + jnp.arange(u, dtype=i32)[None, :]  # (nblk, U)
+    win = win.reshape(-1)
+    win_valid = win < B
+    winc = jnp.clip(win, 0, B - 1)
+    data_idx = c.order[winc]  # original row at this sorted position
+    # a window slot is live iff it's inside the batch, targets this block,
+    # and survived dedup/overflow — written flags are per original row
+    tgt_w = c.tgt_sorted[winc]
+    blk_ids = jnp.repeat(jnp.arange(nblk, dtype=i32), u)
+    in_block = (tgt_w // jnp.int32(K * blk)) == blk_ids
+    livew = win_valid & in_block & c.written[data_idx]
+
+    wnew = new16[data_idx] * livew[:, None].astype(i32)
+    wslot = jnp.where(livew, tgt_w % K, -1).astype(i32)
+    wlb = jnp.where(livew, (tgt_w // K) - blk_ids * blk, -1).astype(i32)
+
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            _sweep_kernel,
+            interpret=jax.default_backend() == "cpu",
+            out_shape=jax.ShapeDtypeStruct(rows_tbl.shape, rows_tbl.dtype),
+            grid=(nblk,),
+            in_specs=[
+                pl.BlockSpec((u, F), lambda i: (i, 0)),
+                pl.BlockSpec((u, 1), lambda i: (i, 0)),
+                pl.BlockSpec((u, 1), lambda i: (i, 0)),
+                pl.BlockSpec((blk, ROW), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((blk, ROW), lambda i: (i, 0)),
+            input_output_aliases={3: 0},
+        )(wnew, wslot.reshape(-1, 1), wlb.reshape(-1, 1), rows_tbl)
+    return out
+
+
+def _write_xla(rows_tbl, new16, c: Claim2):
+    """Semantically identical scatter write for backends without the Pallas
+    TPU pipeline (CPU test meshes). Slot-granular, drop-mode."""
+    NB = rows_tbl.shape[0]
+    slot_view = rows_tbl.reshape(NB * K, F)
+    tgt = jnp.where(c.written, c.bucket * K + c.chosen, NB * K)
+    out = slot_view.at[tgt].set(new16, mode="drop")
+    return out.reshape(NB, ROW)
+
+
+# -------------------------------------------------------------------- decide
+
+
+def decide2_impl(
+    table: Table2, req: ReqBatch, *, write: str = "sweep"
+) -> Tuple[Table2, RespBatch, BatchStats]:
+    """Un-jitted v2 kernel body — call through `decide2` / `decide2_xla`."""
+    B = req.fp.shape[0]
+    NB = table.rows.shape[0]
+    blk, u = sweep_geometry(NB, B)
+    now = req.created_at
+    active = req.active
+
+    c = _probe_claim2(table.rows, req.fp, now, active, blk, u)
+
+    # ---- apply: chosen lane's stored state
+    lane16 = jnp.take_along_axis(c.slots, c.chosen[:, None, None], axis=1)[
+        :, 0, :
+    ]  # (B, F)
+    g = lambda f: lane16[:, f]
+    s_exp = _join64(g(EXP_LO), g(EXP_HI))
+    exists = c.owns & (s_exp >= now)
+    s_flags = g(FLAGS)
+    stored = StoredState(
+        limit=g(LIMIT).astype(i64),
+        burst=g(BURST).astype(i64),
+        rem_i=g(REM_I).astype(i64),
+        algo=s_flags & 0xFF,
+        status=s_flags >> 8,
+        duration=_join64(g(DUR_LO), g(DUR_HI)),
+        stamp=_join64(g(STAMP_LO), g(STAMP_HI)),
+        exp=s_exp,
+        rem_f=jax.lax.bitcast_convert_type(g(REMF_HI), f32).astype(f64)
+        + jax.lax.bitcast_convert_type(g(REMF_LO), f32).astype(f64),
+    )
+    d = bucket_math(stored, req, exists)
+
+    # ---- build update payload rows
+    sat32 = lambda x: jnp.clip(x, -(2**31), 2**31 - 1).astype(i32)
+    remf_hi = d.rem_f_out.astype(f32)
+    remf_lo = (d.rem_f_out - remf_hi.astype(f64)).astype(f32)
+    my_lo = _lo32(req.fp)
+    my_hi = _hi32(req.fp)
+    zero = jnp.zeros_like(my_lo)
+    new16 = jnp.stack(
+        [
+            jnp.where(d.remove, 0, my_lo),
+            jnp.where(d.remove, 0, my_hi),
+            sat32(req.limit),
+            sat32(d.burst_out),
+            sat32(d.rem_i_out),
+            d.flags_out,
+            _lo32(d.dur_out),
+            _hi32(d.dur_out),
+            _lo32(d.stamp_out),
+            _hi32(d.stamp_out),
+            jnp.where(d.remove, 0, _lo32(d.exp_out)),
+            jnp.where(d.remove, 0, _hi32(d.exp_out)),
+            jax.lax.bitcast_convert_type(remf_hi, i32),
+            jax.lax.bitcast_convert_type(remf_lo, i32),
+            zero,
+            zero,
+        ],
+        axis=1,
+    )  # (B, F)
+
+    if write == "sweep":
+        rows_out = _write_sweep(table.rows, new16, c, blk, u)
+    else:
+        rows_out = _write_xla(table.rows, new16, c)
+
+    OVER = jnp.int32(int(Status.OVER_LIMIT))
+    UNDER = jnp.int32(int(Status.UNDER_LIMIT))
+    dropped = active & ~c.written
+    resp = RespBatch(
+        status=jnp.where(active, d.resp_status, UNDER),
+        limit=jnp.where(active, req.limit, i64(0)),
+        remaining=jnp.where(active, d.resp_rem, i64(0)),
+        reset_time=jnp.where(active, d.resp_reset, i64(0)),
+        cache_hit=exists,
+        dropped=dropped,
+    )
+    stats = BatchStats(
+        cache_hits=exists.sum(dtype=i64),
+        cache_misses=(active & ~exists).sum(dtype=i64),
+        over_limit=(active & (resp.status == OVER)).sum(dtype=i64),
+        evicted_unexpired=c.evict_live.sum(dtype=i64),
+        dropped=dropped.sum(dtype=i64),
+    )
+    return Table2(rows=rows_out), resp, stats
+
+
+decide2 = functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("write",))(
+    decide2_impl
+)
+
+
+# -------------------------------------------------------------------- install
+
+
+def install2_impl(
+    table: Table2, inst, *, write: str = "xla"
+) -> Tuple[Table2, jnp.ndarray]:
+    """v2 analog of kernel.install_impl — install owner-authoritative GLOBAL
+    statuses as fresh items (reference UpdatePeerGlobals, gubernator.go:434-474).
+    Returns (table', installed_mask)."""
+    from gubernator_tpu.types import Algorithm
+
+    B = inst.fp.shape[0]
+    NB = table.rows.shape[0]
+    blk, u = sweep_geometry(NB, B)
+    c = _probe_claim2(table.rows, inst.fp, inst.now, inst.active, blk, u)
+
+    is_token = inst.algo == int(Algorithm.TOKEN_BUCKET)
+    rem_i = jnp.where(is_token, inst.remaining, i64(0))
+    rem_f = jnp.where(is_token, f64(0.0), inst.remaining.astype(f64))
+    burst = jnp.where(is_token, i64(0), inst.limit)
+    flags = inst.algo | (inst.status << 8)
+    sat32 = lambda x: jnp.clip(x, -(2**31), 2**31 - 1).astype(i32)
+    remf_hi = rem_f.astype(f32)
+    remf_lo = (rem_f - remf_hi.astype(f64)).astype(f32)
+    zero = jnp.zeros((B,), dtype=i32)
+    new16 = jnp.stack(
+        [
+            _lo32(inst.fp),
+            _hi32(inst.fp),
+            sat32(inst.limit),
+            sat32(burst),
+            sat32(rem_i),
+            flags,
+            _lo32(inst.duration),
+            _hi32(inst.duration),
+            _lo32(inst.now),
+            _hi32(inst.now),
+            _lo32(inst.reset_time),
+            _hi32(inst.reset_time),
+            jax.lax.bitcast_convert_type(remf_hi, i32),
+            jax.lax.bitcast_convert_type(remf_lo, i32),
+            zero,
+            zero,
+        ],
+        axis=1,
+    )
+    if write == "sweep":
+        rows_out = _write_sweep(table.rows, new16, c, blk, u)
+    else:
+        rows_out = _write_xla(table.rows, new16, c)
+    return Table2(rows=rows_out), inst.active & c.written
+
+
+install2 = functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("write",)
+)(install2_impl)

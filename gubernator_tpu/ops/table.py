@@ -111,10 +111,14 @@ def new_table(capacity: int, k: int = 8) -> Table:
     )
 
 
-def live_count(table: Table, now_ms: int) -> int:
+def live_count(table, now_ms: int) -> int:
     """Number of live (non-empty, unexpired) slots — the analog of the
     reference cache Size() (lrucache.go:152-157). Uses the exact expiry from
-    the apply plane."""
+    the apply plane. Accepts either table generation."""
+    if not isinstance(table, Table):  # v2 packed-row table
+        from gubernator_tpu.ops.table2 import live_count2
+
+        return live_count2(table, now_ms)
     lo = np.asarray(table.pfp_lo).view(np.int32).reshape(-1)
     hi = np.asarray(table.pfp_hi).view(np.int32).reshape(-1)
     exp = np.asarray(table.exp_lo).view(np.int32).astype(np.int64) & 0xFFFFFFFF

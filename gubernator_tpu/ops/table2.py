@@ -1,0 +1,90 @@
+"""Packed-row HBM table (v2): one bucket per TPU lane row.
+
+Layout chosen from measured v5e memory-op costs (exp/exp_mem*.py):
+
+* XLA scatters serialize (~8 ns/element regardless of layout) — the v1 design's
+  15 plane scatters cost ~16 ms per 131K-row dispatch;
+* row gathers are fast (~1.3 ms for (131K, 128) int32), and a full streaming
+  sweep of a 1 GB table through VMEM costs ~3.3 ms with int8 one-hot matmuls
+  (the scatter-as-MXU-work trick) essentially free behind the DMA.
+
+Hence the v2 layout: ``rows`` is an (NB, 128) int32 array — NB buckets, each
+row = K=8 slots x 16 int32 fields, slot-major. A bucket row is exactly one TPU
+vector lane row (128 lanes), so:
+
+* probe+apply = ONE row gather of the request's whole bucket (every slot's
+  full state arrives in one fetch — no separate probe plane);
+* write = the Pallas sweep kernel (ops/kernel2.py) composing slot-granular
+  updates into bucket rows via int8 one-hot matmuls on the MXU.
+
+Per-slot field order (16 int32 lanes): fp_lo, fp_hi, limit, burst, rem_i,
+flags(algo | status<<8), dur_lo, dur_hi, stamp_lo, stamp_hi, exp_lo, exp_hi,
+remf_hi(f32 bits), remf_lo(f32 bits), reserved, reserved. Semantics mirror
+TokenBucketItem/LeakyBucketItem (reference store.go:29-43) + CacheItem.ExpireAt
+(reference cache.go:29-41); the leaky float64 remainder is double-single
+(two f32, ~48-bit mantissa). fp == 0 marks an empty slot. Eviction is
+expiry-stamp based exactly as in v1 (ops/table.py docstring; reference
+lrucache.go:111-149).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+K = 8  # slots per bucket
+F = 16  # int32 fields per slot
+ROW = K * F  # 128 int32 lanes per bucket row
+
+# field indices within a slot
+FP_LO, FP_HI, LIMIT, BURST, REM_I, FLAGS = 0, 1, 2, 3, 4, 5
+DUR_LO, DUR_HI, STAMP_LO, STAMP_HI, EXP_LO, EXP_HI = 6, 7, 8, 9, 10, 11
+REMF_HI, REMF_LO = 12, 13
+
+
+class Table2(NamedTuple):
+    rows: jnp.ndarray  # (NB, 128) int32
+
+    @property
+    def n_buckets(self) -> int:
+        return self.rows.shape[-2]
+
+    @property
+    def capacity(self) -> int:
+        return self.rows.shape[-2] * K
+
+
+def n_buckets_for(capacity: int) -> int:
+    """Bucket count for a requested slot capacity: rounded up so the Pallas
+    sweep's block partitioning divides evenly (power of two below 2048 blocks,
+    multiple of 2048 above)."""
+    if capacity <= 0:
+        raise ValueError("capacity must be positive")
+    nb = -(-capacity // K)
+    if nb <= 2048:
+        p = 1
+        while p < nb:
+            p *= 2
+        return p
+    return -(-nb // 2048) * 2048
+
+
+def new_table2(capacity: int) -> Table2:
+    """Fresh empty table (the CacheSize analog, reference config.go:151).
+    Keep load factor <= ~0.6 for healthy buckets."""
+    return Table2(rows=jnp.zeros((n_buckets_for(capacity), ROW), dtype=jnp.int32))
+
+
+def live_count2(table: Table2, now_ms: int) -> int:
+    """Live (non-empty, unexpired) slots — reference cache Size()
+    (lrucache.go:152-157)."""
+    rows = np.asarray(table.rows).reshape(-1, K, F)
+    lo = rows[:, :, FP_LO]
+    hi = rows[:, :, FP_HI]
+    exp = (rows[:, :, EXP_LO].astype(np.int64) & 0xFFFFFFFF) | (
+        rows[:, :, EXP_HI].astype(np.int64) << 32
+    )
+    nonempty = (lo != 0) | (hi != 0)
+    return int((nonempty & (exp >= now_ms)).sum())

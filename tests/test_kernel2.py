@@ -1,0 +1,194 @@
+"""v2 kernel validation: differential vs the v1 oracle + sweep-write parity.
+
+The v1 kernel (ops/kernel.py) carries the reference-semantics test burden
+(test_token_bucket / test_leaky_bucket run the engine, now v2 by default, and
+were originally written against v1). Here v2 is additionally checked
+*differentially* against v1 on randomized traffic, and the Pallas sweep write
+is checked bit-exact against the XLA scatter write (interpret mode on CPU).
+"""
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.ops.engine import LocalEngine
+from gubernator_tpu.ops.table2 import live_count2
+from gubernator_tpu.types import (
+    Algorithm,
+    Behavior,
+    RateLimitRequest,
+    Status,
+    MINUTE,
+    SECOND,
+)
+
+NOW = 1_700_000_000_000
+
+
+def random_requests(rng, n, keyspace, now):
+    reqs = []
+    for _ in range(n):
+        algo = Algorithm.TOKEN_BUCKET if rng.random() < 0.5 else Algorithm.LEAKY_BUCKET
+        behavior = 0
+        r = rng.random()
+        if r < 0.15:
+            behavior |= Behavior.RESET_REMAINING
+        if 0.15 <= r < 0.3:
+            behavior |= Behavior.DRAIN_OVER_LIMIT
+        reqs.append(
+            RateLimitRequest(
+                name="diff",
+                unique_key=f"k{rng.integers(keyspace)}",
+                hits=int(rng.integers(0, 4)),
+                limit=int(rng.integers(1, 20)),
+                duration=int(rng.integers(1, 5)) * SECOND,
+                algorithm=algo,
+                behavior=behavior,
+                created_at=now,
+            )
+        )
+    return reqs
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_v2_matches_v1_on_random_traffic(seed):
+    """Same request stream, same responses, both kernels. Tables are large
+    enough that eviction never triggers (eviction ordering legitimately
+    differs: v1 probes coarse expiry, v2 exact — see kernel2 docstring)."""
+    rng = np.random.default_rng(seed)
+    e1 = LocalEngine(capacity=4096, kernel=1)
+    e2 = LocalEngine(capacity=4096, kernel=2)
+    now = NOW
+    for step in range(6):
+        reqs = random_requests(rng, 64, keyspace=40, now=now)
+        r1 = e1.check(reqs, now_ms=now)
+        r2 = e2.check(reqs, now_ms=now)
+        for i, (a, b) in enumerate(zip(r1, r2)):
+            assert (a.status, a.limit, a.remaining, a.reset_time, a.error) == (
+                b.status,
+                b.limit,
+                b.remaining,
+                b.reset_time,
+                b.error,
+            ), f"step {step} row {i}: {reqs[i]} → v1={a} v2={b}"
+        now += int(rng.integers(0, 3000))
+    assert e1.stats.cache_hits == e2.stats.cache_hits
+    assert e1.stats.cache_misses == e2.stats.cache_misses
+    assert e1.stats.over_limit == e2.stats.over_limit
+
+
+def test_sweep_write_matches_xla_write():
+    """The Pallas sweep (interpret mode on CPU) must produce a bit-identical
+    table to the XLA scatter write."""
+    rng = np.random.default_rng(7)
+    ex = LocalEngine(capacity=4096, kernel=2, write_mode="xla")
+    es = LocalEngine(capacity=4096, kernel=2, write_mode="sweep")
+    now = NOW
+    for _ in range(3):
+        reqs = random_requests(rng, 48, keyspace=60, now=now)
+        rx = ex.check(reqs, now_ms=now)
+        rs = es.check(reqs, now_ms=now)
+        for a, b in zip(rx, rs):
+            assert (a.status, a.remaining, a.reset_time) == (
+                b.status,
+                b.remaining,
+                b.reset_time,
+            )
+        now += 1500
+    assert np.array_equal(np.asarray(ex.table.rows), np.asarray(es.table.rows))
+
+
+def test_v2_bucket_overflow_evicts_soonest_expiring():
+    """9 keys forced into one bucket of 8 lanes: the 9th insert evicts the
+    soonest-expiring live slot (expiry-stamp eviction, reference
+    lrucache.go:138-149) and the alarm counter fires."""
+    eng = LocalEngine(capacity=8, kernel=2)  # single-bucket table (NB=8... )
+    # NB is rounded to >=8 buckets; pick keys that all land in bucket 0
+    from gubernator_tpu.hashing import fingerprint
+
+    nb = eng.table.rows.shape[0]
+    keys = []
+    i = 0
+    while len(keys) < 9:
+        k = f"ov{i}"
+        if fingerprint("t", k) % nb == 0:
+            keys.append(k)
+        i += 1
+    now = NOW
+    # first 8 fill the bucket with staggered expirations (key j expires at
+    # now + (j+1) minutes)
+    for j, k in enumerate(keys[:8]):
+        (r,) = eng.check(
+            [
+                RateLimitRequest(
+                    name="t", unique_key=k, hits=1, limit=10,
+                    duration=(j + 1) * MINUTE, created_at=now,
+                )
+            ],
+            now_ms=now,
+        )
+        assert r.error == "" and r.remaining == 9
+    assert eng.stats.evicted_unexpired == 0
+    # 9th key evicts keys[0] (soonest expiry)
+    (r,) = eng.check(
+        [
+            RateLimitRequest(
+                name="t", unique_key=keys[8], hits=1, limit=10,
+                duration=MINUTE, created_at=now,
+            )
+        ],
+        now_ms=now,
+    )
+    assert r.error == "" and r.remaining == 9
+    assert eng.stats.evicted_unexpired == 1
+    # keys[0] is gone: re-checking it starts a fresh bucket (miss)
+    hits_before = eng.stats.cache_hits
+    (r,) = eng.check(
+        [
+            RateLimitRequest(
+                name="t", unique_key=keys[0], hits=1, limit=10,
+                duration=MINUTE, created_at=now,
+            )
+        ],
+        now_ms=now,
+    )
+    assert r.remaining == 9  # fresh, not 8
+    assert eng.stats.cache_hits == hits_before
+    # keys[1] survived
+    (r,) = eng.check(
+        [
+            RateLimitRequest(
+                name="t", unique_key=keys[1], hits=1, limit=10,
+                duration=2 * MINUTE, created_at=now,
+            )
+        ],
+        now_ms=now,
+    )
+    assert r.remaining == 8
+
+
+def test_v2_live_count_and_expiry():
+    eng = LocalEngine(capacity=1024, kernel=2)
+    now = NOW
+    reqs = [
+        RateLimitRequest(
+            name="t", unique_key=f"lc{i}", hits=1, limit=5, duration=10 * SECOND,
+            created_at=now,
+        )
+        for i in range(50)
+    ]
+    eng.check(reqs, now_ms=now)
+    assert live_count2(eng.table, now) == 50
+    assert live_count2(eng.table, now + 11 * SECOND) == 0
+    # expired slots are reclaimed lazily: re-check after expiry is a miss
+    later = now + 11 * SECOND
+    out = eng.check(
+        [
+            RateLimitRequest(
+                name="t", unique_key="lc0", hits=1, limit=5, duration=10 * SECOND,
+                created_at=later,
+            )
+        ],
+        now_ms=later,
+    )
+    assert out[0].remaining == 4
+    assert eng.stats.cache_hits == 0
