@@ -1,16 +1,22 @@
-"""Headline benchmark: rate-limit decisions/sec on one TPU chip.
+"""Headline benchmark: rate-limit decisions/sec on one TPU chip (v2 kernel).
 
-Measures steady-state decision throughput of the core kernel against the
-north-star target (BASELINE.md: ≥50M decisions/sec on a v5e-8 with 10M live
-keys, p99 < 2 ms → per-chip share 6.25M decisions/sec).
+Measures steady-state decision throughput of the packed-row kernel
+(ops/kernel2.py, Pallas sweep write) against the north-star target
+(BASELINE.md: ≥50M decisions/sec on a v5e-8 with 10M live keys, p99 < 2 ms →
+per-chip share 6.25M decisions/sec), plus the BASELINE config matrix:
 
-Setup mirrors BASELINE config #2/#3 scale on a single chip:
-* 16.7M-slot HBM table (~1.5 GB), pre-seeded with 10M live keys
-* token-bucket traffic over the live keyspace, 128K-decision batches,
-  pipelined dispatches (async, donated table buffer)
+  headline  token bucket, 16.7M-slot table, 10M live keys       (config #3 scale)
+  config1   token bucket, 1K hot keys, small table              (config #1)
+  config2   leaky bucket, 1M keys, Zipf-1.1 skewed traffic      (config #2)
+  config4   mixed token+leaky with RESET_REMAINING/DRAIN flags  (config #4)
 
-Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Also reports per-dispatch p99 latency (fetch-forced round trips, so the number
+includes the axon tunnel RTT — an upper bound on device latency) and runs a
+sweep-vs-XLA write parity smoke on the real TPU (the only place the Pallas
+sweep runs un-interpreted; CI meshes are CPU).
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "matrix": {...}}
 plus human-readable detail on stderr.
 """
 
@@ -25,104 +31,283 @@ import jax
 import jax.numpy as jnp
 
 from gubernator_tpu.ops.batch import ReqBatch
-from gubernator_tpu.ops.kernel import decide
-from gubernator_tpu.ops.table import new_table
-from gubernator_tpu.types import Algorithm
+from gubernator_tpu.ops.engine import default_write_mode
+from gubernator_tpu.ops.kernel2 import decide2
+from gubernator_tpu.ops.table2 import new_table2
+from gubernator_tpu.types import Algorithm, Behavior
 
-CAPACITY = 1 << 24  # 16.7M slots
-LIVE_KEYS = 10_000_000
-BATCH = 1 << 17  # 131072
-N_STAGED = 8  # distinct pre-staged batches cycled through
-WARMUP = 3
-DISPATCHES = 48
 PER_CHIP_BASELINE = 50e6 / 8  # north-star 50M/s on v5e-8 → per-chip share
+WRITE = default_write_mode()
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def make_batches(rng: np.random.Generator, now: int) -> list:
-    """Disjoint windows of a keyspace permutation → unique fps per batch."""
-    keyspace = rng.integers(1, (1 << 63) - 1, size=LIVE_KEYS, dtype=np.int64)
-    perm = rng.permutation(LIVE_KEYS)
-    batches = []
-    zeros = np.zeros(BATCH, dtype=np.int64)
-    for i in range(N_STAGED):
-        fps = keyspace[perm[i * BATCH : (i + 1) * BATCH]]
-        rb = ReqBatch(
-            fp=jnp.asarray(fps),
-            algo=jnp.full(BATCH, int(Algorithm.TOKEN_BUCKET), dtype=jnp.int32),
-            behavior=jnp.zeros(BATCH, dtype=jnp.int32),
-            hits=jnp.ones(BATCH, dtype=jnp.int64),
-            limit=jnp.full(BATCH, 1000, dtype=jnp.int64),
-            burst=jnp.asarray(zeros),
-            duration=jnp.full(BATCH, 60_000, dtype=jnp.int64),
-            created_at=jnp.full(BATCH, now, dtype=jnp.int64),
-            expire_new=jnp.full(BATCH, now + 60_000, dtype=jnp.int64),
-            greg_interval=jnp.asarray(zeros),
-            duration_eff=jnp.full(BATCH, 60_000, dtype=jnp.int64),
-            active=jnp.ones(BATCH, dtype=bool),
+def make_req_batch(
+    fps: np.ndarray,
+    now: int,
+    hits: np.ndarray = None,
+    algo: np.ndarray = None,
+    behavior: np.ndarray = None,
+    limit: int = 1000,
+    duration: int = 60_000,
+) -> ReqBatch:
+    b = fps.shape[0]
+    zeros = np.zeros(b, dtype=np.int64)
+    algo = (
+        np.full(b, int(Algorithm.TOKEN_BUCKET), dtype=np.int32)
+        if algo is None
+        else algo
+    )
+    is_leaky = algo == int(Algorithm.LEAKY_BUCKET)
+    limit_arr = np.full(b, limit, dtype=np.int64)
+    return ReqBatch(
+        fp=jnp.asarray(fps),
+        algo=jnp.asarray(algo),
+        behavior=jnp.asarray(
+            np.zeros(b, dtype=np.int32) if behavior is None else behavior
+        ),
+        hits=jnp.asarray(np.ones(b, dtype=np.int64) if hits is None else hits),
+        limit=jnp.asarray(limit_arr),
+        # leaky burst defaults to limit (host packing rule, algorithms.go:259-261)
+        burst=jnp.asarray(np.where(is_leaky, limit_arr, 0)),
+        duration=jnp.full(b, duration, dtype=jnp.int64),
+        created_at=jnp.full(b, now, dtype=jnp.int64),
+        expire_new=jnp.full(b, now + duration, dtype=jnp.int64),
+        greg_interval=jnp.asarray(zeros),
+        duration_eff=jnp.full(b, duration, dtype=jnp.int64),
+        active=jnp.ones(b, dtype=bool),
+    )
+
+
+def unique_agg(fps: np.ndarray) -> "tuple[np.ndarray, np.ndarray]":
+    """Aggregate duplicate keys in a batch (sum hits) — the same-key
+    aggregation the host pass planner / GLOBAL accumulator performs
+    (reference global.go:109-123) so the kernel sees unique fingerprints."""
+    ufp, counts = np.unique(fps, return_counts=True)
+    return ufp, counts.astype(np.int64)
+
+
+class Case:
+    """One benchmark case: pre-staged device batches cycled through a
+    donated-table dispatch loop; throughput from the slope between a short and
+    a long pipelined run (the tunneled axon platform has no true
+    block_until_ready, so completion is forced by fetching a scalar)."""
+
+    def __init__(self, name, capacity, batches, seed_batches=None):
+        self.name = name
+        self.table = new_table2(capacity)
+        self.batches = batches
+        self.seed_batches = seed_batches if seed_batches is not None else batches
+        self.last_stats = None
+
+    def dispatch(self, b):
+        self.table, resp, stats = decide2(self.table, b, write=WRITE)
+        return stats
+
+    def run(self, dispatches=48, latency_probes=24):
+        t0 = time.perf_counter()
+        for b in self.seed_batches:
+            stats = self.dispatch(b)
+        _ = int(stats.cache_hits)
+        log(f"[{self.name}] compile+seed: {time.perf_counter() - t0:.1f}s")
+        n = len(self.batches)
+
+        def timed_run(k: int):
+            t0 = time.perf_counter()
+            stats = None
+            for i in range(k):
+                stats = self.dispatch(self.batches[i % n])
+            hits = int(stats.cache_hits)  # forces the chain (donated deps)
+            return time.perf_counter() - t0, hits, int(stats.cache_misses)
+
+        timed_run(2)
+        n_short, n_long = 4, 4 + dispatches
+        t_short = min(timed_run(n_short)[0] for _ in range(3))
+        t_long, hits, misses = min(timed_run(n_long) for _ in range(3))
+        dt = max(t_long - t_short, 1e-9)
+        batch = int(self.batches[0].fp.shape[0])
+        dps = dispatches * batch / dt
+        per_dispatch_ms = dt / dispatches * 1e3
+        # per-dispatch latency: force a round trip EVERY iteration (no
+        # pipelining) — includes the host↔device fetch RTT, upper bound
+        lat = []
+        for i in range(latency_probes):
+            t0 = time.perf_counter()
+            stats = self.dispatch(self.batches[i % n])
+            _ = int(stats.cache_hits)
+            lat.append(time.perf_counter() - t0)
+        lat_ms = np.asarray(lat) * 1e3
+        p50, p99 = float(np.percentile(lat_ms, 50)), float(np.percentile(lat_ms, 99))
+        log(
+            f"[{self.name}] slope: {dispatches} x {batch} decisions in {dt:.3f}s"
+            f" = {dps/1e6:.2f}M/s ({per_dispatch_ms:.2f} ms/dispatch); "
+            f"round-trip latency p50={p50:.1f}ms p99={p99:.1f}ms; "
+            f"timed-phase stats: hits={hits} misses={misses}"
         )
-        batches.append(jax.device_put(rb))
-    return batches
+        return {
+            "decisions_per_sec": round(dps, 1),
+            "dispatch_ms": round(per_dispatch_ms, 3),
+            "batch": batch,
+            "rt_latency_p50_ms": round(p50, 2),
+            "rt_latency_p99_ms": round(p99, 2),
+            "timed_hits": hits,
+            "timed_misses": misses,
+        }
+
+
+def headline_case(rng, now) -> Case:
+    CAPACITY = 1 << 24  # 16.7M slots
+    LIVE = 10_000_000
+    BATCH = 1 << 17
+    keyspace = rng.integers(1, (1 << 63) - 1, size=LIVE, dtype=np.int64)
+    perm = rng.permutation(LIVE)
+    batches = [
+        jax.device_put(
+            make_req_batch(keyspace[perm[i * BATCH : (i + 1) * BATCH]], now)
+        )
+        for i in range(8)
+    ]
+    # seed = one full pass over all staged batches → timed phase is pure
+    # cache-hit steady state over 10M live keys (subset cycled)
+    return Case("headline-10M", CAPACITY, batches)
+
+
+def config1_case(rng, now) -> Case:
+    """BASELINE config #1: token bucket over 1K hot keys. Every batch row is a
+    duplicate of one of 1K keys → host aggregation, unique-key dispatches."""
+    BATCH = 1 << 17
+    keys = rng.integers(1, (1 << 63) - 1, size=1024, dtype=np.int64)
+    batches = []
+    for _ in range(8):
+        draw = keys[rng.integers(0, 1024, size=BATCH)]
+        ufp, hits = unique_agg(draw)
+        pad = 1024 - ufp.shape[0]
+        if pad:
+            ufp = np.concatenate([ufp, np.zeros(pad, dtype=np.int64)])
+            hits = np.concatenate([hits, np.zeros(pad, dtype=np.int64)])
+        b = make_req_batch(ufp, now, hits=hits, limit=1 << 30)
+        b = b._replace(active=jnp.asarray(ufp != 0))
+        batches.append(jax.device_put(b))
+    c = Case("config1-token-1K", 1 << 14, batches)
+    c.logical_batch = BATCH  # decisions represented per dispatch
+    return c
+
+
+def config2_case(rng, now) -> Case:
+    """BASELINE config #2: leaky bucket, 1M keyspace, Zipf-1.1 skew."""
+    LIVE = 1 << 20  # "1M" = 8 x 131072 so the seed pass covers every key
+    BATCH = 1 << 17
+    keyspace = rng.integers(1, (1 << 63) - 1, size=LIVE, dtype=np.int64)
+    batches = []
+    for _ in range(8):
+        z = rng.zipf(1.1, size=BATCH * 2) - 1
+        z = z[z < LIVE][:BATCH]
+        draw = keyspace[z]
+        ufp, hits = unique_agg(draw)
+        pad = BATCH - ufp.shape[0]
+        ufp = np.concatenate([ufp, np.zeros(pad, dtype=np.int64)])
+        hits = np.concatenate([hits, np.zeros(pad, dtype=np.int64)])
+        algo = np.full(BATCH, int(Algorithm.LEAKY_BUCKET), dtype=np.int32)
+        b = make_req_batch(ufp, now, hits=hits, algo=algo, limit=1 << 30)
+        b = b._replace(active=jnp.asarray(ufp != 0))
+        batches.append(jax.device_put(b))
+    # seed with the full keyspace so steady state has 1M live keys
+    seed = [
+        jax.device_put(
+            make_req_batch(
+                keyspace[i * BATCH : (i + 1) * BATCH],
+                now,
+                algo=np.full(BATCH, int(Algorithm.LEAKY_BUCKET), dtype=np.int32),
+                limit=1 << 30,
+            )
+        )
+        for i in range(LIVE // BATCH)
+    ] + batches
+    return Case("config2-leaky-1M-zipf", 1 << 21, batches, seed_batches=seed)
+
+
+def config4_case(rng, now) -> Case:
+    """BASELINE config #4: mixed token+leaky, RESET_REMAINING and
+    DRAIN_OVER_LIMIT flags on random rows, 1M keys."""
+    LIVE = 1 << 20  # 8 full batches cover the keyspace exactly
+    BATCH = 1 << 17
+    keyspace = rng.integers(1, (1 << 63) - 1, size=LIVE, dtype=np.int64)
+    perm = rng.permutation(LIVE)
+    batches = []
+    for i in range(8):
+        fps = keyspace[perm[i * BATCH : (i + 1) * BATCH]]
+        algo = (rng.random(BATCH) < 0.5).astype(np.int32)  # half leaky
+        r = rng.random(BATCH)
+        behavior = np.zeros(BATCH, dtype=np.int32)
+        behavior[r < 0.15] |= int(Behavior.RESET_REMAINING)
+        behavior[(r >= 0.15) & (r < 0.3)] |= int(Behavior.DRAIN_OVER_LIMIT)
+        hits = rng.integers(0, 4, size=BATCH).astype(np.int64)
+        b = make_req_batch(fps, now, hits=hits, algo=algo, behavior=behavior, limit=100)
+        batches.append(jax.device_put(b))
+    return Case("config4-mixed-flags-1M", 1 << 21, batches)
+
+
+def sweep_parity_smoke(rng, now):
+    """Real-TPU check that the Pallas sweep write produces the same table and
+    responses as the XLA scatter write. Returns True/False, or "skipped" on
+    backends without the TPU sweep path (CPU covers the same comparison in
+    interpret mode under pytest — tests/test_kernel2.py)."""
+    if WRITE != "sweep":
+        log("[parity] skipped (no TPU sweep path on this backend)")
+        return "skipped"
+    cap = 1 << 18
+    fps = rng.integers(1, (1 << 63) - 1, size=4096, dtype=np.int64)
+    tbl_s, tbl_x = new_table2(cap), new_table2(cap)
+    ok = True
+    for step in range(3):
+        b = make_req_batch(fps, now + step * 1000, limit=3)
+        tbl_s, resp_s, _ = decide2(tbl_s, b, write="sweep")
+        tbl_x, resp_x, _ = decide2(tbl_x, b, write="xla")
+        same_resp = bool(
+            jnp.array_equal(resp_s.status, resp_x.status)
+            & jnp.array_equal(resp_s.remaining, resp_x.remaining)
+            & jnp.array_equal(resp_s.reset_time, resp_x.reset_time)
+        )
+        ok = ok and same_resp
+    same_tbl = bool(jnp.array_equal(tbl_s.rows, tbl_x.rows))
+    ok = ok and same_tbl
+    log(f"[parity] sweep vs xla on {jax.default_backend()}: responses+table equal = {ok}")
+    return ok
 
 
 def main() -> None:
     dev = jax.devices()[0]
-    log(f"device: {dev}")
+    log(f"device: {dev}  write mode: {WRITE}")
     now = int(time.time() * 1000)
     rng = np.random.default_rng(42)
 
-    table = new_table(CAPACITY)
-    batches = make_batches(rng, now)
+    parity_ok = sweep_parity_smoke(rng, now)
 
-    # seed the table: every staged batch inserted once (1M+ live keys) —
-    # then cycle again so the timed phase is pure cache-hit steady state.
-    # NOTE on timing: block_until_ready does not actually round-trip on the
-    # tunneled axon platform, so every measurement below forces completion by
-    # fetching a scalar from the dependency chain, and throughput is derived
-    # from the SLOPE between a short and a long pipelined run (subtracting the
-    # fixed fetch RTT).
-    t0 = time.perf_counter()
-    for i in range(WARMUP):
-        table, resp, stats = decide(table, batches[i % N_STAGED])
-    _ = int(stats.cache_hits)
-    log(f"compile+warmup: {time.perf_counter() - t0:.1f}s")
-    for b in batches:
-        table, resp, stats = decide(table, b)
-    _ = int(stats.cache_hits)
+    headline = headline_case(rng, now).run()
+    matrix = {"parity_sweep_vs_xla": parity_ok}
+    for builder in (config1_case, config2_case, config4_case):
+        case = builder(rng, now)
+        res = case.run(dispatches=24, latency_probes=12)
+        if hasattr(case, "logical_batch"):
+            # throughput in *client decisions* (pre-aggregation) per second
+            scale = case.logical_batch / res["batch"]
+            res["client_decisions_per_sec"] = round(
+                res["decisions_per_sec"] * scale, 1
+            )
+        matrix[case.name] = res
 
-    def timed_run(n: int) -> float:
-        nonlocal table
-        t0 = time.perf_counter()
-        stats = None
-        for i in range(n):
-            table, resp, stats = decide(table, batches[i % N_STAGED])
-        _ = int(stats.cache_hits)  # forces the whole chain (donated table deps)
-        return time.perf_counter() - t0
-
-    timed_run(2)
-    n_short, n_long = 4, 4 + DISPATCHES
-    t_short = min(timed_run(n_short) for _ in range(3))
-    t_long = min(timed_run(n_long) for _ in range(3))
-    dt = max(t_long - t_short, 1e-9)
-    dps = DISPATCHES * BATCH / dt
-    per_dispatch_ms = dt / DISPATCHES * 1e3
-    log(
-        f"throughput (slope): {DISPATCHES} x {BATCH} decisions in {dt:.3f}s "
-        f"= {dps/1e6:.2f}M/s  ({per_dispatch_ms:.2f} ms/dispatch)"
-    )
-    log(f"fixed overhead (short run incl. fetch RTT): {t_short*1e3:.1f} ms")
-    log(f"stats sample: hits={int(stats.cache_hits)} miss={int(stats.cache_misses)}")
-
+    dps = headline["decisions_per_sec"]
+    matrix["headline-10M"] = headline
     print(
         json.dumps(
             {
                 "metric": "ratelimit_decisions_per_sec_per_chip",
-                "value": round(dps, 1),
+                "value": dps,
                 "unit": "decisions/s",
                 "vs_baseline": round(dps / PER_CHIP_BASELINE, 3),
+                "matrix": matrix,
             }
         )
     )

@@ -150,12 +150,21 @@ def main():
     timed("J: 15 separate flat i32 scatters", scatter_P_i32, planes_i32, slots, vals)
 
     # ---- K: full decide() for reference
-    from gubernator_tpu.ops.kernel import decide
-    from gubernator_tpu.ops.table import new_table
-    from bench import make_batches
+    from tests.oracle.kernel_v1 import decide
+    from tests.oracle.table_v1 import new_table
+    from bench import make_req_batch
 
     table = new_table(C)
-    batches = make_batches(np.random.default_rng(42), 1_700_000_000_000)
+    _rng = np.random.default_rng(42)
+    batches = [
+        jax.device_put(
+            make_req_batch(
+                _rng.integers(1, (1 << 63) - 1, size=1 << 17, dtype=np.int64),
+                1_700_000_000_000,
+            )
+        )
+        for _ in range(8)
+    ]
 
     def dec(i=[0]):
         pass

@@ -6,7 +6,7 @@ pick a worker shard (63-bit xxhash, reference workers.go:185-189). The TPU
 build collapses both into one 64-bit xxhash fingerprint computed host-side:
 
 * high bits select the owning device shard (parallel/, M3+);
-* `fp mod capacity` selects the HBM slot within a shard (ops/kernel.py).
+* `fp mod capacity` selects the HBM bucket within a shard (ops/kernel2.py).
 
 Strings never reach the device — only fingerprints do. fp == 0 is reserved as
 the empty-slot sentinel, so real fingerprints are remapped away from 0.

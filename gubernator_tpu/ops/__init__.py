@@ -1,5 +1,17 @@
-from gubernator_tpu.ops.table import Table, new_table
-from gubernator_tpu.ops.batch import ReqBatch, RespBatch, BatchStats
-from gubernator_tpu.ops.kernel import decide
+from gubernator_tpu.ops.table2 import Table2, new_table2, live_count2
+from gubernator_tpu.ops.batch import BatchStats, InstallBatch, ReqBatch, RespBatch
+from gubernator_tpu.ops.kernel2 import decide2, install2
+from gubernator_tpu.ops.engine import LocalEngine
 
-__all__ = ["Table", "new_table", "ReqBatch", "RespBatch", "BatchStats", "decide"]
+__all__ = [
+    "Table2",
+    "new_table2",
+    "live_count2",
+    "BatchStats",
+    "InstallBatch",
+    "ReqBatch",
+    "RespBatch",
+    "decide2",
+    "install2",
+    "LocalEngine",
+]

@@ -3,7 +3,7 @@
 A `ReqBatch` is the SoA form of a slice of RateLimitRequests after host-side
 resolution: strings → fingerprints, Gregorian durations → absolute expiries and
 interval lengths, leaky burst defaulting (burst==0 → limit, reference
-algorithms.go:259-261). The kernel (ops/kernel.py) requires all fingerprints
+algorithms.go:259-261). The kernel (ops/kernel2.py) requires all fingerprints
 within one batch to be distinct — the pass planner (ops/plan.py) guarantees
 that, reproducing the reference's per-key sequential semantics (the worker
 hash-ring serializes same-key requests, reference workers.go:185-189).
@@ -74,6 +74,21 @@ class BatchStats(NamedTuple):
     over_limit: jnp.ndarray  # int64 — rows answered OVER_LIMIT
     evicted_unexpired: jnp.ndarray  # int64 — live slots evicted for new keys
     dropped: jnp.ndarray  # int64 — rows that failed slot claiming
+
+
+class InstallBatch(NamedTuple):
+    """SoA of authoritative global statuses (one owner-broadcast entry per
+    row): what UpdatePeerGlobalsReq.Globals carries (reference peers.proto:50-73)."""
+
+    fp: jnp.ndarray  # int64
+    algo: jnp.ndarray  # int32
+    status: jnp.ndarray  # int32
+    limit: jnp.ndarray  # int64
+    remaining: jnp.ndarray  # int64
+    reset_time: jnp.ndarray  # int64
+    duration: jnp.ndarray  # int64
+    now: jnp.ndarray  # int64 (B,)
+    active: jnp.ndarray  # bool
 
 
 class HostBatch(NamedTuple):

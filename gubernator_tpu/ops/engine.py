@@ -13,24 +13,30 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
 import jax
 
 from gubernator_tpu.ops.batch import HostBatch, pack_requests, pad_batch, to_device
-from gubernator_tpu.ops.kernel import decide
 from gubernator_tpu.ops.kernel2 import decide2
 from gubernator_tpu.ops.plan import plan_passes
-from gubernator_tpu.ops.table import Table, new_table
-from gubernator_tpu.ops.table2 import new_table2
+from gubernator_tpu.ops.table2 import Table2, new_table2
 from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
+
+# Error surfaced for rows whose decision could never be persisted (claim
+# dropped after every retry). The reference never silently skips the cache
+# write; returning the computed answer without persisting it would hand out
+# free decisions under pathological contention.
+ERR_NOT_PERSISTED = "rate limit state could not be persisted (contended table); retry"
 
 
 def default_write_mode() -> str:
-    """Pallas sweep write on real TPU; XLA scatter on CPU (test meshes)."""
-    return "xla" if jax.default_backend() == "cpu" else "sweep"
+    """Pallas sweep write on real TPU; XLA scatter everywhere else (CPU test
+    meshes, and any backend without the TPU Pallas pipeline — e.g. GPU, where
+    the sweep kernel has never been lowered)."""
+    return "sweep" if jax.default_backend() == "tpu" else "xla"
 
 
 def ms_now() -> int:
@@ -67,34 +73,32 @@ class EngineStats:
 
 
 class LocalEngine:
-    """One device-resident rate-limit table + its dispatch loop."""
+    """One device-resident rate-limit table + its dispatch loop.
+
+    `decide_fn`/`table` injection exists for the differential test oracle
+    (tests/oracle/ keeps the v1 plane kernel); production always runs the v2
+    packed-row kernel (ops/kernel2.py).
+    """
 
     def __init__(
         self,
         capacity: int = 50_000,
-        probes: int = 8,
         max_exact_passes: int = 8,
-        kernel: int = 2,
         write_mode: Optional[str] = None,
+        decide_fn: Optional[Callable] = None,
+        table=None,
     ):
-        # `probes` is the bucket width K (the probe-window analog); the v2
-        # packed-row table is fixed at K=8 (one bucket per 128-lane row)
-        self.kernel = kernel
-        if kernel == 2:
-            self.table = new_table2(capacity)
-            self.write_mode = write_mode or default_write_mode()
-        else:
-            self.table = new_table(capacity, k=probes)
-            self.write_mode = "planes"
-        self.probes = probes
+        self.table = table if table is not None else new_table2(capacity)
+        self.write_mode = write_mode or default_write_mode()
+        self._decide_fn = decide_fn
         self.max_exact_passes = max_exact_passes
         self.max_claim_retries = 3
         self.stats = EngineStats()
 
     def _decide(self, rb):
-        if self.kernel == 2:
-            return decide2(self.table, rb, write=self.write_mode)
-        return decide(self.table, rb)
+        if self._decide_fn is not None:
+            return self._decide_fn(self.table, rb)
+        return decide2(self.table, rb, write=self.write_mode)
 
     def check(
         self,
@@ -116,13 +120,16 @@ class LocalEngine:
         for p in plan_passes(hb, max_exact=self.max_exact_passes):
             n = len(p.rows)
             batch = pad_batch(p.batch, _pad_size(n))
-            status, limit, remaining, reset = self._dispatch_with_retry(batch, n)
+            status, limit, remaining, reset, dropped = self._dispatch_with_retry(
+                batch, n
+            )
             for i in range(n):
                 r = RateLimitResponse(
                     status=int(status[i]),
                     limit=int(limit[i]),
                     remaining=int(remaining[i]),
                     reset_time=int(reset[i]),
+                    error=ERR_NOT_PERSISTED if dropped[i] else "",
                 )
                 if p.member_rows:
                     for row in p.member_rows[i]:
@@ -135,7 +142,8 @@ class LocalEngine:
     def _dispatch_with_retry(self, batch, n: int):
         """Run one unique-fp pass; rows the claim auction dropped (contended
         bucket within a single dispatch) are re-dispatched — the decision is
-        only authoritative once persisted."""
+        only authoritative once persisted. Rows still unpersisted after
+        `max_claim_retries` surface a per-item error (`ERR_NOT_PERSISTED`)."""
         rb = to_device(batch)
         self.table, resp, stats = self._decide(rb)
         self.stats.accumulate(stats, count_dropped=False)
@@ -165,4 +173,4 @@ class LocalEngine:
             retries += 1
         # only rows still unpersisted after retries count as dropped
         self.stats.dropped += int(dropped.sum())
-        return status, limit, remaining, reset
+        return status, limit, remaining, reset, dropped

@@ -102,6 +102,15 @@ def sweep_geometry(n_buckets: int, batch: int) -> Tuple[int, int]:
     knob, not correctness). BLK shrinks until the (BLK, U) one-hot operand
     fits VMEM comfortably."""
     blk = min(2048, n_buckets)
+    if n_buckets % blk:
+        # tables built by new_table2 are always conforming (power-of-two below
+        # 2048 buckets, multiple of 2048 above); a hand-built table with a
+        # non-dividing bucket count would leave tail rows outside the Pallas
+        # grid with undefined content under input_output_aliasing
+        raise ValueError(
+            f"n_buckets={n_buckets} not divisible by sweep block {blk}; "
+            "build tables with new_table2()"
+        )
     while True:
         nblk = n_buckets // blk
         mean = batch / nblk

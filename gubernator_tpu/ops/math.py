@@ -5,7 +5,7 @@ rows).
 This is the exact decision table of the reference's algorithms.go, expressed
 as masked vector arithmetic over per-row stored state + request fields. All
 file:line citations are /root/reference/algorithms.go unless noted. The
-deliberate divergences are documented in ops/kernel.py's module docstring.
+deliberate divergences are documented in ops/kernel2.py's module docstring.
 """
 
 from __future__ import annotations

@@ -40,8 +40,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from gubernator_tpu.ops.batch import HostBatch, ReqBatch, pack_requests, pad_batch
-from gubernator_tpu.ops.kernel import InstallBatch, decide_impl, install_impl
+from gubernator_tpu.ops.batch import HostBatch, InstallBatch, ReqBatch, pack_requests, pad_batch
+from gubernator_tpu.ops.kernel2 import decide2_impl, install2_impl
 from gubernator_tpu.ops.plan import plan_passes, _subset
 from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_of
 from gubernator_tpu.parallel.sharded import ShardedEngine, new_sharded_table
@@ -51,7 +51,7 @@ from gubernator_tpu.types import (
     RateLimitResponse,
     has_behavior,
 )
-from gubernator_tpu.ops.engine import _pad_size, ms_now
+from gubernator_tpu.ops.engine import ERR_NOT_PERSISTED, _pad_size, default_write_mode, ms_now
 
 
 @dataclass
@@ -69,6 +69,7 @@ class GlobalStats:
 def _mk_sync_step(mesh, n_shards: int, out_size: int):
     """Build the jitted collective sync step."""
     D = n_shards
+    write = default_write_mode()
     # sentinel OUTSIDE the fingerprint domain (real fps are in [1, 2^63-1],
     # hashing.py): non-owned/inactive outbox rows sort into their own leading
     # segment and can never merge with a real key's aggregation
@@ -116,7 +117,7 @@ def _mk_sync_step(mesh, n_shards: int, out_size: int):
             behavior=cfg.behavior | DRAIN | reset_bit,
             active=valid,
         )
-        primary, resp, stats = decide_impl(primary, agg)
+        primary, resp, stats = decide2_impl(primary, agg, write=write)
 
         # ---- stage 3: broadcast authoritative statuses (runBroadcasts analog)
         bc = InstallBatch(
@@ -135,7 +136,7 @@ def _mk_sync_step(mesh, n_shards: int, out_size: int):
         bc_owner = ((bc_flat.fp >> 32) % D).astype(jnp.int32)
         theirs = bc_flat.active & (bc_owner != me)
         inst = bc_flat._replace(active=theirs)
-        replica, installed = install_impl(replica, inst)
+        replica, installed = install2_impl(replica, inst, write=write)
 
         counters = jnp.stack(
             [
@@ -168,17 +169,15 @@ class GlobalShardedEngine(ShardedEngine):
         self,
         mesh,
         capacity_per_shard: int = 50_000,
-        probes: int = 8,
         max_exact_passes: int = 8,
         sync_out: int = 256,
     ):
         super().__init__(
             mesh,
             capacity_per_shard=capacity_per_shard,
-            probes=probes,
             max_exact_passes=max_exact_passes,
         )
-        self.replica = new_sharded_table(mesh, capacity_per_shard, k=probes)
+        self.replica = new_sharded_table(mesh, capacity_per_shard)
         self.sync_out = sync_out
         self.pending: List[Dict[int, dict]] = [dict() for _ in range(self.n_shards)]
         self._sync_step = _mk_sync_step(mesh, self.n_shards, sync_out)
@@ -277,7 +276,7 @@ class GlobalShardedEngine(ShardedEngine):
                 if home is not None
                 else None
             )
-            _, (status, limit, remaining, reset) = self._dispatch(
+            _, (status, limit, remaining, reset, dropped) = self._dispatch(
                 batch, shard=shard, table_attr=table_attr
             )
             for bi, orig in enumerate(p.rows):
@@ -286,6 +285,7 @@ class GlobalShardedEngine(ShardedEngine):
                     limit=int(limit[bi]),
                     remaining=int(remaining[bi]),
                     reset_time=int(reset[bi]),
+                    error=ERR_NOT_PERSISTED if dropped[bi] else "",
                 )
                 if p.member_rows:
                     for row in p.member_rows[bi]:
@@ -295,7 +295,17 @@ class GlobalShardedEngine(ShardedEngine):
 
     # ------------------------------------------------------------------- sync
     def sync(self, now_ms: Optional[int] = None) -> None:
-        """One collective hit-sync + broadcast round (the 100 ms tick)."""
+        """One sync tick: drain ALL pending hits, in as many collective
+        rounds as the fixed outbox size requires. The reference flushes its
+        queue on batch-limit OR timer and never leaves a backlog behind a tick
+        (global.go:125-151); a fixed one-round outbox would silently backlog
+        hot global keys beyond `sync_out`."""
+        self._sync_round(now_ms)
+        while any(self.pending):
+            self._sync_round(now_ms)
+
+    def _sync_round(self, now_ms: Optional[int] = None) -> None:
+        """One collective hit-sync + broadcast round."""
         now = now_ms if now_ms is not None else ms_now()
         OUT = self.sync_out
         boxes = []

@@ -8,9 +8,9 @@ owning shard, and one shard_map dispatch executes the decision kernel on all
 shards simultaneously — no forwarding hop, no N×N connection mesh; ICI does
 what gRPC did.
 
-Layout: every Table/ReqBatch/RespBatch leaf gains a leading (D,) device axis,
+Layout: every Table2/ReqBatch/RespBatch leaf gains a leading (D,) device axis,
 sharded with PartitionSpec("shard"). Inside shard_map each device sees its
-(1, …) block and runs decide_impl on its local slice independently —
+(1, …) block and runs decide2_impl on its local slice independently —
 embarrassingly parallel, exactly like the reference's share-nothing workers
 (workers.go:19-37) but across chips.
 """
@@ -26,10 +26,10 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gubernator_tpu.ops.batch import HostBatch, ReqBatch, pack_requests, pad_batch
-from gubernator_tpu.ops.kernel import decide_impl
-from gubernator_tpu.ops.engine import EngineStats, ms_now, _pad_size
+from gubernator_tpu.ops.kernel2 import decide2_impl
+from gubernator_tpu.ops.engine import ERR_NOT_PERSISTED, EngineStats, default_write_mode, ms_now, _pad_size
 from gubernator_tpu.ops.plan import plan_passes, _subset
-from gubernator_tpu.ops.table import Table, new_table
+from gubernator_tpu.ops.table2 import Table2, new_table2
 from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_of
 from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
 
@@ -39,13 +39,15 @@ def _stack_tree(trees):
 
 
 def make_sharded_decide(mesh: Mesh):
-    """Build the jitted all-shards decision step: (Table[D,·], ReqBatch[D,·])
-    → (Table', RespBatch[D,·], BatchStats[D])."""
+    """Build the jitted all-shards decision step: (Table2[D,·], ReqBatch[D,·])
+    → (Table2', RespBatch[D,·], BatchStats[D]). Write mode is resolved once at
+    build time (Pallas sweep on TPU, XLA scatter on CPU test meshes)."""
+    write = default_write_mode()
 
-    def per_device(table: Table, req: ReqBatch):
+    def per_device(table: Table2, req: ReqBatch):
         table = jax.tree.map(lambda x: x[0], table)
         req = jax.tree.map(lambda x: x[0], req)
-        table, resp, stats = decide_impl(table, req)
+        table, resp, stats = decide2_impl(table, req, write=write)
         expand = lambda t: jax.tree.map(lambda x: x[None], t)
         return expand(table), expand(resp), expand(stats)
 
@@ -56,10 +58,10 @@ def make_sharded_decide(mesh: Mesh):
     return jax.jit(fn, donate_argnums=(0,))
 
 
-def new_sharded_table(mesh: Mesh, capacity_per_shard: int, k: int = 8) -> Table:
-    """A (D, capacity) table placed shard-per-device."""
+def new_sharded_table(mesh: Mesh, capacity_per_shard: int) -> Table2:
+    """A (D, n_buckets, 128) packed-row table placed shard-per-device."""
     D = mesh.devices.size
-    local = new_table(capacity_per_shard, k=k)
+    local = new_table2(capacity_per_shard)
     stacked = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (D,) + x.shape), local)
     sharding = NamedSharding(mesh, P(SHARD_AXIS))
     return jax.tree.map(lambda x: jax.device_put(x, sharding), stacked)
@@ -77,12 +79,11 @@ class ShardedEngine:
         self,
         mesh: Mesh,
         capacity_per_shard: int = 50_000,
-        probes: int = 8,
         max_exact_passes: int = 8,
     ):
         self.mesh = mesh
         self.n_shards = int(mesh.devices.size)
-        self.table = new_sharded_table(mesh, capacity_per_shard, k=probes)
+        self.table = new_sharded_table(mesh, capacity_per_shard)
         self._decide = make_sharded_decide(mesh)
         self._batch_sharding = NamedSharding(mesh, P(SHARD_AXIS))
         self.max_exact_passes = max_exact_passes
@@ -103,13 +104,14 @@ class ShardedEngine:
                 out[i] = RateLimitResponse(error=err)
         for p in plan_passes(hb, max_exact=self.max_exact_passes):
             resp_rows, resp_vals = self._dispatch(p.batch)
-            status, limit, remaining, reset = resp_vals
+            status, limit, remaining, reset, dropped = resp_vals
             for bi, orig in enumerate(p.rows):
                 r = RateLimitResponse(
                     status=int(status[bi]),
                     limit=int(limit[bi]),
                     remaining=int(remaining[bi]),
                     reset_time=int(reset[bi]),
+                    error=ERR_NOT_PERSISTED if dropped[bi] else "",
                 )
                 if p.member_rows:
                     for row in p.member_rows[bi]:
@@ -179,7 +181,7 @@ class ShardedEngine:
         )
         if dropped.any() and depth < 3:
             rows = np.nonzero(dropped)[0]
-            _, (s2, l2, r2, t2) = self._dispatch(
+            _, (s2, l2, r2, t2, d2) = self._dispatch(
                 _subset(batch, rows),
                 depth=depth + 1,
                 shard=routed[rows] if shard is not None else None,
@@ -187,10 +189,14 @@ class ShardedEngine:
             )
             status = status.copy(); limit = limit.copy()
             remaining = remaining.copy(); reset = reset.copy()
+            dropped = dropped.copy()
             status[rows], limit[rows], remaining[rows], reset[rows] = s2, l2, r2, t2
+            dropped[rows] = d2
         elif dropped.any():
+            # exhausted retries: decision was never persisted — callers
+            # surface ERR_NOT_PERSISTED per item instead of failing open
             self.stats.dropped += int(dropped.sum())
-        return np.arange(n), (status, limit, remaining, reset)
+        return np.arange(n), (status, limit, remaining, reset, dropped)
 
 
 def _to_grid(field: np.ndarray, shard_sorted, offset, D: int, b_local: int) -> np.ndarray:

@@ -62,9 +62,9 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from gubernator_tpu.ops.batch import BatchStats, ReqBatch, RespBatch
+from gubernator_tpu.ops.batch import BatchStats, InstallBatch, ReqBatch, RespBatch
 from gubernator_tpu.ops.math import StoredState, bucket_math
-from gubernator_tpu.ops.table import EXPC_SHIFT, Table
+from tests.oracle.table_v1 import EXPC_SHIFT, Table
 from gubernator_tpu.types import Algorithm, Behavior, Status
 
 _CLAIM_ROUNDS = 2  # bidding rounds; engine retries dropped rows host-side
@@ -329,21 +329,6 @@ def install_impl(table: Table, inst: "InstallBatch") -> Tuple[Table, jnp.ndarray
         ),
     )
     return table, active & resolved
-
-
-class InstallBatch(NamedTuple):
-    """SoA of authoritative global statuses (one owner-broadcast entry per
-    row): what UpdatePeerGlobalsReq.Globals carries (reference peers.proto:50-73)."""
-
-    fp: jnp.ndarray  # int64
-    algo: jnp.ndarray  # int32
-    status: jnp.ndarray  # int32
-    limit: jnp.ndarray  # int64
-    remaining: jnp.ndarray  # int64
-    reset_time: jnp.ndarray  # int64
-    duration: jnp.ndarray  # int64
-    now: jnp.ndarray  # int64 (B,)
-    active: jnp.ndarray  # bool
 
 
 install = partial(jax.jit, donate_argnums=(0,))(install_impl)

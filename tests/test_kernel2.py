@@ -1,10 +1,10 @@
 """v2 kernel validation: differential vs the v1 oracle + sweep-write parity.
 
-The v1 kernel (ops/kernel.py) carries the reference-semantics test burden
-(test_token_bucket / test_leaky_bucket run the engine, now v2 by default, and
-were originally written against v1). Here v2 is additionally checked
-*differentially* against v1 on randomized traffic, and the Pallas sweep write
-is checked bit-exact against the XLA scatter write (interpret mode on CPU).
+The v1 plane kernel now lives under tests/oracle/ purely as a differential
+oracle (it was the original implementation the reference-semantics tests were
+written against). v2 is checked against it on randomized traffic, and the
+Pallas sweep write is checked bit-exact against the XLA scatter write
+(interpret mode on CPU).
 """
 
 import numpy as np
@@ -12,6 +12,7 @@ import pytest
 
 from gubernator_tpu.ops.engine import LocalEngine
 from gubernator_tpu.ops.table2 import live_count2
+from tests.oracle import v1_engine
 from gubernator_tpu.types import (
     Algorithm,
     Behavior,
@@ -55,8 +56,8 @@ def test_v2_matches_v1_on_random_traffic(seed):
     enough that eviction never triggers (eviction ordering legitimately
     differs: v1 probes coarse expiry, v2 exact — see kernel2 docstring)."""
     rng = np.random.default_rng(seed)
-    e1 = LocalEngine(capacity=4096, kernel=1)
-    e2 = LocalEngine(capacity=4096, kernel=2)
+    e1 = v1_engine(capacity=4096)
+    e2 = LocalEngine(capacity=4096)
     now = NOW
     for step in range(6):
         reqs = random_requests(rng, 64, keyspace=40, now=now)
@@ -80,8 +81,8 @@ def test_sweep_write_matches_xla_write():
     """The Pallas sweep (interpret mode on CPU) must produce a bit-identical
     table to the XLA scatter write."""
     rng = np.random.default_rng(7)
-    ex = LocalEngine(capacity=4096, kernel=2, write_mode="xla")
-    es = LocalEngine(capacity=4096, kernel=2, write_mode="sweep")
+    ex = LocalEngine(capacity=4096, write_mode="xla")
+    es = LocalEngine(capacity=4096, write_mode="sweep")
     now = NOW
     for _ in range(3):
         reqs = random_requests(rng, 48, keyspace=60, now=now)
@@ -101,7 +102,7 @@ def test_v2_bucket_overflow_evicts_soonest_expiring():
     """9 keys forced into one bucket of 8 lanes: the 9th insert evicts the
     soonest-expiring live slot (expiry-stamp eviction, reference
     lrucache.go:138-149) and the alarm counter fires."""
-    eng = LocalEngine(capacity=8, kernel=2)  # single-bucket table (NB=8... )
+    eng = LocalEngine(capacity=8)  # single-bucket table (NB=8... )
     # NB is rounded to >=8 buckets; pick keys that all land in bucket 0
     from gubernator_tpu.hashing import fingerprint
 
@@ -167,7 +168,7 @@ def test_v2_bucket_overflow_evicts_soonest_expiring():
 
 
 def test_v2_live_count_and_expiry():
-    eng = LocalEngine(capacity=1024, kernel=2)
+    eng = LocalEngine(capacity=1024)
     now = NOW
     reqs = [
         RateLimitRequest(

@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 
 from gubernator_tpu.ops.engine import LocalEngine
-from gubernator_tpu.ops.table import live_count
+from gubernator_tpu.ops.table2 import live_count2 as live_count
 from gubernator_tpu.types import RateLimitRequest, Status, MINUTE, SECOND
 
 
@@ -71,7 +71,7 @@ def test_colliding_keys_coexist_via_probing(frozen_now):
     # with capacity C, keys whose fingerprints share fp % C land in the same
     # probe window; linear probing must keep them all live. Use a tiny table
     # and enough keys that collisions are guaranteed.
-    eng = LocalEngine(capacity=16, probes=8)
+    eng = LocalEngine(capacity=16)
     t = frozen_now
     keys = [f"c{i}" for i in range(12)]
     for k in keys:
