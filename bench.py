@@ -113,6 +113,10 @@ class Case:
         _ = int(stats.cache_hits)
         log(f"[{self.name}] compile+seed: {time.perf_counter() - t0:.1f}s")
         n = len(self.batches)
+        # small batches dispatch in ~µs — scale the dispatch count up so the
+        # timed work dwarfs tunnel RTT jitter, or the slope is pure noise
+        batch_rows = int(self.batches[0].fp.shape[0])
+        dispatches = min(4096, max(dispatches, dispatches * ((1 << 17) // batch_rows)))
 
         def timed_run(k: int):
             t0 = time.perf_counter()

@@ -108,6 +108,177 @@ class HostBatch(NamedTuple):
     active: np.ndarray
 
 
+# ---------------------------------------------------------------- columns path
+#
+# The serving hot path (service/ front door, bench e2e) avoids per-request
+# Python objects entirely: requests arrive as parallel columns (numpy arrays +
+# one fingerprint pass over the key strings) and resolution/validation is
+# vectorized. The object API (pack_requests below) is a thin wrapper kept for
+# tests and embedding use.
+
+ERR_OK = 0
+ERR_EMPTY_KEY = 1
+ERR_EMPTY_NAME = 2
+ERR_LIMIT_I32 = 3
+ERR_BURST_I32 = 4
+ERR_GREGORIAN = 5
+ERR_DROPPED = 6
+
+# wording parity with the reference where it has fixed strings
+# (gubernator.go:215-224); ERR_DROPPED is this design's own failure mode
+ERROR_STRINGS = {
+    ERR_OK: "",
+    ERR_EMPTY_KEY: "field 'unique_key' cannot be empty",
+    ERR_EMPTY_NAME: "field 'namespace' cannot be empty",
+    ERR_LIMIT_I32: "field 'limit' must fit int32",
+    ERR_BURST_I32: "field 'burst' must fit int32",
+    ERR_GREGORIAN: "invalid gregorian duration",
+    ERR_DROPPED: "rate limit state could not be persisted (contended table); retry",
+}
+
+
+class RequestColumns(NamedTuple):
+    """Column-oriented request batch (pre-fingerprinted). `created_at == 0`
+    means unset (stamped with ingress now, reference gubernator.go:225-227);
+    `err` carries fingerprint-stage validation codes."""
+
+    fp: np.ndarray  # int64; 0 where err != 0
+    algo: np.ndarray  # int32
+    behavior: np.ndarray  # int32
+    hits: np.ndarray  # int64
+    limit: np.ndarray  # int64
+    burst: np.ndarray  # int64 (raw; 0 → limit resolved for leaky in pack)
+    duration: np.ndarray  # int64
+    created_at: np.ndarray  # int64; 0 = unset
+    err: np.ndarray  # int8 error codes (ERR_*)
+
+
+def fingerprint_columns(names, keys) -> "tuple[np.ndarray, np.ndarray]":
+    """Fingerprint parallel name/key string sequences; returns (fp, err).
+    The per-item xxhash call is the one irreducible Python loop on the ingress
+    path (native/ replaces it with a C pass when built)."""
+    n = len(names)
+    fp = np.zeros(n, dtype=np.int64)
+    err = np.zeros(n, dtype=np.int8)
+    for i in range(n):
+        k = keys[i]
+        nm = names[i]
+        if k == "":
+            err[i] = ERR_EMPTY_KEY
+        elif nm == "":
+            err[i] = ERR_EMPTY_NAME
+        else:
+            fp[i] = fingerprint(nm, k)
+    return fp, err
+
+
+def pack_columns(
+    cols: RequestColumns, now_ms: int
+) -> "tuple[HostBatch, np.ndarray]":
+    """Vectorized resolution of a RequestColumns batch into a HostBatch.
+    Mirrors pack_requests() semantics exactly (validation, created_at
+    clamping, leaky burst defaulting, Gregorian resolution); returns
+    (batch, err_codes)."""
+    n = cols.fp.shape[0]
+    err = cols.err.copy()
+    ok = err == ERR_OK
+    bad_limit = ok & ((cols.limit > INT32_MAX) | (cols.limit < -INT32_MAX))
+    err[bad_limit] = ERR_LIMIT_I32
+    bad_burst = (err == ERR_OK) & (
+        (cols.burst > INT32_MAX) | (cols.burst < -INT32_MAX)
+    )
+    err[bad_burst] = ERR_BURST_I32
+
+    created = np.where(cols.created_at == 0, now_ms, cols.created_at)
+    created = np.clip(
+        created, now_ms - CREATED_AT_TOLERANCE_MS, now_ms + CREATED_AT_TOLERANCE_MS
+    )
+    leaky = cols.algo == int(Algorithm.LEAKY_BUCKET)
+    burst = np.where(leaky & (cols.burst == 0), cols.limit, cols.burst)
+
+    expire_new = created + cols.duration
+    greg_interval = np.zeros(n, dtype=np.int64)
+    duration_eff = cols.duration.astype(np.int64).copy()
+    greg_rows = (cols.behavior & int(Behavior.DURATION_IS_GREGORIAN)) != 0
+    if greg_rows.any():
+        # Gregorian durations are an enum (≤6 distinct values) and the whole
+        # batch shares one `now` — resolve once per distinct enum value
+        for val in np.unique(cols.duration[greg_rows]):
+            rows = greg_rows & (cols.duration == val)
+            try:
+                expire = gregorian.gregorian_expiration(now_ms, int(val))
+                interval = gregorian.gregorian_duration(now_ms, int(val))
+            except gregorian.GregorianError:
+                err[rows & (err == ERR_OK)] = ERR_GREGORIAN
+                continue
+            expire_new[rows] = expire
+            greg_interval[rows] = interval
+            duration_eff[rows] = expire - now_ms
+
+    active = err == ERR_OK
+    b = HostBatch(
+        fp=np.where(active, cols.fp, 0),
+        algo=cols.algo.astype(np.int32),
+        behavior=cols.behavior.astype(np.int32),
+        hits=cols.hits.astype(np.int64),
+        limit=cols.limit.astype(np.int64),
+        burst=burst.astype(np.int64),
+        duration=cols.duration.astype(np.int64),
+        created_at=created.astype(np.int64),
+        expire_new=expire_new.astype(np.int64),
+        greg_interval=greg_interval,
+        duration_eff=duration_eff,
+        active=active,
+    )
+    return b, err
+
+
+class ResponseColumns(NamedTuple):
+    """Column-oriented responses, request order. `err` uses ERR_* codes;
+    ERROR_STRINGS maps them to the wire strings."""
+
+    status: np.ndarray  # int32
+    limit: np.ndarray  # int64
+    remaining: np.ndarray  # int64
+    reset_time: np.ndarray  # int64
+    err: np.ndarray  # int8
+
+
+def columns_from_requests(
+    requests: Sequence[RateLimitRequest],
+) -> RequestColumns:
+    """Object → columns edge conversion (per-item loop lives here only)."""
+    n = len(requests)
+    fp = np.zeros(n, dtype=np.int64)
+    err = np.zeros(n, dtype=np.int8)
+    algo = np.zeros(n, dtype=np.int32)
+    behavior = np.zeros(n, dtype=np.int32)
+    hits = np.zeros(n, dtype=np.int64)
+    limit = np.zeros(n, dtype=np.int64)
+    burst = np.zeros(n, dtype=np.int64)
+    duration = np.zeros(n, dtype=np.int64)
+    created_at = np.zeros(n, dtype=np.int64)
+    for i, r in enumerate(requests):
+        if r.unique_key == "":
+            err[i] = ERR_EMPTY_KEY
+            continue
+        if r.name == "":
+            err[i] = ERR_EMPTY_NAME
+            continue
+        fp[i] = fingerprint(r.name, r.unique_key)
+        algo[i] = int(r.algorithm)
+        behavior[i] = int(r.behavior)
+        hits[i] = r.hits
+        limit[i] = min(max(r.limit, -(2**62)), 2**62)  # pre-clip to avoid int64 overflow
+        burst[i] = min(max(r.burst, -(2**62)), 2**62)
+        duration[i] = r.duration
+        created_at[i] = r.created_at if r.created_at else 0
+    return RequestColumns(
+        fp=fp, algo=algo, behavior=behavior, hits=hits, limit=limit,
+        burst=burst, duration=duration, created_at=created_at, err=err,
+    )
+
+
 def pack_requests(
     requests: Sequence[RateLimitRequest],
     now_ms: int,

@@ -19,7 +19,17 @@ import numpy as np
 
 import jax
 
-from gubernator_tpu.ops.batch import HostBatch, pack_requests, pad_batch, to_device
+from gubernator_tpu.ops.batch import (
+    ERR_DROPPED,
+    ERROR_STRINGS,
+    HostBatch,
+    RequestColumns,
+    ResponseColumns,
+    columns_from_requests,
+    pack_columns,
+    pad_batch,
+    to_device,
+)
 from gubernator_tpu.ops.kernel2 import decide2
 from gubernator_tpu.ops.plan import plan_passes
 from gubernator_tpu.ops.table2 import Table2, new_table2
@@ -106,38 +116,65 @@ class LocalEngine:
         now_ms: Optional[int] = None,
     ) -> List[RateLimitResponse]:
         """Apply a batch; responses come back in request order (the API
-        contract, reference gubernator.proto:58-61)."""
+        contract, reference gubernator.proto:58-61). Object-API wrapper over
+        the columns fast path."""
         if not requests:
             return []
-        now = now_ms if now_ms is not None else ms_now()
-        hb, errors = pack_requests(requests, now)
-        out: List[Optional[RateLimitResponse]] = [None] * len(requests)
-        # invalid items answer with a per-request error instead of failing the
-        # batch (reference gubernator.go:215-237)
-        for i, err in enumerate(errors):
-            if err is not None:
-                out[i] = RateLimitResponse(error=err)
-        for p in plan_passes(hb, max_exact=self.max_exact_passes):
-            n = len(p.rows)
-            batch = pad_batch(p.batch, _pad_size(n))
-            status, limit, remaining, reset, dropped = self._dispatch_with_retry(
-                batch, n
+        cols = columns_from_requests(requests)
+        rc = self.check_columns(cols, now_ms=now_ms)
+        return [
+            RateLimitResponse(
+                status=int(rc.status[i]),
+                limit=int(rc.limit[i]),
+                remaining=int(rc.remaining[i]),
+                reset_time=int(rc.reset_time[i]),
+                error=ERROR_STRINGS[int(rc.err[i])],
             )
-            for i in range(n):
-                r = RateLimitResponse(
-                    status=int(status[i]),
-                    limit=int(limit[i]),
-                    remaining=int(remaining[i]),
-                    reset_time=int(reset[i]),
-                    error=ERR_NOT_PERSISTED if dropped[i] else "",
+            for i in range(len(requests))
+        ]
+
+    def check_columns(
+        self,
+        cols: RequestColumns,
+        now_ms: Optional[int] = None,
+    ) -> ResponseColumns:
+        """Vectorized serving path: columns in, columns out (request order).
+        Per-request validation errors come back as ERR_* codes instead of
+        failing the batch (reference gubernator.go:215-237)."""
+        now = now_ms if now_ms is not None else ms_now()
+        hb, err = pack_columns(cols, now)
+        n = hb.fp.shape[0]
+        status = np.zeros(n, dtype=np.int32)
+        limit_o = np.zeros(n, dtype=np.int64)
+        remaining = np.zeros(n, dtype=np.int64)
+        reset = np.zeros(n, dtype=np.int64)
+        for p in plan_passes(hb, max_exact=self.max_exact_passes):
+            np_ = len(p.rows)
+            batch = pad_batch(p.batch, _pad_size(np_))
+            s, l, r, t, dropped = self._dispatch_with_retry(batch, np_)
+            if p.member_rows:
+                # fan the aggregate's response out to every member row
+                members = np.concatenate(p.member_rows)
+                src = np.repeat(
+                    np.arange(np_), [len(m) for m in p.member_rows]
                 )
-                if p.member_rows:
-                    for row in p.member_rows[i]:
-                        out[int(row)] = r
-                else:
-                    out[int(p.rows[i])] = r
-        self.stats.checks += len(requests)
-        return out  # type: ignore[return-value]
+                status[members] = s[src]
+                limit_o[members] = l[src]
+                remaining[members] = r[src]
+                reset[members] = t[src]
+                err[members[dropped[src]]] = ERR_DROPPED
+            else:
+                rows = p.rows
+                status[rows] = s
+                limit_o[rows] = l
+                remaining[rows] = r
+                reset[rows] = t
+                err[rows[dropped]] = ERR_DROPPED
+        self.stats.checks += n
+        return ResponseColumns(
+            status=status, limit=limit_o, remaining=remaining,
+            reset_time=reset, err=err,
+        )
 
     def _dispatch_with_retry(self, batch, n: int):
         """Run one unique-fp pass; rows the claim auction dropped (contended
