@@ -1,0 +1,97 @@
+"""Client library — dial helper + typed client for the V1 service.
+
+The analog of the reference's Go client helpers (reference client.go:44-105)
+plus its Python client's role (python/gubernator). Builds raw grpc.aio unary
+calls over the repo pb2 messages, so no generated service stubs are required.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Sequence, Union
+
+import grpc
+
+from gubernator_tpu.proto import gubernator_pb2 as pb
+from gubernator_tpu.types import RateLimitRequest
+
+GET_RATE_LIMITS = "/pb.gubernator.V1/GetRateLimits"
+HEALTH_CHECK = "/pb.gubernator.V1/HealthCheck"
+LIVE_CHECK = "/pb.gubernator.V1/LiveCheck"
+
+
+def to_pb(r: Union[RateLimitRequest, Dict, "pb.RateLimitReq"]) -> "pb.RateLimitReq":
+    if isinstance(r, pb.RateLimitReq):
+        return r
+    if isinstance(r, dict):
+        return pb.RateLimitReq(**r)
+    msg = pb.RateLimitReq(
+        name=r.name,
+        unique_key=r.unique_key,
+        hits=r.hits,
+        limit=r.limit,
+        duration=r.duration,
+        algorithm=int(r.algorithm),
+        behavior=int(r.behavior),
+        burst=r.burst,
+    )
+    if r.created_at:
+        msg.created_at = r.created_at
+    if r.metadata:
+        for k, v in r.metadata.items():
+            msg.metadata[k] = v
+    return msg
+
+
+class V1Client:
+    """Async client for one daemon (DialV1Server analog, client.go:44-66)."""
+
+    def __init__(
+        self,
+        address: str,
+        credentials: Optional[grpc.ChannelCredentials] = None,
+        timeout_s: float = 5.0,
+    ):
+        self.address = address
+        self.timeout_s = timeout_s
+        if credentials is not None:
+            self._channel = grpc.aio.secure_channel(address, credentials)
+        else:
+            self._channel = grpc.aio.insecure_channel(address)
+
+    async def get_rate_limits(
+        self,
+        requests: Sequence[Union[RateLimitRequest, Dict, "pb.RateLimitReq"]],
+        timeout_s: Optional[float] = None,
+    ) -> "pb.GetRateLimitsResp":
+        call = self._channel.unary_unary(
+            GET_RATE_LIMITS,
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.GetRateLimitsResp.FromString,
+        )
+        req = pb.GetRateLimitsReq(requests=[to_pb(r) for r in requests])
+        return await call(req, timeout=timeout_s or self.timeout_s)
+
+    async def health_check(self, timeout_s: Optional[float] = None) -> "pb.HealthCheckResp":
+        call = self._channel.unary_unary(
+            HEALTH_CHECK,
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.HealthCheckResp.FromString,
+        )
+        return await call(pb.HealthCheckReq(), timeout=timeout_s or self.timeout_s)
+
+    async def live_check(self, timeout_s: Optional[float] = None) -> "pb.LiveCheckResp":
+        call = self._channel.unary_unary(
+            LIVE_CHECK,
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.LiveCheckResp.FromString,
+        )
+        return await call(pb.LiveCheckReq(), timeout=timeout_s or self.timeout_s)
+
+    async def close(self) -> None:
+        await self._channel.close()
+
+
+def random_peer(peers: List[str]) -> str:
+    """reference client.go RandomPeer."""
+    return random.choice(peers)

@@ -1,0 +1,218 @@
+"""Daemon configuration: GUBER_* environment variables + optional config file.
+
+Mirrors the reference's env-driven config system (reference config.go:302-547):
+every knob is a `GUBER_*` env var, optionally seeded from a `key=value` file
+(reference config.go:703-726 loads the file INTO the environment first, so env
+set by the file and real env resolve through one path). Defaults match the
+reference's (reference config.go:137-158) where a counterpart exists.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import re
+import socket
+import string
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+class ConfigError(ValueError):
+    """Invalid configuration — message says which key and why (the reference
+    returns actionable errors from SetupDaemonConfig, config.go:359-363)."""
+
+
+def load_config_file(path: str, env: Optional[Dict[str, str]] = None) -> None:
+    """Parse a `key=value` file and set the pairs into the environment
+    (reference config.go:703-726: `fromEnvFile`). Lines starting with # and
+    blank lines are ignored; existing env vars are NOT overridden (real env
+    wins, same as the reference)."""
+    env_map = os.environ if env is None else env
+    with open(path) as f:
+        for ln, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "=" not in line:
+                raise ConfigError(f"{path}:{ln}: expected key=value, got {line!r}")
+            k, _, v = line.partition("=")
+            k, v = k.strip(), v.strip()
+            if k and k not in env_map:
+                env_map[k] = v
+
+
+def _get(env, key: str, default: str = "") -> str:
+    return env.get(key, default)
+
+
+def _get_int(env, key: str, default: int) -> int:
+    raw = env.get(key)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ConfigError(f"{key}: expected integer, got {raw!r}")
+
+
+def _get_float_ms(env, key: str, default_ms: float) -> float:
+    """Duration in milliseconds (reference uses Go durations; we accept a
+    plain number = ms, or with a s/ms/us suffix)."""
+    raw = env.get(key)
+    if raw is None or raw == "":
+        return default_ms
+    m = re.fullmatch(r"\s*([0-9.]+)\s*(us|ms|s|m)?\s*", raw)
+    if not m:
+        raise ConfigError(f"{key}: expected duration, got {raw!r}")
+    val = float(m.group(1))
+    unit = m.group(2) or "ms"
+    return val * {"us": 1e-3, "ms": 1.0, "s": 1e3, "m": 60e3}[unit]
+
+
+def _get_bool(env, key: str, default: bool = False) -> bool:
+    raw = env.get(key, "")
+    if raw == "":
+        return default
+    return raw.lower() in ("1", "true", "yes", "on")
+
+
+def instance_id(env=None) -> str:
+    """Stable-ish instance id: env override or random tag (reference
+    config.go:746-783 also tries the docker cgroup; not meaningful here)."""
+    env = os.environ if env is None else env
+    iid = env.get("GUBER_INSTANCE_ID", "")
+    if iid:
+        return iid
+    return "".join(random.choices(string.hexdigits.lower(), k=12))
+
+
+@dataclass
+class BehaviorConfig:
+    """Batching / GLOBAL cadence knobs (reference config.go:49-70; defaults
+    config.go:137-146)."""
+
+    batch_timeout_ms: float = 500.0  # forwarding RPC timeout (BatchTimeout 500ms)
+    batch_wait_ms: float = 0.5  # coalescing window (BatchWait 500µs)
+    batch_limit: int = 1000  # max items per forwarded batch (BatchLimit)
+
+    global_timeout_ms: float = 500.0  # GLOBAL rpc timeout (GlobalTimeout)
+    global_sync_wait_ms: float = 100.0  # hit-sync cadence (GlobalSyncWait)
+    global_batch_limit: int = 1000  # GlobalBatchLimit
+    global_peer_concurrency: int = 100  # GlobalPeerRequestsConcurrency
+
+    force_global: bool = False  # reference config.go:65-66
+
+
+@dataclass
+class DaemonConfig:
+    """Everything a daemon needs to boot (reference DaemonConfig,
+    config.go:197-284)."""
+
+    grpc_address: str = "localhost:1051"
+    http_address: str = "localhost:1050"
+    advertise_address: str = ""  # defaults to grpc_address
+    data_center: str = ""
+    instance_id: str = ""
+
+    cache_size: int = 50_000  # CacheSize (config.go:151) → table capacity
+    engine: str = "local"  # "local" (one device) | "sharded" (mesh)
+    workers: int = 0  # 0 = auto; host-side executor width
+
+    behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
+
+    # peer discovery (reference config.go:359-363: {none, dns, k8s, etcd,
+    # member-list}; TPU build implements none + dns, the set the reference
+    # test-suite itself relies on)
+    peer_discovery_type: str = "none"
+    dns_fqdn: str = ""
+    dns_poll_ms: float = 5_000.0
+
+    # TLS (reference tls.go); empty = plaintext
+    tls_ca_file: str = ""
+    tls_cert_file: str = ""
+    tls_key_file: str = ""
+    tls_auto: bool = False  # auto self-signed CA + cert (AutoTLS)
+    tls_client_auth: str = ""  # "", "require", "verify"
+
+    # checkpoint/resume (SURVEY §5.4): snapshot file for the Loader hook
+    checkpoint_path: str = ""
+
+    log_level: str = "info"
+    metric_flags: str = ""
+
+    def __post_init__(self):
+        if not self.advertise_address:
+            self.advertise_address = self.grpc_address
+        if not self.instance_id:
+            self.instance_id = instance_id()
+
+    def validate(self) -> None:
+        if self.peer_discovery_type not in ("none", "dns"):
+            raise ConfigError(
+                f"GUBER_PEER_DISCOVERY_TYPE: unknown type "
+                f"{self.peer_discovery_type!r}; must be one of: none, dns "
+                "(k8s/etcd/member-list are not implemented in the TPU build)"
+            )
+        if self.peer_discovery_type == "dns" and not self.dns_fqdn:
+            raise ConfigError("GUBER_DNS_FQDN is required when GUBER_PEER_DISCOVERY_TYPE=dns")
+        if self.engine not in ("local", "sharded"):
+            raise ConfigError(f"GUBER_ENGINE: must be local or sharded, got {self.engine!r}")
+        if self.cache_size <= 0:
+            raise ConfigError("GUBER_CACHE_SIZE must be positive")
+        if self.behaviors.batch_limit <= 0 or self.behaviors.batch_limit > 1000:
+            # the reference hard-caps batches at 1000 (gubernator.go:41-42)
+            raise ConfigError("GUBER_BATCH_LIMIT must be in (0, 1000]")
+        if self.tls_client_auth not in ("", "require", "verify"):
+            raise ConfigError("GUBER_TLS_CLIENT_AUTH must be require or verify")
+
+
+def setup_daemon_config(
+    config_file: str = "", env: Optional[Dict[str, str]] = None
+) -> DaemonConfig:
+    """Build a validated DaemonConfig from env (+ optional file), the analog of
+    SetupDaemonConfig (reference config.go:302-547)."""
+    env = dict(os.environ) if env is None else env
+    if config_file:
+        load_config_file(config_file, env)
+
+    host = socket.gethostname() or "localhost"
+    conf = DaemonConfig(
+        grpc_address=_get(env, "GUBER_GRPC_ADDRESS", "localhost:1051"),
+        http_address=_get(env, "GUBER_HTTP_ADDRESS", "localhost:1050"),
+        advertise_address=_get(env, "GUBER_ADVERTISE_ADDRESS", ""),
+        data_center=_get(env, "GUBER_DATA_CENTER", ""),
+        instance_id=_get(env, "GUBER_INSTANCE_ID", ""),
+        cache_size=_get_int(env, "GUBER_CACHE_SIZE", 50_000),
+        engine=_get(env, "GUBER_ENGINE", "local"),
+        workers=_get_int(env, "GUBER_WORKER_COUNT", 0),
+        behaviors=BehaviorConfig(
+            batch_timeout_ms=_get_float_ms(env, "GUBER_BATCH_TIMEOUT", 500.0),
+            batch_wait_ms=_get_float_ms(env, "GUBER_BATCH_WAIT", 0.5),
+            batch_limit=_get_int(env, "GUBER_BATCH_LIMIT", 1000),
+            global_timeout_ms=_get_float_ms(env, "GUBER_GLOBAL_TIMEOUT", 500.0),
+            global_sync_wait_ms=_get_float_ms(env, "GUBER_GLOBAL_SYNC_WAIT", 100.0),
+            global_batch_limit=_get_int(env, "GUBER_GLOBAL_BATCH_LIMIT", 1000),
+            global_peer_concurrency=_get_int(
+                env, "GUBER_GLOBAL_PEER_CONCURRENCY", 100
+            ),
+            force_global=_get_bool(env, "GUBER_FORCE_GLOBAL", False),
+        ),
+        peer_discovery_type=_get(env, "GUBER_PEER_DISCOVERY_TYPE", "none"),
+        dns_fqdn=_get(env, "GUBER_DNS_FQDN", ""),
+        dns_poll_ms=_get_float_ms(env, "GUBER_DNS_POLL", 5_000.0),
+        tls_ca_file=_get(env, "GUBER_TLS_CA", ""),
+        tls_cert_file=_get(env, "GUBER_TLS_CERT", ""),
+        tls_key_file=_get(env, "GUBER_TLS_KEY", ""),
+        tls_auto=_get_bool(env, "GUBER_TLS_AUTO", False),
+        tls_client_auth=_get(env, "GUBER_TLS_CLIENT_AUTH", ""),
+        checkpoint_path=_get(env, "GUBER_CHECKPOINT_PATH", ""),
+        log_level=_get(env, "GUBER_LOG_LEVEL", "info"),
+        metric_flags=_get(env, "GUBER_METRIC_FLAGS", ""),
+    )
+    # hostname convenience: GUBER_GRPC_ADDRESS=:1051 binds all interfaces but
+    # advertises the hostname (reference net.go ResolveHostIP analog)
+    if conf.advertise_address.startswith(":"):
+        conf.advertise_address = f"{host}{conf.advertise_address}"
+    conf.validate()
+    return conf

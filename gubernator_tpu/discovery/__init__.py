@@ -1,0 +1,8 @@
+"""Peer discovery pools: "none" (explicit set_peers, the test-cluster mode,
+reference daemon.go:258-262) and DNS polling (dns.py). The reference's etcd /
+k8s / memberlist pools depend on infrastructure clients that are out of scope
+for the TPU build; DNS + none cover its own test suite's needs."""
+
+from gubernator_tpu.discovery.dns import DNSPool, system_resolver
+
+__all__ = ["DNSPool", "system_resolver"]

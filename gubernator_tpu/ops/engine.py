@@ -21,6 +21,7 @@ import jax
 
 from gubernator_tpu.ops.batch import (
     ERR_DROPPED,
+    InstallBatch,
     ERROR_STRINGS,
     HostBatch,
     RequestColumns,
@@ -30,7 +31,7 @@ from gubernator_tpu.ops.batch import (
     pad_batch,
     to_device,
 )
-from gubernator_tpu.ops.kernel2 import decide2
+from gubernator_tpu.ops.kernel2 import decide2, install2
 from gubernator_tpu.ops.plan import plan_passes
 from gubernator_tpu.ops.table2 import Table2, new_table2
 from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
@@ -211,3 +212,73 @@ class LocalEngine:
         # only rows still unpersisted after retries count as dropped
         self.stats.dropped += int(dropped.sum())
         return status, limit, remaining, reset, dropped
+
+    # ------------------------------------------------------------ peer plane
+
+    def install_columns(
+        self,
+        fp: np.ndarray,
+        algo: np.ndarray,
+        status: np.ndarray,
+        limit: np.ndarray,
+        remaining: np.ndarray,
+        reset_time: np.ndarray,
+        duration: np.ndarray,
+        now_ms: Optional[int] = None,
+    ) -> int:
+        """Install owner-authoritative GLOBAL statuses as fresh items — the
+        UpdatePeerGlobals receive path (reference gubernator.go:434-474).
+        Returns the number installed."""
+        if self._decide_fn is not None:
+            raise RuntimeError("install_columns unsupported on the v1 oracle engine")
+        now = now_ms if now_ms is not None else ms_now()
+        n = fp.shape[0]
+        if n == 0:
+            return 0
+        size = _pad_size(n)
+
+        def pad(a, dtype):
+            out = np.zeros(size, dtype=dtype)
+            out[:n] = a
+            return out
+
+        import jax.numpy as jnp
+
+        inst = InstallBatch(
+            fp=jnp.asarray(pad(fp, np.int64)),
+            algo=jnp.asarray(pad(algo, np.int32)),
+            status=jnp.asarray(pad(status, np.int32)),
+            limit=jnp.asarray(pad(limit, np.int64)),
+            remaining=jnp.asarray(pad(remaining, np.int64)),
+            reset_time=jnp.asarray(pad(reset_time, np.int64)),
+            duration=jnp.asarray(pad(duration, np.int64)),
+            now=jnp.asarray(pad(np.full(n, now, dtype=np.int64), np.int64)),
+            active=jnp.asarray(pad(np.ones(n, dtype=bool), bool)),
+        )
+        self.table, installed = install2(self.table, inst, write=self.write_mode)
+        self.stats.dispatches += 1
+        return int(np.asarray(installed).sum())
+
+    # ---------------------------------------------------------- checkpointing
+
+    def snapshot(self) -> np.ndarray:
+        """Device→host copy of the whole table (the Loader.Save analog,
+        reference store.go:49-60 / workers.go:457-540)."""
+        return np.asarray(self.table.rows)
+
+    def restore(self, rows: np.ndarray) -> None:
+        """Host→device restore of a snapshot taken by `snapshot()` (the
+        Loader.Load analog, reference workers.go:335-419)."""
+        import jax
+        import jax.numpy as jnp
+
+        if rows.shape != tuple(self.table.rows.shape):
+            raise ValueError(
+                f"snapshot shape {rows.shape} != table {tuple(self.table.rows.shape)}"
+            )
+        self.table = Table2(rows=jax.device_put(jnp.asarray(rows, dtype=jnp.int32)))
+
+    def live_count(self, now_ms: Optional[int] = None) -> int:
+        from gubernator_tpu.ops.table2 import live_count2
+
+        return live_count2(self.table, now_ms if now_ms is not None else ms_now())

@@ -1,0 +1,4 @@
+from gubernator_tpu.service.daemon import Daemon
+from gubernator_tpu.service.metrics import DaemonMetrics, parse_metrics
+
+__all__ = ["Daemon", "DaemonMetrics", "parse_metrics"]

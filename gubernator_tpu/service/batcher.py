@@ -1,0 +1,131 @@
+"""Front-door request coalescing — the BatchWait tick.
+
+The reference's defining serving mechanic: requests arriving within a 500 µs
+window (up to a batch limit) coalesce into one batch (reference
+peer_client.go:289-344 does this toward peers; config.go:138-140 sets the
+window). Here the same window feeds the DEVICE: concurrent GetRateLimits
+handlers enqueue column slices, and each flush concatenates them into a single
+kernel dispatch — one TPU batch instead of one channel message per item.
+
+NO_BATCHING items bypass the window (reference peer_client.go:126-162's fast
+path) by calling the runner directly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from gubernator_tpu.ops.batch import RequestColumns, ResponseColumns
+from gubernator_tpu.ops.engine import ms_now
+from gubernator_tpu.service.wire import concat_columns
+
+# device batches coalesce far beyond the reference's 1000-item RPC cap — the
+# kernel's throughput comes from large batches; this caps one dispatch.
+DEFAULT_COALESCE_LIMIT = 16384
+
+
+class Batcher:
+    """Coalesce concurrent column batches into single engine dispatches."""
+
+    def __init__(
+        self,
+        runner,
+        batch_wait_ms: float = 0.5,
+        coalesce_limit: int = DEFAULT_COALESCE_LIMIT,
+        metrics=None,
+    ):
+        self.runner = runner
+        self.batch_wait_s = batch_wait_ms / 1e3
+        self.coalesce_limit = coalesce_limit
+        self.metrics = metrics
+        self._pending: List[Tuple[RequestColumns, asyncio.Future]] = []
+        self._pending_rows = 0
+        self._flush_task: Optional[asyncio.Task] = None
+        self._flushing = False
+
+    async def check(
+        self, cols: RequestColumns, now_ms: Optional[int] = None
+    ) -> ResponseColumns:
+        """Enqueue a column batch; resolves with this batch's slice of the
+        coalesced response."""
+        now = now_ms if now_ms is not None else ms_now()
+        # stamp unset created_at at ENQUEUE time (reference stamps at request
+        # entry, gubernator.go:225-227), not at flush time
+        cols = cols._replace(
+            created_at=np.where(cols.created_at == 0, now, cols.created_at)
+        )
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._pending.append((cols, fut))
+        self._pending_rows += cols.fp.shape[0]
+        if self.metrics is not None:
+            self.metrics.queue_length.set(self._pending_rows)
+        if self._pending_rows >= self.coalesce_limit:
+            self._kick(immediate=True)
+        else:
+            self._kick(immediate=False)
+        return await fut
+
+    def _kick(self, immediate: bool) -> None:
+        if self._flush_task is not None and not self._flush_task.done():
+            if immediate:
+                # already armed with a wait — replace with an immediate flush
+                self._flush_task.cancel()
+            else:
+                return
+        self._flush_task = asyncio.get_running_loop().create_task(
+            self._flush_after(0.0 if immediate else self.batch_wait_s)
+        )
+
+    async def _flush_after(self, delay: float) -> None:
+        if delay > 0:
+            try:
+                await asyncio.sleep(delay)
+            except asyncio.CancelledError:
+                return
+        await self._flush()
+
+    async def _flush(self) -> None:
+        batch = self._pending
+        self._pending = []
+        self._pending_rows = 0
+        if self.metrics is not None:
+            self.metrics.queue_length.set(0)
+        if not batch:
+            return
+        t0 = time.perf_counter()
+        cat = concat_columns([c for c, _ in batch])
+        try:
+            rc = await self.runner.check_columns(cat)
+        except Exception as exc:  # pragma: no cover - defensive
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(exc)
+            return
+        if self.metrics is not None:
+            self.metrics.batch_send_duration.observe(time.perf_counter() - t0)
+        off = 0
+        for cols, fut in batch:
+            n = cols.fp.shape[0]
+            sl = slice(off, off + n)
+            if not fut.done():
+                fut.set_result(
+                    ResponseColumns(
+                        status=rc.status[sl],
+                        limit=rc.limit[sl],
+                        remaining=rc.remaining[sl],
+                        reset_time=rc.reset_time[sl],
+                        err=rc.err[sl],
+                    )
+                )
+            off += n
+
+    async def drain(self) -> None:
+        """Flush anything pending (shutdown path)."""
+        if self._flush_task is not None and not self._flush_task.done():
+            self._flush_task.cancel()
+        await self._flush()

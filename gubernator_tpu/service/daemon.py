@@ -1,0 +1,470 @@
+"""Daemon: process assembly + the V1 request-routing core.
+
+The TPU analog of the reference's V1Instance + Daemon (reference
+gubernator.go:121-302, daemon.go:90-434). One daemon owns one device engine
+(single-writer dispatch thread), a batching front door, a peer plane
+(consistent-hash ownership + forwarding with retry), the GLOBAL manager, and
+the gRPC/HTTP listeners.
+
+Routing per request item (reference GetRateLimits, gubernator.go:186-302):
+  1. validate + fingerprint (columns at the edge, wire.py)
+  2. ForceGlobal config flips every item to GLOBAL (config.go:65-66)
+  3. owner = consistent-hash ring on the item's hash key
+  4. owner == self        → coalescing batcher → device kernel
+     GLOBAL && not owner  → answer from LOCAL state now, queue async hit
+                            (gubernator.go:401-429)
+     not owner            → forward to owner, ≤5 retries re-resolving
+                            ownership (gubernator.go:318-399)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from gubernator_tpu.config import DaemonConfig
+from gubernator_tpu.hashing import fingerprint
+from gubernator_tpu.ops.batch import ERROR_STRINGS, RequestColumns
+from gubernator_tpu.ops.engine import LocalEngine, ms_now
+from gubernator_tpu.peers.hash_ring import ReplicatedConsistentHash
+from gubernator_tpu.peers.picker import RegionPicker
+from gubernator_tpu.proto import gubernator_pb2 as pb
+from gubernator_tpu.proto import peers_pb2 as peers_pb
+from gubernator_tpu.service.batcher import Batcher
+from gubernator_tpu.service.global_manager import GlobalManager
+from gubernator_tpu.service.metrics import DaemonMetrics
+from gubernator_tpu.service.peer_client import PeerClient, PeerError
+from gubernator_tpu.service.runner import EngineRunner
+from gubernator_tpu.service.wire import (
+    MAX_BATCH_SIZE,
+    columns_from_pb,
+    pb_from_response_columns,
+    subset_columns,
+)
+from gubernator_tpu.types import Behavior, PeerInfo, has_behavior
+
+FORWARD_RETRIES = 5  # reference asyncRequest retries (gubernator.go:333-359)
+
+
+def _hashkey_fp(key: str) -> int:
+    """Fingerprint of a pre-joined hash key ('name_uniquekey') — identical to
+    fingerprint(name, unique_key) because that joins with '_' (client.go:39-41)."""
+    import xxhash
+
+    from gubernator_tpu.hashing import _MASK63, _SEED
+
+    h = xxhash.xxh64_intdigest(key, seed=_SEED) & _MASK63
+    return h if h != 0 else 1
+
+
+class Daemon:
+    """One serving process. Use `await Daemon.spawn(conf)`."""
+
+    def __init__(self, conf: DaemonConfig, engine: Optional[LocalEngine] = None):
+        conf.validate()
+        self.conf = conf
+        self.metrics = DaemonMetrics()
+        self.engine = engine if engine is not None else LocalEngine(
+            capacity=conf.cache_size
+        )
+        self.runner = EngineRunner(self.engine, metrics=self.metrics)
+        self.batcher = Batcher(
+            self.runner,
+            batch_wait_ms=conf.behaviors.batch_wait_ms,
+            metrics=self.metrics,
+        )
+        self.global_manager = GlobalManager(self)
+        self._local_picker = ReplicatedConsistentHash()
+        self._region_picker = RegionPicker()
+        self._peer_clients: Dict[str, PeerClient] = {}
+        self._shutting_down = False
+        self._servers = []  # transport handles (service/server.py)
+        self._pool = None  # discovery pool
+        self.grpc_port: Optional[int] = None
+        self.http_port: Optional[int] = None
+        self._client_creds = None  # set by TLS setup
+
+    # ---------------------------------------------------------------- spawn
+    @classmethod
+    async def spawn(cls, conf: DaemonConfig, engine: Optional[LocalEngine] = None):
+        """SpawnDaemon analog (reference daemon.go:75-88): build, restore
+        checkpoint, start listeners + loops + discovery."""
+        d = cls(conf, engine=engine)
+        d.maybe_restore()
+        await d.warm_up()
+        from gubernator_tpu.service.server import start_servers
+
+        await start_servers(d)
+        d.global_manager.start()
+        await d._start_discovery()
+        return d
+
+    async def warm_up(self) -> None:
+        """Compile the decision + install kernels for the smallest batch shape
+        BEFORE serving: the first XLA compile takes seconds, which would blow
+        the 500 ms peer-RPC budgets (global_timeout, batch_timeout) and drop
+        the first GLOBAL sync round of a fresh daemon."""
+        warm = RequestColumns(
+            fp=np.asarray([1], dtype=np.int64),
+            algo=np.zeros(1, dtype=np.int32),
+            behavior=np.zeros(1, dtype=np.int32),
+            hits=np.zeros(1, dtype=np.int64),
+            limit=np.ones(1, dtype=np.int64),
+            burst=np.zeros(1, dtype=np.int64),
+            duration=np.ones(1, dtype=np.int64),  # expires ~immediately
+            created_at=np.zeros(1, dtype=np.int64),
+            err=np.zeros(1, dtype=np.int8),
+        )
+        await self.runner.check_columns(warm)
+        await self.runner.install_columns(
+            fp=np.asarray([1], dtype=np.int64),
+            algo=np.zeros(1, dtype=np.int32),
+            status=np.zeros(1, dtype=np.int32),
+            limit=np.ones(1, dtype=np.int64),
+            remaining=np.ones(1, dtype=np.int64),
+            reset_time=np.ones(1, dtype=np.int64),
+            duration=np.ones(1, dtype=np.int64),
+            now_ms=1,
+        )
+        # warm-up is not traffic: reset counters so tests and metrics see
+        # only real requests
+        from gubernator_tpu.ops.engine import EngineStats
+
+        self.engine.stats = EngineStats()
+        self.metrics._last_engine = None
+
+    async def _start_discovery(self) -> None:
+        if self.conf.peer_discovery_type == "dns":
+            from gubernator_tpu.discovery.dns import DNSPool
+
+            self._pool = DNSPool(
+                fqdn=self.conf.dns_fqdn,
+                poll_ms=self.conf.dns_poll_ms,
+                on_update=self.set_peers,
+                self_address=self.conf.advertise_address,
+                http_address=self.conf.http_address,
+                data_center=self.conf.data_center,
+            )
+            await self._pool.start()
+        # "none": explicit set_peers calls (reference daemon.go:258-262)
+
+    # ---------------------------------------------------------------- peers
+    def peer_info(self) -> PeerInfo:
+        return PeerInfo(
+            grpc_address=self.conf.advertise_address,
+            http_address=self.conf.http_address,
+            data_center=self.conf.data_center,
+            is_owner=True,
+        )
+
+    def set_peers(self, peers: List[PeerInfo]) -> None:
+        """Hot-swap the peer set (reference SetPeers, gubernator.go:694-789):
+        rebuild both pickers from scratch, reuse live PeerClients by address,
+        and drain clients for peers that disappeared."""
+        local = ReplicatedConsistentHash()
+        region = RegionPicker()
+        keep: Dict[str, PeerClient] = {}
+        for info in peers:
+            info.is_owner = info.grpc_address == self.conf.advertise_address
+            if not info.data_center or info.data_center == self.conf.data_center:
+                local.add(info)
+            else:
+                region.add(info)
+            if not info.is_owner:
+                client = self._peer_clients.get(info.grpc_address)
+                if client is None:
+                    client = PeerClient(
+                        info,
+                        batch_wait_ms=self.conf.behaviors.batch_wait_ms,
+                        batch_limit=self.conf.behaviors.batch_limit,
+                        batch_timeout_ms=self.conf.behaviors.batch_timeout_ms,
+                        metrics=self.metrics,
+                        channel_credentials=self._client_creds,
+                    )
+                keep[info.grpc_address] = client
+        dropped = [
+            c for a, c in self._peer_clients.items() if a not in keep
+        ]
+        self._peer_clients = keep
+        self._local_picker = local
+        self._region_picker = region
+        if dropped:
+            async def drain():
+                await asyncio.gather(
+                    *(c.shutdown() for c in dropped), return_exceptions=True
+                )
+
+            try:
+                asyncio.get_running_loop().create_task(drain())
+            except RuntimeError:
+                pass  # no loop (tests building daemons synchronously)
+
+    def local_peers(self) -> List[PeerInfo]:
+        return self._local_picker.peers()
+
+    def region_peers(self) -> List[PeerInfo]:
+        return self._region_picker.peers()
+
+    def get_peer(self, key: str) -> PeerInfo:
+        return self._local_picker.get(key)
+
+    def is_self(self, info: PeerInfo) -> bool:
+        return info.grpc_address == self.conf.advertise_address
+
+    def peer_client(self, info: PeerInfo) -> Optional[PeerClient]:
+        return self._peer_clients.get(info.grpc_address)
+
+    def now_ms(self) -> int:
+        return ms_now()
+
+    # ------------------------------------------------------------ V1 service
+    async def get_rate_limits(
+        self, items: List["pb.RateLimitReq"]
+    ) -> List["pb.RateLimitResp"]:
+        if len(items) > MAX_BATCH_SIZE:
+            raise ValueError(
+                f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'"
+            )
+        self.metrics.concurrent_checks.inc()
+        try:
+            return await self._route(items)
+        finally:
+            self.metrics.concurrent_checks.dec()
+
+    async def _route(self, items) -> List["pb.RateLimitResp"]:
+        n = len(items)
+        if self.conf.behaviors.force_global:
+            for it in items:
+                it.behavior |= int(Behavior.GLOBAL)
+        cols, hash_keys = columns_from_pb(items)
+        out: List[Optional[pb.RateLimitResp]] = [None] * n
+
+        standalone = self._local_picker.size() == 0
+        local_rows: List[int] = []
+        global_rows: List[int] = []
+        forwards: List[tuple] = []  # (row, key, item)
+        owner_global_rows: List[int] = []
+        for i in range(n):
+            if cols.err[i] != 0:
+                out[i] = pb.RateLimitResp(error=ERROR_STRINGS[int(cols.err[i])])
+                continue
+            is_global = bool(cols.behavior[i] & int(Behavior.GLOBAL))
+            if standalone:
+                local_rows.append(i)
+                if is_global:
+                    owner_global_rows.append(i)
+                continue
+            info = self.get_peer(hash_keys[i])
+            if self.is_self(info):
+                local_rows.append(i)
+                if is_global:
+                    owner_global_rows.append(i)
+            elif is_global:
+                global_rows.append(i)
+            else:
+                forwards.append((i, hash_keys[i], items[i]))
+
+        tasks = []
+        if local_rows:
+            rows = np.asarray(local_rows)
+            tasks.append(self._check_rows(cols, rows, out))
+        if global_rows:
+            rows = np.asarray(global_rows)
+            # answer from local state with GLOBAL stripped + NO_BATCHING
+            # forced (reference gubernator.go:416-422), and queue async hits
+            gcols = subset_columns(cols, rows)
+            gcols = gcols._replace(
+                behavior=(gcols.behavior & ~np.int32(int(Behavior.GLOBAL)))
+                | np.int32(int(Behavior.NO_BATCHING))
+            )
+            for i in global_rows:
+                self.global_manager.queue_hit(hash_keys[i], items[i])
+            tasks.append(self._check_subset(gcols, rows, out))
+        for row, key, item in forwards:
+            tasks.append(self._forward(row, key, item, out))
+        if tasks:
+            await asyncio.gather(*tasks)
+        # owner-side GLOBAL items broadcast their fresh status (reference
+        # getLocalRateLimit → QueueUpdate, gubernator.go:670-672)
+        for i in owner_global_rows:
+            self.global_manager.queue_update(hash_keys[i], items[i])
+        for i in range(n):
+            if out[i] is None:  # pragma: no cover - defensive
+                out[i] = pb.RateLimitResp(error="internal: row not routed")
+            if out[i].status == pb.OVER_LIMIT:
+                self.metrics.over_limit_counter.inc()
+        return out  # type: ignore[return-value]
+
+    async def _check_rows(self, cols, rows: np.ndarray, out) -> None:
+        await self._check_subset(subset_columns(cols, rows), rows, out)
+
+    async def _check_subset(self, sub, rows: np.ndarray, out) -> None:
+        rc = await self.batcher.check(sub)
+        resps = pb_from_response_columns(rc)
+        for j, i in enumerate(rows):
+            out[int(i)] = resps[j]
+
+    async def _forward(self, row: int, key: str, item, out) -> None:
+        """Forward to the owner with ownership re-resolution on failure
+        (reference asyncRequest, gubernator.go:318-399)."""
+        last_err = "no peers available"
+        for attempt in range(FORWARD_RETRIES):
+            try:
+                info = self.get_peer(key)
+            except Exception as exc:
+                last_err = str(exc)
+                break
+            if self.is_self(info):
+                # ownership moved to us mid-flight — serve locally
+                cols, _ = columns_from_pb([item])
+                rc = await self.batcher.check(cols)
+                out[row] = pb_from_response_columns(rc)[0]
+                return
+            client = self.peer_client(info)
+            if client is None:
+                last_err = f"no client for peer {info.grpc_address}"
+                break
+            try:
+                out[row] = await client.get_peer_rate_limit(item)
+                return
+            except PeerError as exc:
+                last_err = str(exc)
+                self.metrics.batch_send_retries.inc()
+                await asyncio.sleep(0.001 * (attempt + 1))
+        self.metrics.check_error_counter.labels(error="forward").inc()
+        out[row] = pb.RateLimitResp(
+            error=f"Error while fetching rate limit from peer: {last_err}"
+        )
+
+    # --------------------------------------------------------- peers service
+    async def get_peer_rate_limits(
+        self, req: "peers_pb.GetPeerRateLimitsReq"
+    ) -> "peers_pb.GetPeerRateLimitsResp":
+        """Owner executes a forwarded/async batch (reference
+        gubernator.go:476-559). GLOBAL-accumulated hits apply with
+        DRAIN_OVER_LIMIT forced (gubernator.go:526-532)."""
+        items = list(req.requests)
+        keys = []
+        for it in items:
+            if has_behavior(it.behavior, Behavior.GLOBAL):
+                it.behavior |= int(Behavior.DRAIN_OVER_LIMIT)
+        cols, hash_keys = columns_from_pb(items)
+        # strip GLOBAL before the local check so the engine path does not
+        # depend on it; broadcast queueing happens below
+        cols = cols._replace(behavior=cols.behavior & ~np.int32(int(Behavior.GLOBAL)))
+        rc = await self.batcher.check(cols)
+        for i, it in enumerate(items):
+            if has_behavior(it.behavior, Behavior.GLOBAL) and cols.err[i] == 0:
+                self.global_manager.queue_update(hash_keys[i], it)
+        return peers_pb.GetPeerRateLimitsResp(
+            rate_limits=pb_from_response_columns(rc)
+        )
+
+    async def update_peer_globals(
+        self, req: "peers_pb.UpdatePeerGlobalsReq"
+    ) -> "peers_pb.UpdatePeerGlobalsResp":
+        """Install owner-authoritative statuses (reference gubernator.go:434-474)."""
+        g = list(req.globals)
+        n = len(g)
+        if n:
+            fp = np.fromiter((_hashkey_fp(u.key) for u in g), dtype=np.int64, count=n)
+            await self.runner.install_columns(
+                fp=fp,
+                algo=np.fromiter((u.algorithm for u in g), dtype=np.int32, count=n),
+                status=np.fromiter(
+                    (u.status.status for u in g), dtype=np.int32, count=n
+                ),
+                limit=np.fromiter((u.status.limit for u in g), dtype=np.int64, count=n),
+                remaining=np.fromiter(
+                    (u.status.remaining for u in g), dtype=np.int64, count=n
+                ),
+                reset_time=np.fromiter(
+                    (u.status.reset_time for u in g), dtype=np.int64, count=n
+                ),
+                duration=np.fromiter((u.duration for u in g), dtype=np.int64, count=n),
+            )
+            self.metrics.updates_installed.inc(n)
+            self.metrics.broadcast_counter.labels(
+                condition="update_peer_globals"
+            ).inc()
+        return peers_pb.UpdatePeerGlobalsResp()
+
+    # ----------------------------------------------------------------- health
+    async def health_check(self) -> "pb.HealthCheckResp":
+        """Aggregate per-peer recent errors (reference gubernator.go:562-643)."""
+        errs: List[str] = []
+        local = self.local_peers()
+        for c in self._peer_clients.values():
+            errs.extend(c.recent_errors())
+        if local and not any(self.is_self(p) for p in local):
+            errs.append(
+                f"this instance ({self.conf.advertise_address}) is not in the peer list"
+            )
+        resp = pb.HealthCheckResp(
+            status="unhealthy" if errs else "healthy",
+            message="; ".join(errs[:5]),
+            peer_count=self._local_picker.size() + self._region_picker.size(),
+            advertise_address=self.conf.advertise_address,
+        )
+        for p in local:
+            resp.local_peers.append(
+                pb.PeerHealthResp(
+                    grpc_address=p.grpc_address, data_center=p.data_center
+                )
+            )
+        for p in self.region_peers():
+            resp.region_peers.append(
+                pb.PeerHealthResp(
+                    grpc_address=p.grpc_address, data_center=p.data_center
+                )
+            )
+        return resp
+
+    def live_check(self) -> "pb.LiveCheckResp":
+        """Liveness gate (reference gubernator.go:646-651): fails during
+        shutdown so load balancers de-register before the listeners close."""
+        if self._shutting_down:
+            raise RuntimeError("shutting down")
+        return pb.LiveCheckResp()
+
+    # ------------------------------------------------------------ checkpoint
+    def maybe_restore(self) -> None:
+        if not self.conf.checkpoint_path:
+            return
+        import os
+
+        if os.path.exists(self.conf.checkpoint_path):
+            from gubernator_tpu.store import load_snapshot
+
+            rows = load_snapshot(self.conf.checkpoint_path)
+            self.engine.restore(rows)
+
+    def maybe_checkpoint(self) -> None:
+        if not self.conf.checkpoint_path:
+            return
+        from gubernator_tpu.store import save_snapshot
+
+        save_snapshot(self.conf.checkpoint_path, self.runner.snapshot_sync())
+
+    # ---------------------------------------------------------------- close
+    async def close(self) -> None:
+        """Graceful shutdown (reference daemon.go:388-434): stop intake,
+        drain batches + global queues, checkpoint, stop listeners."""
+        if self._shutting_down:
+            return
+        self._shutting_down = True
+        if self._pool is not None:
+            await self._pool.close()
+        await self.global_manager.close()
+        await self.batcher.drain()
+        await asyncio.gather(
+            *(c.shutdown() for c in self._peer_clients.values()),
+            return_exceptions=True,
+        )
+        for s in self._servers:
+            await s.stop()
+        self.maybe_checkpoint()
+        self.runner.close()

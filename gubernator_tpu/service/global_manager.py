@@ -1,0 +1,225 @@
+"""Cross-daemon GLOBAL behavior — async hit sync + owner broadcasts.
+
+The host-side peer plane for GLOBAL rate limits across daemons (reference
+global.go:31-307). Complements the in-mesh collective path
+(parallel/global_sync.py): inside one TPU slice the sync is two all_gathers
+over ICI; ACROSS daemons (slices, regions) it is this manager speaking the
+reference's own two-stage protocol over gRPC:
+
+* runAsyncHits analog: non-owner aggregates hits per key (sum Hits, OR
+  RESET_REMAINING — reference global.go:109-123) and ships them to owners via
+  GetPeerRateLimits every GlobalSyncWait (100 ms) or at GlobalBatchLimit.
+* runBroadcasts analog: the owner re-reads each updated key's status with
+  Hits=0 and pushes UpdatePeerGlobals to every local peer except itself
+  (reference global.go:255-298), bounded by GlobalPeerRequestsConcurrency.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Dict, Optional
+
+log = logging.getLogger("gubernator_tpu.global")
+
+from gubernator_tpu.proto import gubernator_pb2 as pb
+from gubernator_tpu.proto import peers_pb2 as peers_pb
+from gubernator_tpu.types import Behavior, has_behavior
+
+
+class GlobalManager:
+    def __init__(self, daemon):
+        self.daemon = daemon
+        b = daemon.conf.behaviors
+        self.sync_wait_s = b.global_sync_wait_ms / 1e3
+        self.batch_limit = b.global_batch_limit
+        self.timeout_s = b.global_timeout_ms / 1e3
+        self.concurrency = b.global_peer_concurrency
+        self.metrics = daemon.metrics
+        # pending hits: hash_key → aggregated RateLimitReq (non-owner side)
+        self._hits: Dict[str, pb.RateLimitReq] = {}
+        # pending broadcasts: hash_key → latest owner-side request (config carrier)
+        self._updates: Dict[str, pb.RateLimitReq] = {}
+        self._hits_wake = asyncio.Event()
+        self._bcast_wake = asyncio.Event()
+        self._tasks = []
+        self._closed = False
+
+    def start(self) -> None:
+        self._tasks = [
+            asyncio.create_task(self._hits_loop(), name="global-hits"),
+            asyncio.create_task(self._broadcast_loop(), name="global-bcast"),
+        ]
+
+    async def close(self) -> None:
+        self._closed = True
+        self._hits_wake.set()
+        self._bcast_wake.set()
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        # final flush so queued hits/updates aren't lost on graceful shutdown
+        await self._send_hits()
+        await self._broadcast()
+
+    # --------------------------------------------------------------- queueing
+    def queue_hit(self, key: str, item: "pb.RateLimitReq") -> None:
+        """Non-owner hit on a GLOBAL key (reference global.go:85-123).
+        Zero-hit requests are never queued (global.go:85-95)."""
+        if item.hits == 0:
+            return
+        agg = self._hits.get(key)
+        if agg is None:
+            agg = pb.RateLimitReq()
+            agg.CopyFrom(item)
+            self._hits[key] = agg
+        else:
+            hits = agg.hits + item.hits
+            reset = (agg.behavior | item.behavior) & int(Behavior.RESET_REMAINING)
+            agg.CopyFrom(item)  # newest config wins
+            agg.hits = hits
+            agg.behavior |= reset
+        self.metrics.global_queue_length.set(len(self._hits))
+        if len(self._hits) >= self.batch_limit:
+            self._hits_wake.set()
+
+    def queue_update(self, key: str, item: "pb.RateLimitReq") -> None:
+        """Owner-side: mark the key for an authoritative broadcast (reference
+        QueueUpdate, global.go:92-99)."""
+        self._updates[key] = item
+        if len(self._updates) >= self.batch_limit:
+            self._bcast_wake.set()
+
+    # ------------------------------------------------------------- hits loop
+    async def _hits_loop(self) -> None:
+        while not self._closed:
+            try:
+                await asyncio.wait_for(self._hits_wake.wait(), self.sync_wait_s)
+            except asyncio.TimeoutError:
+                pass
+            self._hits_wake.clear()
+            try:
+                await self._send_hits()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # a failed round must not kill the loop (reference counts and
+                # moves on, global.go:190-195)
+                log.exception("global hit-sync round failed")
+
+    async def _send_hits(self) -> None:
+        if not self._hits:
+            return
+        batch, self._hits = self._hits, {}
+        self.metrics.global_queue_length.set(0)
+        t0 = time.perf_counter()
+        # group by owning peer (reference sendHits, global.go:155-199)
+        by_peer: Dict[str, list] = {}
+        infos = {}
+        for key, item in batch.items():
+            try:
+                info = self.daemon.get_peer(key)
+            except Exception:
+                continue  # no peers; drop (eventual consistency tolerates it)
+            if self.daemon.is_self(info):
+                continue  # became owner since queueing; owner path handles it
+            by_peer.setdefault(info.grpc_address, []).append(item)
+            infos[info.grpc_address] = info
+        sem = asyncio.Semaphore(self.concurrency)
+
+        async def send(addr, items):
+            client = self.daemon.peer_client(infos[addr])
+            if client is None:
+                return
+            async with sem:
+                try:
+                    await client.get_peer_rate_limits(
+                        peers_pb.GetPeerRateLimitsReq(requests=items),
+                        timeout=self.timeout_s,
+                    )
+                except Exception:
+                    # counted + dropped, never retried (reference
+                    # global.go:190-195 — replication tolerates loss)
+                    self.metrics.check_error_counter.labels(
+                        error="global_send"
+                    ).inc()
+
+        await asyncio.gather(*(send(a, i) for a, i in by_peer.items()))
+        if by_peer:
+            self.metrics.global_send_duration.observe(time.perf_counter() - t0)
+
+    # -------------------------------------------------------- broadcast loop
+    async def _broadcast_loop(self) -> None:
+        while not self._closed:
+            try:
+                await asyncio.wait_for(self._bcast_wake.wait(), self.sync_wait_s)
+            except asyncio.TimeoutError:
+                pass
+            self._bcast_wake.clear()
+            try:
+                await self._broadcast()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("global broadcast round failed")
+
+    async def _broadcast(self) -> None:
+        if not self._updates:
+            return
+        batch, self._updates = self._updates, {}
+        t0 = time.perf_counter()
+        # re-read each key's current status with Hits=0 (reference
+        # global.go:255-262) — a zero-hit check is the authoritative read
+        import numpy as np
+
+        from gubernator_tpu.service.wire import columns_from_pb
+
+        reads = []
+        for key, item in batch.items():
+            r = pb.RateLimitReq()
+            r.CopyFrom(item)
+            r.hits = 0
+            r.behavior &= ~int(Behavior.GLOBAL)  # local read, not re-queued
+            reads.append(r)
+        cols, _ = columns_from_pb(reads)
+        rc = await self.daemon.runner.check_columns(cols)
+        globals_ = []
+        for i, (key, item) in enumerate(batch.items()):
+            globals_.append(
+                peers_pb.UpdatePeerGlobal(
+                    key=key,
+                    status=pb.RateLimitResp(
+                        status=int(rc.status[i]),
+                        limit=int(rc.limit[i]),
+                        remaining=int(rc.remaining[i]),
+                        reset_time=int(rc.reset_time[i]),
+                    ),
+                    algorithm=item.algorithm,
+                    duration=item.duration,
+                    created_at=item.created_at or self.daemon.now_ms(),
+                )
+            )
+        req = peers_pb.UpdatePeerGlobalsReq(globals=globals_)
+        peers = [p for p in self.daemon.local_peers() if not self.daemon.is_self(p)]
+        sem = asyncio.Semaphore(self.concurrency)
+
+        async def push(info):
+            client = self.daemon.peer_client(info)
+            if client is None:
+                return
+            async with sem:
+                try:
+                    await client.update_peer_globals(req, timeout=self.timeout_s)
+                    self.metrics.broadcast_counter.labels(condition="broadcast").inc()
+                except Exception:
+                    self.metrics.check_error_counter.labels(
+                        error="broadcast"
+                    ).inc()
+
+        await asyncio.gather(*(push(p) for p in peers))
+        self.metrics.broadcast_duration.observe(time.perf_counter() - t0)

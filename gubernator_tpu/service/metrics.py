@@ -1,0 +1,193 @@
+"""Prometheus metrics for the daemon — load-bearing for convergence tests.
+
+The reference's functional suite asserts distributed behavior by scraping each
+node's /metrics endpoint and checking exact counter values (reference
+functional_test.go:1760-2167 via getMetrics/waitForBroadcast; series catalog
+docs/prometheus.md:17-43). This module exposes the same-named series backed by
+the TPU engine's host-side counters, on a PRIVATE registry per daemon so an
+in-process test cluster scrapes N independent endpoints.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    Summary,
+    generate_latest,
+)
+from prometheus_client.parser import text_string_to_metric_families
+
+
+class DaemonMetrics:
+    """One daemon's metric family set (names mirror docs/prometheus.md)."""
+
+    def __init__(self) -> None:
+        self.registry = CollectorRegistry()
+        r = self.registry
+        # --- request plane (grpc_stats.go:41-131 analog)
+        self.grpc_request_counts = Counter(
+            "gubernator_grpc_request_counts",
+            "The count of gRPC/HTTP requests",
+            ["method", "status"],
+            registry=r,
+        )
+        self.grpc_request_duration = Summary(
+            "gubernator_grpc_request_duration",
+            "Request handling duration in seconds",
+            ["method"],
+            registry=r,
+        )
+        self.concurrent_checks = Gauge(
+            "gubernator_concurrent_checks_counter",
+            "Number of rate limit checks in flight",
+            registry=r,
+        )
+        self.check_error_counter = Counter(
+            "gubernator_check_error_counter",
+            "Count of per-item errors returned",
+            ["error"],
+            registry=r,
+        )
+        self.over_limit_counter = Counter(
+            "gubernator_over_limit_counter",
+            "Count of OVER_LIMIT responses",
+            registry=r,
+        )
+        # --- cache / table (lrucache.go:48-59 analog)
+        self.cache_size = Gauge(
+            "gubernator_cache_size",
+            "Number of live keys in the device table",
+            registry=r,
+        )
+        self.cache_access = Counter(
+            "gubernator_cache_access_count",
+            "Device table lookups",
+            ["type"],  # hit | miss
+            registry=r,
+        )
+        self.unexpired_evictions = Counter(
+            "gubernator_unexpired_evictions_count",
+            "Live (unexpired) items evicted for new keys",
+            registry=r,
+        )
+        # --- TPU dispatch plane (no reference analog; the kernel is ours)
+        self.dispatch_count = Counter(
+            "gubernator_tpu_dispatch_count",
+            "Decision-kernel dispatches",
+            registry=r,
+        )
+        self.dispatch_duration = Histogram(
+            "gubernator_tpu_dispatch_duration",
+            "Seconds per decision-kernel dispatch (host-observed)",
+            registry=r,
+            buckets=(0.0005, 0.001, 0.002, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5, 2.5),
+        )
+        self.dropped_rows = Counter(
+            "gubernator_tpu_dropped_rows_count",
+            "Rows whose decision could not be persisted after retries",
+            registry=r,
+        )
+        # --- batching front door (gubernator.go:98-112 analog)
+        self.queue_length = Gauge(
+            "gubernator_queue_length",
+            "Items waiting in the front-door coalescing buffer",
+            registry=r,
+        )
+        self.batch_send_duration = Summary(
+            "gubernator_batch_send_duration",
+            "Seconds per coalesced front-door batch",
+            registry=r,
+        )
+        self.batch_queue_length = Gauge(
+            "gubernator_batch_queue_length",
+            "Items queued toward peers (forwarding)",
+            registry=r,
+        )
+        self.batch_send_retries = Counter(
+            "gubernator_batch_send_retries",
+            "Forwarded requests re-sent after peer errors/ownership moves",
+            registry=r,
+        )
+        # --- GLOBAL behavior (global.go:53-79 analog; names must match, the
+        # convergence tests key on them)
+        self.global_send_duration = Summary(
+            "gubernator_global_send_duration",
+            "Seconds per async hit-sync send to owners",
+            registry=r,
+        )
+        self.broadcast_duration = Summary(
+            "gubernator_broadcast_duration",
+            "Seconds per owner broadcast round",
+            registry=r,
+        )
+        self.broadcast_counter = Counter(
+            "gubernator_broadcast_counter",
+            "Owner UpdatePeerGlobals broadcasts sent",
+            ["condition"],  # broadcast | update_peer_globals (received)
+            registry=r,
+        )
+        self.global_queue_length = Gauge(
+            "gubernator_global_queue_length",
+            "Pending async GLOBAL hits awaiting the sync tick",
+            registry=r,
+        )
+        self.updates_installed = Counter(
+            "gubernator_update_peer_globals_installed",
+            "Authoritative GLOBAL statuses installed from owner broadcasts",
+            registry=r,
+        )
+
+    def observe_engine(self, stats) -> None:
+        """Refresh counter families from an EngineStats snapshot (engine
+        counters are cumulative; prometheus Counters only go up, so set via
+        delta)."""
+        # Counters in prometheus_client can't be set; track last-seen and inc
+        # the difference.
+        last = getattr(self, "_last_engine", None)
+        if last is None:
+            last = dict(hits=0, misses=0, over=0, evic=0, dropped=0, disp=0)
+        d_hits = stats.cache_hits - last["hits"]
+        d_miss = stats.cache_misses - last["misses"]
+        d_over = stats.over_limit - last["over"]
+        d_evic = stats.evicted_unexpired - last["evic"]
+        d_drop = stats.dropped - last["dropped"]
+        d_disp = stats.dispatches - last["disp"]
+        if d_hits > 0:
+            self.cache_access.labels(type="hit").inc(d_hits)
+        if d_miss > 0:
+            self.cache_access.labels(type="miss").inc(d_miss)
+        if d_over > 0:
+            self.over_limit_counter.inc(d_over)
+        if d_evic > 0:
+            self.unexpired_evictions.inc(d_evic)
+        if d_drop > 0:
+            self.dropped_rows.inc(d_drop)
+        if d_disp > 0:
+            self.dispatch_count.inc(d_disp)
+        self._last_engine = dict(
+            hits=stats.cache_hits,
+            misses=stats.cache_misses,
+            over=stats.over_limit,
+            evic=stats.evicted_unexpired,
+            dropped=stats.dropped,
+            disp=stats.dispatches,
+        )
+
+    def render(self) -> bytes:
+        """Prometheus text exposition (the /metrics body)."""
+        return generate_latest(self.registry)
+
+
+def parse_metrics(text: str):
+    """Scrape helper for tests: text exposition → {name: {labelset: value}}.
+    The analog of the reference tests' expfmt parsing (functional_test.go:2245)."""
+    out = {}
+    for fam in text_string_to_metric_families(text):
+        for sample in fam.samples:
+            out.setdefault(sample.name, {})[
+                tuple(sorted(sample.labels.items()))
+            ] = sample.value
+    return out

@@ -1,0 +1,207 @@
+"""PeerClient — one per remote peer; forwarding with batch coalescing.
+
+Mirrors reference peer_client.go: a gRPC connection plus a batching queue that
+flushes at BatchLimit (1000) or BatchWait (500 µs), a NO_BATCHING direct path,
+a graceful Shutdown that drains in-flight requests, and a recent-error LRU
+feeding the health check (reference peer_client.go:86-451).
+
+Raw grpc.aio unary calls are built from method paths + pb2 serializers — no
+generated stubs needed (the repo's pb2 files carry messages only).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import time
+from typing import List, Optional, Tuple
+
+import grpc
+
+from gubernator_tpu.proto import gubernator_pb2 as pb
+from gubernator_tpu.proto import peers_pb2 as peers_pb
+from gubernator_tpu.types import Behavior, PeerInfo, has_behavior
+
+GET_PEER_RATE_LIMITS = "/pb.gubernator.PeersV1/GetPeerRateLimits"
+UPDATE_PEER_GLOBALS = "/pb.gubernator.PeersV1/UpdatePeerGlobals"
+GET_RATE_LIMITS = "/pb.gubernator.V1/GetRateLimits"
+HEALTH_CHECK = "/pb.gubernator.V1/HealthCheck"
+
+LAST_ERRS_CAP = 100  # reference peer_client.go:211-240 — LRU(100)
+LAST_ERRS_TTL_S = 300.0  # 5-minute TTL
+
+
+class PeerError(Exception):
+    """RPC-level failure talking to a peer (carries the address)."""
+
+    def __init__(self, address: str, cause: BaseException):
+        super().__init__(f"peer {address}: {cause}")
+        self.address = address
+        self.cause = cause
+
+
+class PeerClient:
+    def __init__(
+        self,
+        info: PeerInfo,
+        batch_wait_ms: float = 0.5,
+        batch_limit: int = 1000,
+        batch_timeout_ms: float = 500.0,
+        metrics=None,
+        channel_credentials=None,
+    ):
+        self.info = info
+        self.batch_wait_s = batch_wait_ms / 1e3
+        self.batch_limit = batch_limit
+        self.timeout_s = batch_timeout_ms / 1e3
+        self.metrics = metrics
+        self._creds = channel_credentials
+        self._channel: Optional[grpc.aio.Channel] = None
+        self._queue: List[Tuple[pb.RateLimitReq, asyncio.Future]] = []
+        self._flush_task: Optional[asyncio.Task] = None
+        self._inflight = 0
+        self._closed = False
+        self.last_errs: collections.deque = collections.deque(maxlen=LAST_ERRS_CAP)
+
+    # ------------------------------------------------------------- transport
+    def _chan(self) -> grpc.aio.Channel:
+        if self._channel is None:
+            opts = [
+                ("grpc.max_receive_message_length", 1 << 20),  # daemon.go:133
+            ]
+            if self._creds is not None:
+                self._channel = grpc.aio.secure_channel(
+                    self.info.grpc_address, self._creds, options=opts
+                )
+            else:
+                self._channel = grpc.aio.insecure_channel(
+                    self.info.grpc_address, options=opts
+                )
+        return self._channel
+
+    def _record_err(self, exc: BaseException) -> None:
+        self.last_errs.append((time.monotonic(), str(exc)))
+
+    def recent_errors(self) -> List[str]:
+        cutoff = time.monotonic() - LAST_ERRS_TTL_S
+        return [msg for ts, msg in self.last_errs if ts >= cutoff]
+
+    async def _unary(self, path: str, req, resp_cls, timeout: Optional[float] = None):
+        call = self._chan().unary_unary(
+            path,
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp_cls.FromString,
+        )
+        try:
+            return await call(req, timeout=timeout or self.timeout_s)
+        except BaseException as exc:
+            self._record_err(exc)
+            raise PeerError(self.info.grpc_address, exc) from exc
+
+    # ------------------------------------------------------------ peer RPCs
+    async def get_peer_rate_limits(
+        self, req: "peers_pb.GetPeerRateLimitsReq", timeout: Optional[float] = None
+    ) -> "peers_pb.GetPeerRateLimitsResp":
+        return await self._unary(
+            GET_PEER_RATE_LIMITS, req, peers_pb.GetPeerRateLimitsResp, timeout
+        )
+
+    async def update_peer_globals(
+        self, req: "peers_pb.UpdatePeerGlobalsReq", timeout: Optional[float] = None
+    ) -> "peers_pb.UpdatePeerGlobalsResp":
+        return await self._unary(
+            UPDATE_PEER_GLOBALS, req, peers_pb.UpdatePeerGlobalsResp, timeout
+        )
+
+    # ------------------------------------------------- forwarding (batched)
+    async def get_peer_rate_limit(self, item: "pb.RateLimitReq") -> "pb.RateLimitResp":
+        """Forward one item to this peer. BATCHING (default) coalesces into
+        the 500 µs / 1000-item window; NO_BATCHING and GLOBAL-accumulated
+        sends go direct (reference peer_client.go:126-162)."""
+        if self._closed:
+            raise PeerError(self.info.grpc_address, RuntimeError("peer client closed"))
+        if has_behavior(item.behavior, Behavior.NO_BATCHING):
+            resp = await self.get_peer_rate_limits(
+                peers_pb.GetPeerRateLimitsReq(requests=[item])
+            )
+            if len(resp.rate_limits) != 1:
+                raise PeerError(
+                    self.info.grpc_address,
+                    RuntimeError("expected 1 rate limit in response"),
+                )
+            return resp.rate_limits[0]
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._queue.append((item, fut))
+        if self.metrics is not None:
+            self.metrics.batch_queue_length.set(len(self._queue))
+        if len(self._queue) >= self.batch_limit:
+            self._kick(immediate=True)
+        else:
+            self._kick(immediate=False)
+        return await fut
+
+    def _kick(self, immediate: bool) -> None:
+        if self._flush_task is not None and not self._flush_task.done():
+            if immediate:
+                self._flush_task.cancel()
+            else:
+                return
+        self._flush_task = asyncio.get_running_loop().create_task(
+            self._flush_after(0.0 if immediate else self.batch_wait_s)
+        )
+
+    async def _flush_after(self, delay: float) -> None:
+        if delay > 0:
+            try:
+                await asyncio.sleep(delay)
+            except asyncio.CancelledError:
+                return
+        await self._flush()
+
+    async def _flush(self) -> None:
+        batch = self._queue[: self.batch_limit]
+        self._queue = self._queue[self.batch_limit :]
+        if self.metrics is not None:
+            self.metrics.batch_queue_length.set(len(self._queue))
+        if not batch:
+            return
+        if self._queue:
+            self._kick(immediate=len(self._queue) >= self.batch_limit)
+        self._inflight += 1
+        try:
+            req = peers_pb.GetPeerRateLimitsReq(requests=[i for i, _ in batch])
+            try:
+                resp = await self.get_peer_rate_limits(req)
+                if len(resp.rate_limits) != len(batch):
+                    raise PeerError(
+                        self.info.grpc_address,
+                        RuntimeError("mismatched response count"),
+                    )
+                for (item, fut), r in zip(batch, resp.rate_limits):
+                    if not fut.done():
+                        fut.set_result(r)
+            except BaseException as exc:
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(
+                            exc
+                            if isinstance(exc, PeerError)
+                            else PeerError(self.info.grpc_address, exc)
+                        )
+        finally:
+            self._inflight -= 1
+
+    # -------------------------------------------------------------- shutdown
+    async def shutdown(self) -> None:
+        """Drain: flush the queue, wait for in-flight sends, close the
+        channel (reference peer_client.go:415-451)."""
+        self._closed = True
+        while self._queue or self._inflight:
+            if self._flush_task is not None and not self._flush_task.done():
+                self._flush_task.cancel()
+            await self._flush()
+            await asyncio.sleep(0)
+        if self._channel is not None:
+            await self._channel.close()
+            self._channel = None

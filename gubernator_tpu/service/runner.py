@@ -1,0 +1,73 @@
+"""EngineRunner: the single-writer dispatch thread.
+
+The device table has exactly one owner — the kernel — and the host side
+funnels every mutation through ONE thread, the TPU analog of the reference's
+"each worker owns its cache, no mutexes" rule (reference workers.go:19-37).
+asyncio handlers await engine work through this runner; ordering of submitted
+jobs is FIFO, which is what makes the front-door batcher's request-order
+contract hold.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+import numpy as np
+
+from gubernator_tpu.ops.batch import RequestColumns, ResponseColumns
+from gubernator_tpu.ops.engine import LocalEngine
+
+
+class EngineRunner:
+    """Serializes engine access onto one thread; async façade."""
+
+    def __init__(self, engine: LocalEngine, metrics=None):
+        self.engine = engine
+        self.metrics = metrics
+        self._exec = ThreadPoolExecutor(max_workers=1, thread_name_prefix="engine")
+
+    async def check_columns(
+        self, cols: RequestColumns, now_ms: Optional[int] = None
+    ) -> ResponseColumns:
+        loop = asyncio.get_running_loop()
+
+        def run():
+            t0 = time.perf_counter()
+            rc = self.engine.check_columns(cols, now_ms=now_ms)
+            if self.metrics is not None:
+                self.metrics.dispatch_duration.observe(time.perf_counter() - t0)
+                self.metrics.observe_engine(self.engine.stats)
+            return rc
+
+        return await loop.run_in_executor(self._exec, run)
+
+    async def install_columns(self, **kw) -> int:
+        loop = asyncio.get_running_loop()
+
+        def run():
+            n = self.engine.install_columns(**kw)
+            if self.metrics is not None:
+                self.metrics.observe_engine(self.engine.stats)
+            return n
+
+        return await loop.run_in_executor(self._exec, run)
+
+    async def live_count(self) -> int:
+        """Table live-key count, serialized onto the engine thread — reading
+        engine.table from another thread races the donated-buffer dispatch."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self._exec, self.engine.live_count)
+
+    async def snapshot(self) -> np.ndarray:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self._exec, self.engine.snapshot)
+
+    def snapshot_sync(self) -> np.ndarray:
+        """Synchronous snapshot for shutdown paths with no running loop."""
+        return self._exec.submit(self.engine.snapshot).result()
+
+    def close(self) -> None:
+        self._exec.shutdown(wait=True)

@@ -1,0 +1,223 @@
+"""Transport: gRPC services + HTTP/JSON gateway + /metrics.
+
+The reference serves gRPC (V1 + PeersV1) and an HTTP gateway that maps
+/v1/GetRateLimits, /v1/HealthCheck, /v1/LiveCheck to the same handlers with
+proto-names JSON (reference daemon.go:131-196, 264-311). Here: grpc.aio with
+hand-built generic handlers over the repo's pb2 messages (no generated service
+stubs needed), and an aiohttp app for the gateway + Prometheus /metrics.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import grpc
+from aiohttp import web
+from google.protobuf import json_format
+
+from gubernator_tpu.proto import gubernator_pb2 as pb
+from gubernator_tpu.proto import peers_pb2 as peers_pb
+
+V1 = "pb.gubernator.V1"
+PEERS_V1 = "pb.gubernator.PeersV1"
+
+
+def _timed(metrics, method):
+    def wrap(fn):
+        async def run(request, context):
+            t0 = time.perf_counter()
+            status = "ok"
+            try:
+                return await fn(request, context)
+            except Exception:
+                status = "error"
+                raise
+            finally:
+                metrics.grpc_request_counts.labels(
+                    method=method, status=status
+                ).inc()
+                metrics.grpc_request_duration.labels(method=method).observe(
+                    time.perf_counter() - t0
+                )
+
+        return run
+
+    return wrap
+
+
+def build_grpc_services(daemon):
+    """Generic handlers for the V1 + PeersV1 services."""
+    m = daemon.metrics
+
+    @_timed(m, "/v1.GetRateLimits")
+    async def get_rate_limits(request: pb.GetRateLimitsReq, context):
+        try:
+            resps = await daemon.get_rate_limits(list(request.requests))
+        except ValueError as exc:  # batch too large etc.
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+        return pb.GetRateLimitsResp(responses=resps)
+
+    @_timed(m, "/v1.HealthCheck")
+    async def health_check(request: pb.HealthCheckReq, context):
+        return await daemon.health_check()
+
+    @_timed(m, "/v1.LiveCheck")
+    async def live_check(request: pb.LiveCheckReq, context):
+        try:
+            return daemon.live_check()
+        except RuntimeError as exc:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
+
+    @_timed(m, "/peers.GetPeerRateLimits")
+    async def get_peer_rate_limits(request: peers_pb.GetPeerRateLimitsReq, context):
+        return await daemon.get_peer_rate_limits(request)
+
+    @_timed(m, "/peers.UpdatePeerGlobals")
+    async def update_peer_globals(request: peers_pb.UpdatePeerGlobalsReq, context):
+        return await daemon.update_peer_globals(request)
+
+    def unary(fn, req_cls, resp_cls):
+        return grpc.unary_unary_rpc_method_handler(
+            fn,
+            request_deserializer=req_cls.FromString,
+            response_serializer=lambda msg: msg.SerializeToString(),
+        )
+
+    v1 = grpc.method_handlers_generic_handler(
+        V1,
+        {
+            "GetRateLimits": unary(
+                get_rate_limits, pb.GetRateLimitsReq, pb.GetRateLimitsResp
+            ),
+            "HealthCheck": unary(health_check, pb.HealthCheckReq, pb.HealthCheckResp),
+            "LiveCheck": unary(live_check, pb.LiveCheckReq, pb.LiveCheckResp),
+        },
+    )
+    peers = grpc.method_handlers_generic_handler(
+        PEERS_V1,
+        {
+            "GetPeerRateLimits": unary(
+                get_peer_rate_limits,
+                peers_pb.GetPeerRateLimitsReq,
+                peers_pb.GetPeerRateLimitsResp,
+            ),
+            "UpdatePeerGlobals": unary(
+                update_peer_globals,
+                peers_pb.UpdatePeerGlobalsReq,
+                peers_pb.UpdatePeerGlobalsResp,
+            ),
+        },
+    )
+    return [v1, peers]
+
+
+def build_http_app(daemon) -> web.Application:
+    """The grpc-gateway analog: JSON in/out with proto field names
+    (UseProtoNames — reference daemon.go:267-273), plus /metrics."""
+
+    def to_json(msg) -> web.Response:
+        return web.json_response(
+            json_format.MessageToDict(
+                msg,
+                preserving_proto_field_name=True,
+                always_print_fields_with_no_presence=True,
+            )
+        )
+
+    async def get_rate_limits(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            req = json_format.ParseDict(body, pb.GetRateLimitsReq())
+        except Exception as exc:
+            return web.json_response(
+                {"code": 3, "message": f"invalid request: {exc}"}, status=400
+            )
+        try:
+            resps = await daemon.get_rate_limits(list(req.requests))
+        except ValueError as exc:
+            return web.json_response({"code": 3, "message": str(exc)}, status=400)
+        return to_json(pb.GetRateLimitsResp(responses=resps))
+
+    async def health(request: web.Request) -> web.Response:
+        return to_json(await daemon.health_check())
+
+    async def live(request: web.Request) -> web.Response:
+        try:
+            daemon.live_check()
+        except RuntimeError as exc:
+            return web.json_response({"code": 14, "message": str(exc)}, status=503)
+        return web.json_response({})
+
+    async def metrics(request: web.Request) -> web.Response:
+        daemon.metrics.cache_size.set(await daemon.runner.live_count())
+        return web.Response(
+            body=daemon.metrics.render(),
+            content_type="text/plain",
+            charset="utf-8",
+        )
+
+    app = web.Application()
+    app.router.add_post("/v1/GetRateLimits", get_rate_limits)
+    app.router.add_get("/v1/HealthCheck", health)
+    app.router.add_post("/v1/HealthCheck", health)
+    app.router.add_get("/v1/LiveCheck", live)
+    app.router.add_post("/v1/LiveCheck", live)
+    app.router.add_get("/metrics", metrics)
+    return app
+
+
+class GrpcHandle:
+    def __init__(self, server: grpc.aio.Server):
+        self.server = server
+
+    async def stop(self) -> None:
+        await self.server.stop(grace=1.0)
+
+
+class HttpHandle:
+    def __init__(self, runner: web.AppRunner):
+        self.runner = runner
+
+    async def stop(self) -> None:
+        await self.runner.cleanup()
+
+
+async def start_servers(daemon) -> None:
+    """Bind + start the gRPC server and HTTP gateway; records actual ports on
+    the daemon (port 0 supported for tests)."""
+    server = grpc.aio.server()
+    for h in build_grpc_services(daemon):
+        server.add_generic_rpc_handlers((h,))
+    creds = None
+    if daemon.conf.tls_cert_file or daemon.conf.tls_auto:
+        from gubernator_tpu.service.tls import server_credentials, client_credentials
+
+        creds = server_credentials(daemon.conf)
+        daemon._client_creds = client_credentials(daemon.conf)
+    if creds is not None:
+        port = server.add_secure_port(daemon.conf.grpc_address, creds)
+    else:
+        port = server.add_insecure_port(daemon.conf.grpc_address)
+    if port == 0:
+        raise RuntimeError(f"failed to bind {daemon.conf.grpc_address}")
+    daemon.grpc_port = port
+    # rewrite :0 addresses with the real port so advertise/peer wiring works
+    host = daemon.conf.grpc_address.rsplit(":", 1)[0]
+    daemon.conf.grpc_address = f"{host}:{port}"
+    if daemon.conf.advertise_address.endswith(":0"):
+        daemon.conf.advertise_address = f"{host}:{port}"
+    await server.start()
+    daemon._servers.append(GrpcHandle(server))
+
+    if daemon.conf.http_address:
+        app = build_http_app(daemon)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        hhost, _, hport = daemon.conf.http_address.rpartition(":")
+        site = web.TCPSite(runner, hhost or "127.0.0.1", int(hport))
+        await site.start()
+        real = runner.addresses[0][1] if runner.addresses else int(hport)
+        daemon.http_port = real
+        daemon.conf.http_address = f"{hhost or '127.0.0.1'}:{real}"
+        daemon._servers.append(HttpHandle(runner))

@@ -1,0 +1,143 @@
+"""TLS subsystem: file-based certs, auto self-signed CA, client-auth modes.
+
+Mirrors the reference's tls.go scope (reference tls.go:50-520): server + client
+credentials from PEM files, an AutoTLS mode that generates a self-signed CA and
+server certificate in memory (reference tls.go:364-520), and client-auth
+("require" = any client cert, "verify" = must chain to the CA — reference
+TLSConfig.ClientAuth). Certificates are built with `cryptography`; gRPC takes
+raw PEM bytes, aiohttp takes an ssl.SSLContext — both come from one CertBundle.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import ssl
+import tempfile
+from dataclasses import dataclass
+from typing import Optional
+
+import grpc
+
+
+@dataclass
+class CertBundle:
+    ca_pem: bytes
+    cert_pem: bytes
+    key_pem: bytes
+
+
+_auto_cache: dict = {}
+
+
+def generate_self_signed(hostnames=("localhost",)) -> CertBundle:
+    """Self-signed CA + server cert (reference AutoTLS, tls.go:364-520)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    ca_key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    ca_name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "gubernator-tpu auto CA")]
+    )
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(ca_name)
+        .issuer_name(ca_name)
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
+        .sign(ca_key, hashes.SHA256())
+    )
+    sans = []
+    for h in hostnames:
+        try:
+            sans.append(x509.IPAddress(ipaddress.ip_address(h)))
+        except ValueError:
+            sans.append(x509.DNSName(h))
+    sans.append(x509.IPAddress(ipaddress.ip_address("127.0.0.1")))
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(
+            x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, hostnames[0])])
+        )
+        .issuer_name(ca_name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(x509.SubjectAlternativeName(sans), critical=False)
+        .sign(ca_key, hashes.SHA256())
+    )
+    return CertBundle(
+        ca_pem=ca_cert.public_bytes(serialization.Encoding.PEM),
+        cert_pem=cert.public_bytes(serialization.Encoding.PEM),
+        key_pem=key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        ),
+    )
+
+
+def bundle_from_config(conf) -> CertBundle:
+    """Resolve the cert bundle once per daemon: files when given, else AutoTLS
+    (cached per advertise address so server and client sides agree)."""
+    if conf.tls_cert_file and conf.tls_key_file:
+        ca = b""
+        if conf.tls_ca_file:
+            with open(conf.tls_ca_file, "rb") as f:
+                ca = f.read()
+        with open(conf.tls_cert_file, "rb") as f:
+            cert = f.read()
+        with open(conf.tls_key_file, "rb") as f:
+            key = f.read()
+        return CertBundle(ca_pem=ca, cert_pem=cert, key_pem=key)
+    host = conf.advertise_address.rsplit(":", 1)[0] or "localhost"
+    if host not in _auto_cache:
+        _auto_cache[host] = generate_self_signed((host,))
+    return _auto_cache[host]
+
+
+def server_credentials(conf) -> grpc.ServerCredentials:
+    b = bundle_from_config(conf)
+    require = conf.tls_client_auth in ("require", "verify")
+    return grpc.ssl_server_credentials(
+        [(b.key_pem, b.cert_pem)],
+        root_certificates=b.ca_pem if require else None,
+        require_client_auth=require,
+    )
+
+
+def client_credentials(conf) -> grpc.ChannelCredentials:
+    """Peer-to-peer client credentials; with client-auth modes the peers
+    present the same cert (the reference's peers share the server TLS setup,
+    tls.go:138-238)."""
+    b = bundle_from_config(conf)
+    if conf.tls_client_auth in ("require", "verify"):
+        return grpc.ssl_channel_credentials(
+            root_certificates=b.ca_pem or None,
+            private_key=b.key_pem,
+            certificate_chain=b.cert_pem,
+        )
+    return grpc.ssl_channel_credentials(root_certificates=b.ca_pem or None)
+
+
+def http_ssl_context(conf) -> Optional[ssl.SSLContext]:
+    """Server-side ssl context for the HTTP gateway listener."""
+    b = bundle_from_config(conf)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    with tempfile.NamedTemporaryFile(suffix=".pem") as cf, tempfile.NamedTemporaryFile(
+        suffix=".pem"
+    ) as kf:
+        cf.write(b.cert_pem)
+        cf.flush()
+        kf.write(b.key_pem)
+        kf.flush()
+        ctx.load_cert_chain(cf.name, kf.name)
+    return ctx

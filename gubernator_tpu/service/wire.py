@@ -1,0 +1,137 @@
+"""Protobuf ↔ column conversion at the serving edge.
+
+The wire surface is the reference's exact proto schema (proto/gubernator.proto,
+re-created wire-compatibly); internally everything is columns
+(ops/batch.py RequestColumns). The per-item loops live here, at the edge, and
+nowhere else on the serving path.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from gubernator_tpu.hashing import fingerprint
+from gubernator_tpu.ops.batch import (
+    ERR_EMPTY_KEY,
+    ERR_EMPTY_NAME,
+    ERROR_STRINGS,
+    RequestColumns,
+    ResponseColumns,
+)
+from gubernator_tpu.proto import gubernator_pb2 as pb
+from gubernator_tpu.proto import peers_pb2 as peers_pb
+
+# the reference rejects batches above this size outright (gubernator.go:41-42)
+MAX_BATCH_SIZE = 1000
+
+
+def columns_from_pb(
+    items: Sequence["pb.RateLimitReq"],
+) -> Tuple[RequestColumns, List[str]]:
+    """RateLimitReq list → (RequestColumns, hash_keys). hash_keys feed the
+    peer ring (ownership is decided on the string key, reference
+    gubernator.go:243 + replicated_hash.go:104)."""
+    n = len(items)
+    fp = np.zeros(n, dtype=np.int64)
+    err = np.zeros(n, dtype=np.int8)
+    algo = np.zeros(n, dtype=np.int32)
+    behavior = np.zeros(n, dtype=np.int32)
+    hits = np.zeros(n, dtype=np.int64)
+    limit = np.zeros(n, dtype=np.int64)
+    burst = np.zeros(n, dtype=np.int64)
+    duration = np.zeros(n, dtype=np.int64)
+    created_at = np.zeros(n, dtype=np.int64)
+    hash_keys: List[str] = [""] * n
+    clip = 1 << 62
+    for i, r in enumerate(items):
+        if r.unique_key == "":
+            err[i] = ERR_EMPTY_KEY
+            continue
+        if r.name == "":
+            err[i] = ERR_EMPTY_NAME
+            continue
+        hash_keys[i] = r.name + "_" + r.unique_key
+        fp[i] = fingerprint(r.name, r.unique_key)
+        algo[i] = r.algorithm
+        behavior[i] = r.behavior
+        hits[i] = min(max(r.hits, -clip), clip)
+        limit[i] = min(max(r.limit, -clip), clip)
+        burst[i] = min(max(r.burst, -clip), clip)
+        duration[i] = min(max(r.duration, -clip), clip)
+        created_at[i] = r.created_at if r.HasField("created_at") else 0
+    return (
+        RequestColumns(
+            fp=fp, algo=algo, behavior=behavior, hits=hits, limit=limit,
+            burst=burst, duration=duration, created_at=created_at, err=err,
+        ),
+        hash_keys,
+    )
+
+
+def pb_from_response_columns(
+    rc: ResponseColumns, rows: Sequence[int] = None
+) -> List["pb.RateLimitResp"]:
+    """ResponseColumns → RateLimitResp list (optionally a row subset)."""
+    idx = range(rc.status.shape[0]) if rows is None else rows
+    return [
+        pb.RateLimitResp(
+            status=int(rc.status[i]),
+            limit=int(rc.limit[i]),
+            remaining=int(rc.remaining[i]),
+            reset_time=int(rc.reset_time[i]),
+            error=ERROR_STRINGS[int(rc.err[i])],
+        )
+        for i in idx
+    ]
+
+
+def subset_columns(cols: RequestColumns, rows: np.ndarray) -> RequestColumns:
+    return RequestColumns(*[f[rows] for f in cols])
+
+
+def concat_columns(parts: Sequence[RequestColumns]) -> RequestColumns:
+    if len(parts) == 1:
+        return parts[0]
+    return RequestColumns(
+        *[np.concatenate([p[k] for p in parts]) for k in range(len(parts[0]))]
+    )
+
+
+def empty_response_columns(n: int) -> ResponseColumns:
+    return ResponseColumns(
+        status=np.zeros(n, dtype=np.int32),
+        limit=np.zeros(n, dtype=np.int64),
+        remaining=np.zeros(n, dtype=np.int64),
+        reset_time=np.zeros(n, dtype=np.int64),
+        err=np.zeros(n, dtype=np.int8),
+    )
+
+
+def merge_response_columns(
+    dst: ResponseColumns, rows: np.ndarray, src: ResponseColumns
+) -> None:
+    """Scatter `src` (len(rows) entries) into `dst` at `rows` in place."""
+    dst.status[rows] = src.status
+    dst.limit[rows] = src.limit
+    dst.remaining[rows] = src.remaining
+    dst.reset_time[rows] = src.reset_time
+    dst.err[rows] = src.err
+
+
+def resp_pb_into_columns(
+    dst: ResponseColumns, rows: Sequence[int], resps: Sequence["pb.RateLimitResp"]
+) -> None:
+    """Install peer-returned RateLimitResp messages into response columns.
+    Free-form peer error strings don't fit the ERR_* enum; they're carried in
+    an overflow list keyed by row (see ResponseAssembly)."""
+    for row, r in zip(rows, resps):
+        dst.status[row] = r.status
+        dst.limit[row] = r.limit
+        dst.remaining[row] = r.remaining
+        dst.reset_time[row] = r.reset_time
+
+
+def peer_req_pb(items: Sequence["pb.RateLimitReq"]) -> "peers_pb.GetPeerRateLimitsReq":
+    return peers_pb.GetPeerRateLimitsReq(requests=items)

@@ -1,0 +1,99 @@
+"""Persistence hooks: checkpoint snapshots + Loader/Store interfaces.
+
+The reference never persists by default; `Loader` (startup/shutdown snapshot)
+and `Store` (continuous write-through) are embedding hooks the server wires
+when asked (reference store.go:49-78, workers.go:335-540). The TPU analogs:
+
+* snapshot = ONE device→host DMA of the whole packed-row table (Table2.rows)
+  written to disk; restore = one host→device put. The reference streams
+  CacheItems one by one through channels; here the state array IS the cache,
+  so checkpointing is a bulk array copy — structurally simpler and faster.
+* Store = a host-side hook invoked with batch-level change sets after each
+  dispatch (fingerprints only — the device holds state; embedders needing the
+  full mapping keep their own key→fp index, since raw keys never reach the
+  device by design, hashing.py).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+SNAPSHOT_MAGIC = "GUBTPU1"
+
+
+def save_snapshot(path: str, rows: np.ndarray) -> None:
+    """Atomically write a table snapshot (tmp + rename, so a crash mid-write
+    never leaves a torn file for the next boot)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".gubtpu-snap-")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, magic=np.frombuffer(
+                SNAPSHOT_MAGIC.encode(), dtype=np.uint8
+            ), rows=rows)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_snapshot(path: str) -> np.ndarray:
+    with np.load(path) as z:
+        magic = bytes(z["magic"]).decode()
+        if magic != SNAPSHOT_MAGIC:
+            raise ValueError(f"{path}: not a gubernator-tpu snapshot")
+        return z["rows"]
+
+
+@dataclass
+class ChangeSet:
+    """One dispatch's worth of state changes, host-visible form."""
+
+    fps: np.ndarray  # int64 fingerprints touched
+    created_at: int  # dispatch timestamp (ms)
+
+
+class Store:
+    """Write-through hook interface (reference store.go:63-78). Subclass and
+    pass to LocalEngine/daemon wiring; `on_change` fires after every dispatch
+    with the touched fingerprints. `get`/`remove` have no device analog —
+    misses are resolved by the table itself — but exist for interface parity
+    with embedders porting reference Store implementations."""
+
+    def on_change(self, change: ChangeSet) -> None:  # pragma: no cover
+        pass
+
+
+class Loader:
+    """Startup/shutdown snapshot interface (reference store.go:49-60)."""
+
+    def load(self) -> Optional[np.ndarray]:  # pragma: no cover
+        """Return table rows to restore, or None."""
+        return None
+
+    def save(self, rows: np.ndarray) -> None:  # pragma: no cover
+        pass
+
+
+class FileLoader(Loader):
+    """Loader backed by a snapshot file — what GUBER_CHECKPOINT_PATH wires."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def load(self) -> Optional[np.ndarray]:
+        if os.path.exists(self.path):
+            return load_snapshot(self.path)
+        return None
+
+    def save(self, rows: np.ndarray) -> None:
+        save_snapshot(self.path, rows)

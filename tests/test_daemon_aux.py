@@ -1,0 +1,240 @@
+"""Config system, DNS discovery, checkpoint/resume, TLS — the daemon's
+auxiliary subsystems (reference config.go / dns.go / store.go / tls.go)."""
+
+import asyncio
+import functools
+import os
+
+import pytest
+
+from gubernator_tpu.client import V1Client
+from gubernator_tpu.config import (
+    ConfigError,
+    DaemonConfig,
+    load_config_file,
+    setup_daemon_config,
+)
+from gubernator_tpu.types import RateLimitRequest
+
+from tests.cluster import daemon_config
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **k):
+        asyncio.run(fn(*a, **k))
+
+    return wrapper
+
+
+def req(key, hits=1, limit=5):
+    return RateLimitRequest(
+        name="aux", unique_key=key, hits=hits, limit=limit, duration=60_000
+    )
+
+
+# -------------------------------------------------------------------- config
+
+
+def test_config_from_env():
+    env = {
+        "GUBER_GRPC_ADDRESS": "127.0.0.1:9999",
+        "GUBER_HTTP_ADDRESS": "127.0.0.1:9998",
+        "GUBER_CACHE_SIZE": "12345",
+        "GUBER_BATCH_WAIT": "2ms",
+        "GUBER_GLOBAL_SYNC_WAIT": "1s",
+        "GUBER_BATCH_LIMIT": "500",
+        "GUBER_DATA_CENTER": "dc-west",
+        "GUBER_FORCE_GLOBAL": "true",
+    }
+    conf = setup_daemon_config(env=env)
+    assert conf.grpc_address == "127.0.0.1:9999"
+    assert conf.cache_size == 12345
+    assert conf.behaviors.batch_wait_ms == 2.0
+    assert conf.behaviors.global_sync_wait_ms == 1000.0
+    assert conf.behaviors.batch_limit == 500
+    assert conf.data_center == "dc-west"
+    assert conf.behaviors.force_global is True
+    assert conf.advertise_address == "127.0.0.1:9999"
+
+
+def test_config_file_seeds_env_but_real_env_wins(tmp_path):
+    f = tmp_path / "guber.conf"
+    f.write_text(
+        "# comment\n\nGUBER_CACHE_SIZE=777\nGUBER_DATA_CENTER = dc-file\n"
+    )
+    env = {"GUBER_DATA_CENTER": "dc-env"}
+    conf = setup_daemon_config(config_file=str(f), env=env)
+    assert conf.cache_size == 777  # from file
+    assert conf.data_center == "dc-env"  # real env wins (config.go:703-726)
+
+
+def test_config_validation_errors():
+    with pytest.raises(ConfigError, match="GUBER_PEER_DISCOVERY_TYPE"):
+        setup_daemon_config(env={"GUBER_PEER_DISCOVERY_TYPE": "etcd"})
+    with pytest.raises(ConfigError, match="GUBER_DNS_FQDN"):
+        setup_daemon_config(env={"GUBER_PEER_DISCOVERY_TYPE": "dns"})
+    with pytest.raises(ConfigError, match="GUBER_BATCH_LIMIT"):
+        setup_daemon_config(env={"GUBER_BATCH_LIMIT": "5000"})
+    with pytest.raises(ConfigError, match="integer"):
+        setup_daemon_config(env={"GUBER_CACHE_SIZE": "lots"})
+    with pytest.raises(ConfigError, match="key=value"):
+        import tempfile
+
+        with tempfile.NamedTemporaryFile("w", suffix=".conf", delete=False) as f:
+            f.write("not-a-pair\n")
+        try:
+            load_config_file(f.name, {})
+        finally:
+            os.unlink(f.name)
+
+
+# ----------------------------------------------------------------- discovery
+
+
+@async_test
+async def test_dns_pool_with_fake_resolver():
+    """DNS pool against an injected resolver (reference dns_test.go:81-294):
+    peer set follows record changes; empty answers never clear the list
+    (dns.go:253-264)."""
+    from gubernator_tpu.discovery.dns import DNSPool
+
+    answers = {"cluster.test": ["10.0.0.1", "10.0.0.2"]}
+    calls = []
+
+    def resolver(fqdn, port):
+        calls.append(fqdn)
+        return [f"{ip}:{port}" for ip in answers.get(fqdn, [])]
+
+    seen = []
+    pool = DNSPool(
+        fqdn="cluster.test",
+        poll_ms=20.0,
+        on_update=lambda peers: seen.append([p.grpc_address for p in peers]),
+        self_address="10.0.0.1:1051",
+        resolver=resolver,
+    )
+    await pool.start()
+    try:
+        assert seen == [["10.0.0.1:1051", "10.0.0.2:1051"]]
+        # a record appears → update fires once with the new set
+        answers["cluster.test"] = ["10.0.0.1", "10.0.0.2", "10.0.0.3"]
+        await asyncio.sleep(0.08)
+        assert seen[-1] == ["10.0.0.1:1051", "10.0.0.2:1051", "10.0.0.3:1051"]
+        n_updates = len(seen)
+        # resolver failure → stale list kept, no update fired
+        answers["cluster.test"] = []
+        await asyncio.sleep(0.08)
+        assert len(seen) == n_updates
+    finally:
+        await pool.close()
+
+
+@async_test
+async def test_daemon_boots_from_env_with_dns():
+    """Daemon boots from env alone (discovery=dns, fake-resolved to self)."""
+    from unittest import mock
+
+    from gubernator_tpu.discovery import dns as dns_mod
+    from gubernator_tpu.service.daemon import Daemon
+
+    conf = setup_daemon_config(
+        env={
+            "GUBER_GRPC_ADDRESS": "127.0.0.1:0",
+            "GUBER_HTTP_ADDRESS": "127.0.0.1:0",
+            "GUBER_PEER_DISCOVERY_TYPE": "dns",
+            "GUBER_DNS_FQDN": "self.test",
+            "GUBER_DNS_POLL": "50ms",
+            "GUBER_CACHE_SIZE": "4096",
+        }
+    )
+
+    def resolver(fqdn, port):
+        return [f"127.0.0.1:{port}"]
+
+    with mock.patch.object(dns_mod, "system_resolver", resolver):
+        d = await Daemon.spawn(conf)
+    try:
+        # resolver returned self → single-peer cluster, serving locally
+        client = V1Client(d.conf.grpc_address, timeout_s=15.0)
+        resp = await client.get_rate_limits([req("dns1")])
+        assert resp.responses[0].remaining == 4
+        assert d.local_peers()[0].is_owner
+        await client.close()
+    finally:
+        await d.close()
+
+
+# ---------------------------------------------------------------- checkpoint
+
+
+@async_test
+async def test_checkpoint_survives_restart(tmp_path):
+    """Kill/restart a daemon with GUBER_CHECKPOINT_PATH: remaining counts
+    survive (reference TestLoader, store_test.go:76)."""
+    from gubernator_tpu.service.daemon import Daemon
+
+    snap = str(tmp_path / "table.ckpt")
+    conf = daemon_config()
+    conf.checkpoint_path = snap
+    d = await Daemon.spawn(conf)
+    client = V1Client(d.conf.grpc_address, timeout_s=15.0)
+    resp = await client.get_rate_limits([req("ck1", hits=3, limit=10)])
+    assert resp.responses[0].remaining == 7
+    await client.close()
+    await d.close()  # checkpoint written on graceful shutdown
+    assert os.path.exists(snap)
+
+    d2 = await Daemon.spawn(conf)  # restores on boot
+    client = V1Client(d2.conf.grpc_address, timeout_s=15.0)
+    try:
+        resp = await client.get_rate_limits([req("ck1", hits=1, limit=10)])
+        assert resp.responses[0].remaining == 6  # 10 - 3 (restored) - 1
+    finally:
+        await client.close()
+        await d2.close()
+
+
+def test_snapshot_rejects_garbage(tmp_path):
+    import numpy as np
+
+    from gubernator_tpu.store import load_snapshot, save_snapshot
+
+    p = tmp_path / "x.ckpt"
+    np.savez(p, magic=np.frombuffer(b"NOTGUB!", dtype=np.uint8), rows=np.zeros(3))
+    with pytest.raises(ValueError, match="not a gubernator-tpu snapshot"):
+        load_snapshot(str(p) + ".npz")  # np.savez appends .npz
+    save_snapshot(str(p), np.arange(12, dtype=np.int32).reshape(3, 4))
+    assert load_snapshot(str(p)).tolist()[1] == [4, 5, 6, 7]
+
+
+# ----------------------------------------------------------------------- tls
+
+
+@async_test
+async def test_auto_tls_daemon():
+    """AutoTLS: self-signed CA + cert generated at boot; a client presenting
+    that CA connects; the gRPC listener speaks TLS (reference tls_test.go)."""
+    import grpc
+
+    from gubernator_tpu.service.daemon import Daemon
+    from gubernator_tpu.service.tls import bundle_from_config
+
+    conf = daemon_config()
+    conf.tls_auto = True
+    conf.http_address = ""  # gRPC-only for this test
+    d = await Daemon.spawn(conf)
+    try:
+        bundle = bundle_from_config(d.conf)
+        creds = grpc.ssl_channel_credentials(root_certificates=bundle.ca_pem)
+        client = V1Client(d.conf.grpc_address, credentials=creds, timeout_s=15.0)
+        resp = await client.get_rate_limits([req("tls1")])
+        assert resp.responses[0].remaining == 4
+        await client.close()
+        # plaintext client must NOT work against the TLS port
+        plain = V1Client(d.conf.grpc_address, timeout_s=2.0)
+        with pytest.raises(grpc.aio.AioRpcError):
+            await plain.get_rate_limits([req("tls2")])
+        await plain.close()
+    finally:
+        await d.close()

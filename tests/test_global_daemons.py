@@ -1,0 +1,148 @@
+"""Cross-daemon GLOBAL behavior — convergence asserted by scraping /metrics.
+
+The reference's signature distributed test technique (TestGlobalBehavior,
+functional_test.go:1760-2167): drive GLOBAL hits at specific daemons, poll
+each daemon's REAL /metrics endpoint for exact broadcast/update counts, then
+verify every peer converged to the same remaining.
+
+This covers the HOST peer plane (service/global_manager.py over gRPC); the
+in-mesh collective plane has its own suite (tests/test_global.py).
+"""
+
+import asyncio
+import functools
+
+from gubernator_tpu.client import V1Client
+from gubernator_tpu.types import Behavior, RateLimitRequest
+
+from tests.cluster import Cluster, metric_value, scrape, wait_for
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **k):
+        asyncio.run(fn(*a, **k))
+
+    return wrapper
+
+
+def greq(key, name="glob", hits=1, limit=100):
+    return RateLimitRequest(
+        name=name,
+        unique_key=key,
+        hits=hits,
+        limit=limit,
+        duration=60_000,
+        behavior=Behavior.GLOBAL,
+    )
+
+
+async def broadcast_count(daemon) -> float:
+    s = await scrape(daemon)
+    return metric_value(
+        s, "gubernator_broadcast_counter_total", condition="broadcast"
+    )
+
+
+async def updates_installed(daemon) -> float:
+    s = await scrape(daemon)
+    return metric_value(s, "gubernator_update_peer_globals_installed_total")
+
+
+@async_test
+async def test_global_hits_converge_via_owner_broadcast():
+    """Non-owner takes GLOBAL hits → async-sends to owner → owner broadcasts →
+    every peer's local answer converges (TestGlobalRateLimits analog,
+    functional_test.go:961)."""
+    c = await Cluster.start(3)
+    clients = {d.conf.advertise_address: V1Client(d.conf.grpc_address) for d in c.daemons}
+    try:
+        owner = c.find_owning_daemon("glob", "gk1")
+        non_owners = c.non_owning_daemons("glob", "gk1")
+        na = non_owners[0]
+        # 5 hits at a NON-owner: answered locally, queued async
+        resp = await clients[na.conf.advertise_address].get_rate_limits(
+            [greq("gk1", hits=5)]
+        )
+        (r,) = resp.responses
+        assert r.error == ""
+        assert r.remaining == 95  # local replica answered immediately
+
+        # owner applies the async hits and broadcasts exactly once
+        await wait_for(lambda: broadcast_count(owner))
+        # every non-owner installed the authoritative status
+        for d in non_owners:
+            await wait_for(lambda d=d: updates_installed(d))
+
+        # all daemons now agree (each answers locally with hits=0)
+        for d in c.daemons:
+            resp = await clients[d.conf.advertise_address].get_rate_limits(
+                [greq("gk1", hits=0)]
+            )
+            assert resp.responses[0].remaining == 95, d.conf.advertise_address
+
+        # EXACT counter accounting, scraped over the wire:
+        # the owner broadcast to 2 peers (not itself)
+        assert await broadcast_count(owner) == 2.0
+        for d in non_owners:
+            assert await broadcast_count(d) == 0.0
+            assert await updates_installed(d) == 1.0
+    finally:
+        for cl in clients.values():
+            await cl.close()
+        await c.stop()
+
+
+@async_test
+async def test_global_owner_hit_broadcasts():
+    """Hits AT the owner also queue a broadcast (QueueUpdate on the owner
+    path, gubernator.go:670-672)."""
+    c = await Cluster.start(3)
+    owner = c.find_owning_daemon("glob", "gk2")
+    client = V1Client(owner.conf.grpc_address)
+    try:
+        resp = await client.get_rate_limits([greq("gk2", hits=3)])
+        assert resp.responses[0].remaining == 97
+        await wait_for(lambda: broadcast_count(owner))
+        assert await broadcast_count(owner) == 2.0
+        for d in c.non_owning_daemons("glob", "gk2"):
+            await wait_for(lambda d=d: updates_installed(d))
+            # non-owner answers from its replica without contacting the owner
+            cl = V1Client(d.conf.grpc_address)
+            r = (await cl.get_rate_limits([greq("gk2", hits=0)])).responses[0]
+            await cl.close()
+            assert r.remaining == 97
+    finally:
+        await client.close()
+        await c.stop()
+
+
+@async_test
+async def test_global_aggregates_hits_across_non_owners():
+    """Hits from MULTIPLE non-owners aggregate on the owner; remaining
+    reflects the sum after one sync round (TestGlobalBehavior's
+    multi-updater case)."""
+    c = await Cluster.start(3)
+    clients = [V1Client(d.conf.grpc_address) for d in c.daemons]
+    try:
+        owner = c.find_owning_daemon("glob", "gk3")
+        owner_idx = c.daemons.index(owner)
+        total = 0
+        for i, d in enumerate(c.daemons):
+            if i == owner_idx:
+                continue
+            await clients[i].get_rate_limits([greq("gk3", hits=4)])
+            total += 4
+        await wait_for(lambda: broadcast_count(owner))
+
+        async def converged():
+            r = (
+                await clients[owner_idx].get_rate_limits([greq("gk3", hits=0)])
+            ).responses[0]
+            return r.remaining == 100 - total
+
+        await wait_for(converged)
+    finally:
+        for cl in clients:
+            await cl.close()
+        await c.stop()
