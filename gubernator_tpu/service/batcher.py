@@ -4,8 +4,9 @@ The reference's defining serving mechanic: requests arriving within a 500 µs
 window (up to a batch limit) coalesce into one batch (reference
 peer_client.go:289-344 does this toward peers; config.go:138-140 sets the
 window). Here the same window feeds the DEVICE: concurrent GetRateLimits
-handlers enqueue column slices, and each flush concatenates them into a single
-kernel dispatch — one TPU batch instead of one channel message per item.
+handlers enqueue column slices, and a dedicated flush loop concatenates them
+into a single kernel dispatch — one TPU batch instead of one channel message
+per item.
 
 NO_BATCHING items bypass the window (reference peer_client.go:126-162's fast
 path) by calling the runner directly.
@@ -29,7 +30,14 @@ DEFAULT_COALESCE_LIMIT = 16384
 
 
 class Batcher:
-    """Coalesce concurrent column batches into single engine dispatches."""
+    """Coalesce concurrent column batches into single engine dispatches.
+
+    One long-lived flush loop (the runBatch goroutine analog,
+    peer_client.go:289-344) wakes on enqueue, waits out the batch window
+    unless the coalesce limit is already met, and flushes. Items enqueued
+    while a flush's dispatch is in flight are picked up by the next loop
+    iteration — nothing can strand in the queue.
+    """
 
     def __init__(
         self,
@@ -44,8 +52,9 @@ class Batcher:
         self.metrics = metrics
         self._pending: List[Tuple[RequestColumns, asyncio.Future]] = []
         self._pending_rows = 0
-        self._flush_task: Optional[asyncio.Task] = None
-        self._flushing = False
+        self._wake: Optional[asyncio.Event] = None
+        self._loop_task: Optional[asyncio.Task] = None
+        self._closed = False
 
     async def check(
         self, cols: RequestColumns, now_ms: Optional[int] = None
@@ -64,30 +73,25 @@ class Batcher:
         self._pending_rows += cols.fp.shape[0]
         if self.metrics is not None:
             self.metrics.queue_length.set(self._pending_rows)
-        if self._pending_rows >= self.coalesce_limit:
-            self._kick(immediate=True)
+        if self._closed:
+            # shutdown path: no loop to wake; dispatch inline
+            await self._flush()
         else:
-            self._kick(immediate=False)
+            if self._loop_task is None or self._loop_task.done():
+                self._wake = asyncio.Event()
+                self._loop_task = loop.create_task(self._run())
+            self._wake.set()
         return await fut
 
-    def _kick(self, immediate: bool) -> None:
-        if self._flush_task is not None and not self._flush_task.done():
-            if immediate:
-                # already armed with a wait — replace with an immediate flush
-                self._flush_task.cancel()
-            else:
-                return
-        self._flush_task = asyncio.get_running_loop().create_task(
-            self._flush_after(0.0 if immediate else self.batch_wait_s)
-        )
-
-    async def _flush_after(self, delay: float) -> None:
-        if delay > 0:
-            try:
-                await asyncio.sleep(delay)
-            except asyncio.CancelledError:
-                return
-        await self._flush()
+    async def _run(self) -> None:
+        while not self._closed:
+            await self._wake.wait()
+            self._wake.clear()
+            if not self._pending:
+                continue
+            if self._pending_rows < self.coalesce_limit and self.batch_wait_s > 0:
+                await asyncio.sleep(self.batch_wait_s)
+            await self._flush()
 
     async def _flush(self) -> None:
         batch = self._pending
@@ -125,7 +129,11 @@ class Batcher:
             off += n
 
     async def drain(self) -> None:
-        """Flush anything pending (shutdown path)."""
-        if self._flush_task is not None and not self._flush_task.done():
-            self._flush_task.cancel()
+        """Stop the flush loop and flush anything pending (shutdown path).
+        Lets an in-flight flush finish rather than cancelling it — cancelled
+        flushes would strand their callers' futures."""
+        self._closed = True
+        if self._loop_task is not None and not self._loop_task.done():
+            self._wake.set()
+            await self._loop_task
         await self._flush()

@@ -12,7 +12,7 @@ import pytest
 from gubernator_tpu.client import V1Client
 from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest, Status
 
-from tests.cluster import Cluster, metric_value, scrape, daemon_config as test_config, wait_for
+from tests.cluster import Cluster, metric_value, scrape, daemon_config, wait_for
 
 
 def async_test(fn):
@@ -36,7 +36,7 @@ def req(key, name="svc", hits=1, limit=5, duration=60_000, **kw):
 async def test_single_daemon_over_limit_via_grpc():
     from gubernator_tpu.service.daemon import Daemon
 
-    d = await Daemon.spawn(test_config())
+    d = await Daemon.spawn(daemon_config())
     client = V1Client(d.conf.grpc_address)
     try:
         for expect_remaining, expect_status in [
@@ -61,7 +61,7 @@ async def test_single_daemon_over_limit_via_grpc():
 async def test_request_order_and_per_item_errors():
     from gubernator_tpu.service.daemon import Daemon
 
-    d = await Daemon.spawn(test_config())
+    d = await Daemon.spawn(daemon_config())
     client = V1Client(d.conf.grpc_address)
     try:
         resp = await client.get_rate_limits(
@@ -89,7 +89,7 @@ async def test_batch_too_large_rejected():
 
     from gubernator_tpu.service.daemon import Daemon
 
-    d = await Daemon.spawn(test_config())
+    d = await Daemon.spawn(daemon_config())
     client = V1Client(d.conf.grpc_address)
     try:
         with pytest.raises(grpc.aio.AioRpcError) as e:
@@ -108,7 +108,7 @@ async def test_http_gateway_json():
 
     from gubernator_tpu.service.daemon import Daemon
 
-    d = await Daemon.spawn(test_config())
+    d = await Daemon.spawn(daemon_config())
     try:
         base = f"http://{d.conf.http_address}"
         async with aiohttp.ClientSession() as s:
@@ -150,7 +150,7 @@ async def test_batching_coalesces_concurrent_requests():
     dispatch (the 500µs coalescing mechanic, peer_client.go:289-344 analog)."""
     from gubernator_tpu.service.daemon import Daemon
 
-    d = await Daemon.spawn(test_config())
+    d = await Daemon.spawn(daemon_config())
     # generous timeout: the coalesced batch shape compiles on first use
     client = V1Client(d.conf.grpc_address, timeout_s=30.0)
     try:
