@@ -18,9 +18,21 @@ int64 timestamps (epoch milliseconds) and float64 leaky-bucket remainders
 require jax x64 mode, enabled at import.
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Honor an explicit JAX_PLATFORMS=cpu request. Some environments bootstrap a
+# default accelerator platform via sitecustomize (e.g. the axon TPU tunnel
+# force-sets jax_platforms AND exports JAX_PLATFORMS), which would silently
+# override a user's CPU request — CPU-only deployments and tests must win.
+# Only the cpu case is re-asserted; any accelerator value is left to the
+# platform bootstrap, which knows how to initialize it.
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    if jax.config.jax_platforms != "cpu":
+        jax.config.update("jax_platforms", "cpu")
 
 from gubernator_tpu.types import (  # noqa: E402
     Algorithm,

@@ -1,0 +1,5 @@
+import sys
+
+from gubernator_tpu.cmd.server import main
+
+sys.exit(main())

@@ -1,0 +1,8 @@
+"""Entry-point binaries (the reference's cmd/ tree, L8):
+
+* ``python -m gubernator_tpu``               — server daemon (cmd/gubernator)
+* ``python -m gubernator_tpu.cmd.cli``       — load generator (cmd/gubernator-cli)
+* ``python -m gubernator_tpu.cmd.cluster``   — local in-process cluster
+                                               (cmd/gubernator-cluster)
+* ``python -m gubernator_tpu.cmd.healthcheck`` — k8s probe (cmd/healthcheck)
+"""

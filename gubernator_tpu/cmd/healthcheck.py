@@ -1,0 +1,69 @@
+"""Health probe binary for k8s liveness/readiness (reference
+cmd/healthcheck/main.go:34-105): GET /v1/HealthCheck with retries, exit code
+2 when the daemon answers but is unhealthy, 1 on transport errors.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+class NotHealthy(Exception):
+    pass
+
+
+def check(url: str, attempts: int, delay_s: float = 0.5, out=sys.stdout) -> None:
+    """Raises NotHealthy if the daemon reports unhealthy, URLError and friends
+    on transport failure; returns on success."""
+    last: Exception = RuntimeError("no attempts made")
+    for i in range(max(attempts, 1)):
+        req_url = f"http://{url}/v1/HealthCheck"
+        print(f'checking "{req_url}": attempt={i}', file=out)
+        try:
+            with urllib.request.urlopen(req_url, timeout=2.0) as resp:
+                hc = json.loads(resp.read().decode())
+        except Exception as exc:  # noqa: BLE001 - retried, rethrown at the end
+            last = exc
+            if i < attempts - 1:
+                time.sleep(delay_s)
+            continue
+        if hc.get("status") != "healthy":
+            last = NotHealthy(
+                f"not healthy: status={hc.get('status')!r} "
+                f"message={hc.get('message')!r} peer_count={hc.get('peer_count')} "
+                f"advertise_address={hc.get('advertise_address')!r}"
+            )
+            if i < attempts - 1:
+                time.sleep(delay_s)
+            continue
+        return
+    raise last
+
+
+def main(argv=None) -> int:
+    url = os.environ.get("GUBER_HTTP_ADDRESS") or "localhost:1050"
+    attempts_str = os.environ.get("GUBER_HTTP_RETRY_COUNT", "")
+    try:
+        attempts = int(attempts_str) if attempts_str else 1
+    except ValueError:
+        print(f"invalid GUBER_HTTP_RETRY_COUNT: {attempts_str!r}")
+        return 1
+    try:
+        check(url, attempts)
+    except NotHealthy as exc:
+        print(exc)
+        return 2
+    except Exception as exc:  # noqa: BLE001
+        print(exc)
+        return 1
+    print("is healthy")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -138,6 +138,10 @@ class DaemonConfig:
     # checkpoint/resume (SURVEY §5.4): snapshot file for the Loader hook
     checkpoint_path: str = ""
 
+    # accepted client created_at skew (ms); requests outside now±tolerance are
+    # clamped and counted (gubernator_created_at_clamped_count)
+    created_at_tolerance_ms: float = 5 * 60 * 1000.0
+
     log_level: str = "info"
     metric_flags: str = ""
 
@@ -165,6 +169,8 @@ class DaemonConfig:
             raise ConfigError("GUBER_BATCH_LIMIT must be in (0, 1000]")
         if self.tls_client_auth not in ("", "require", "verify"):
             raise ConfigError("GUBER_TLS_CLIENT_AUTH must be require or verify")
+        if self.created_at_tolerance_ms <= 0:
+            raise ConfigError("GUBER_CREATED_AT_TOLERANCE must be positive")
 
 
 def setup_daemon_config(
@@ -207,6 +213,9 @@ def setup_daemon_config(
         tls_auto=_get_bool(env, "GUBER_TLS_AUTO", False),
         tls_client_auth=_get(env, "GUBER_TLS_CLIENT_AUTH", ""),
         checkpoint_path=_get(env, "GUBER_CHECKPOINT_PATH", ""),
+        created_at_tolerance_ms=_get_float_ms(
+            env, "GUBER_CREATED_AT_TOLERANCE", 5 * 60 * 1000.0
+        ),
         log_level=_get(env, "GUBER_LOG_LEVEL", "info"),
         metric_flags=_get(env, "GUBER_METRIC_FLAGS", ""),
     )

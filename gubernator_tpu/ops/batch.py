@@ -31,6 +31,20 @@ INT32_MAX = 2**31 - 1
 # buckets. Frozen-time tests pass an explicit now_ms and matching created_at,
 # which never clamps.
 CREATED_AT_TOLERANCE_MS = 5 * 60 * 1000
+_created_at_tolerance_ms = CREATED_AT_TOLERANCE_MS
+
+
+def set_created_at_tolerance_ms(ms: int) -> None:
+    """Configure the accepted client clock skew (GUBER_CREATED_AT_TOLERANCE).
+    Replayed/queued traffic with legitimately old timestamps can raise it."""
+    global _created_at_tolerance_ms
+    if ms <= 0:
+        raise ValueError("created_at tolerance must be positive")
+    _created_at_tolerance_ms = int(ms)
+
+
+def created_at_tolerance_ms() -> int:
+    return _created_at_tolerance_ms
 
 
 class ReqBatch(NamedTuple):
@@ -173,12 +187,14 @@ def fingerprint_columns(names, keys) -> "tuple[np.ndarray, np.ndarray]":
 
 
 def pack_columns(
-    cols: RequestColumns, now_ms: int
+    cols: RequestColumns, now_ms: int, tolerance_ms: Optional[int] = None
 ) -> "tuple[HostBatch, np.ndarray]":
     """Vectorized resolution of a RequestColumns batch into a HostBatch.
     Mirrors pack_requests() semantics exactly (validation, created_at
     clamping, leaky burst defaulting, Gregorian resolution); returns
-    (batch, err_codes)."""
+    (batch, err_codes). `tolerance_ms` overrides the process-default clock
+    skew bound (engines thread their own configured value)."""
+    tol = _created_at_tolerance_ms if tolerance_ms is None else tolerance_ms
     n = cols.fp.shape[0]
     err = cols.err.copy()
     ok = err == ERR_OK
@@ -190,9 +206,7 @@ def pack_columns(
     err[bad_burst] = ERR_BURST_I32
 
     created = np.where(cols.created_at == 0, now_ms, cols.created_at)
-    created = np.clip(
-        created, now_ms - CREATED_AT_TOLERANCE_MS, now_ms + CREATED_AT_TOLERANCE_MS
-    )
+    created = np.clip(created, now_ms - tol, now_ms + tol)
     leaky = cols.algo == int(Algorithm.LEAKY_BUCKET)
     burst = np.where(leaky & (cols.burst == 0), cols.limit, cols.burst)
 
@@ -334,10 +348,10 @@ def pack_requests(
             errors[i] = "field 'burst' must fit int32"
             continue
         created = r.created_at if r.created_at is not None and r.created_at != 0 else now_ms
-        if created > now_ms + CREATED_AT_TOLERANCE_MS:
-            created = now_ms + CREATED_AT_TOLERANCE_MS
-        elif created < now_ms - CREATED_AT_TOLERANCE_MS:
-            created = now_ms - CREATED_AT_TOLERANCE_MS
+        if created > now_ms + _created_at_tolerance_ms:
+            created = now_ms + _created_at_tolerance_ms
+        elif created < now_ms - _created_at_tolerance_ms:
+            created = now_ms - _created_at_tolerance_ms
         b.fp[i] = fingerprint(r.name, r.unique_key)
         b.algo[i] = int(r.algorithm)
         b.behavior[i] = int(r.behavior)

@@ -31,7 +31,7 @@ from gubernator_tpu.ops.batch import (
     pad_batch,
     to_device,
 )
-from gubernator_tpu.ops.kernel2 import decide2, install2
+from gubernator_tpu.ops.kernel2 import decide2_packed, install2, pack_outputs
 from gubernator_tpu.ops.plan import plan_passes
 from gubernator_tpu.ops.table2 import Table2, new_table2
 from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
@@ -73,6 +73,7 @@ class EngineStats:
     dropped: int = 0
     checks: int = 0
     dispatches: int = 0
+    created_at_clamped: int = 0  # client timestamps outside the skew tolerance
 
     def accumulate(self, stats, count_dropped: bool = True) -> None:
         self.cache_hits += int(stats.cache_hits)
@@ -98,18 +99,27 @@ class LocalEngine:
         write_mode: Optional[str] = None,
         decide_fn: Optional[Callable] = None,
         table=None,
+        created_at_tolerance_ms: Optional[int] = None,
     ):
         self.table = table if table is not None else new_table2(capacity)
         self.write_mode = write_mode or default_write_mode()
         self._decide_fn = decide_fn
         self.max_exact_passes = max_exact_passes
         self.max_claim_retries = 3
+        # per-engine clock-skew bound; None = the ops.batch process default
+        self.created_at_tolerance_ms = created_at_tolerance_ms
         self.stats = EngineStats()
 
-    def _decide(self, rb):
+    def _decide_packed(self, rb) -> np.ndarray:
+        """One dispatch → ONE host fetch: the packed (B+2, 4) i64 output
+        (kernel2.pack_outputs). Updates self.table; returns the host array."""
         if self._decide_fn is not None:
-            return self._decide_fn(self.table, rb)
-        return decide2(self.table, rb, write=self.write_mode)
+            # oracle engines return unpacked outputs; pack on device for the
+            # same downstream shape
+            self.table, resp, stats = self._decide_fn(self.table, rb)
+            return np.asarray(pack_outputs(resp, stats))
+        self.table, packed = decide2_packed(self.table, rb, write=self.write_mode)
+        return np.asarray(packed)
 
     def check(
         self,
@@ -143,7 +153,10 @@ class LocalEngine:
         Per-request validation errors come back as ERR_* codes instead of
         failing the batch (reference gubernator.go:215-237)."""
         now = now_ms if now_ms is not None else ms_now()
-        hb, err = pack_columns(cols, now)
+        hb, err = pack_columns(cols, now, tolerance_ms=self.created_at_tolerance_ms)
+        self.stats.created_at_clamped += int(
+            ((cols.created_at != 0) & (hb.created_at != cols.created_at)).sum()
+        )
         n = hb.fp.shape[0]
         status = np.zeros(n, dtype=np.int32)
         limit_o = np.zeros(n, dtype=np.int64)
@@ -182,31 +195,32 @@ class LocalEngine:
         bucket within a single dispatch) are re-dispatched — the decision is
         only authoritative once persisted. Rows still unpersisted after
         `max_claim_retries` surface a per-item error (`ERR_NOT_PERSISTED`)."""
-        rb = to_device(batch)
-        self.table, resp, stats = self._decide(rb)
-        self.stats.accumulate(stats, count_dropped=False)
+        arr = self._decide_packed(to_device(batch))
+        self.stats.cache_hits += int(arr[-2, 0])
+        self.stats.cache_misses += int(arr[-2, 1])
+        self.stats.over_limit += int(arr[-2, 2])
+        self.stats.evicted_unexpired += int(arr[-2, 3])
         self.stats.dispatches += 1
-        status = np.asarray(resp.status)[:n].copy()
-        limit = np.asarray(resp.limit)[:n].copy()
-        remaining = np.asarray(resp.remaining)[:n].copy()
-        reset = np.asarray(resp.reset_time)[:n].copy()
+        limit = arr[:n, 0].copy()
+        remaining = arr[:n, 1].copy()
+        reset = arr[:n, 2].copy()
+        status = (arr[:n, 3] & 1).astype(np.int32)
+        dropped = (arr[:n, 3] & 4) != 0
         retries = 0
-        dropped = np.asarray(resp.dropped)[:n]
         while dropped.any() and retries < self.max_claim_retries:
             rows = np.nonzero(dropped)[0]
             sub = HostBatch(*[f[:n][rows] for f in batch])
             sub = pad_batch(sub, _pad_size(len(rows)))
-            rb = to_device(sub)
-            self.table, resp, stats = self._decide(rb)
+            arr = self._decide_packed(to_device(sub))
             self.stats.dispatches += 1
-            self.stats.evicted_unexpired += int(stats.evicted_unexpired)
+            self.stats.evicted_unexpired += int(arr[-2, 3])
             m = len(rows)
-            status[rows] = np.asarray(resp.status)[:m]
-            limit[rows] = np.asarray(resp.limit)[:m]
-            remaining[rows] = np.asarray(resp.remaining)[:m]
-            reset[rows] = np.asarray(resp.reset_time)[:m]
+            limit[rows] = arr[:m, 0]
+            remaining[rows] = arr[:m, 1]
+            reset[rows] = arr[:m, 2]
+            status[rows] = (arr[:m, 3] & 1).astype(np.int32)
             nd = np.zeros(n, dtype=bool)
-            nd[rows] = np.asarray(resp.dropped)[:m]
+            nd[rows] = (arr[:m, 3] & 4) != 0
             dropped = nd
             retries += 1
         # only rows still unpersisted after retries count as dropped

@@ -427,6 +427,43 @@ decide2 = functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("writ
 )
 
 
+def pack_outputs(resp: RespBatch, stats: BatchStats) -> jnp.ndarray:
+    """Pack responses + stats into ONE (B+2, 4) i64 array.
+
+    The serving engine reads kernel results with a single device→host
+    transfer: each fetched array costs a full round trip on the tunneled axon
+    platform (~100 ms), and even on a co-located TPU host one DMA beats six.
+    Layout: row i < B = [limit, remaining, reset_time, flags] with
+    flags = status | cache_hit<<1 | dropped<<2; row B = [cache_hits,
+    cache_misses, over_limit, evicted_unexpired]; row B+1 = [dropped, 0, 0, 0].
+    """
+    flags = (
+        resp.status.astype(i64)
+        | (resp.cache_hit.astype(i64) << 1)
+        | (resp.dropped.astype(i64) << 2)
+    )
+    rows = jnp.stack([resp.limit, resp.remaining, resp.reset_time, flags], axis=1)
+    z = jnp.zeros((), dtype=i64)
+    srow0 = jnp.stack(
+        [stats.cache_hits, stats.cache_misses, stats.over_limit,
+         stats.evicted_unexpired]
+    )[None, :]
+    srow1 = jnp.stack([stats.dropped, z, z, z])[None, :]
+    return jnp.concatenate([rows, srow0, srow1], axis=0)
+
+
+def decide2_packed_impl(
+    table: Table2, req: ReqBatch, *, write: str = "sweep"
+) -> Tuple[Table2, jnp.ndarray]:
+    table, resp, stats = decide2_impl(table, req, write=write)
+    return table, pack_outputs(resp, stats)
+
+
+decide2_packed = functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("write",)
+)(decide2_packed_impl)
+
+
 # -------------------------------------------------------------------- install
 
 

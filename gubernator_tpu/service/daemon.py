@@ -44,7 +44,8 @@ from gubernator_tpu.service.wire import (
     pb_from_response_columns,
     subset_columns,
 )
-from gubernator_tpu.types import Behavior, PeerInfo, has_behavior
+from gubernator_tpu.types import Behavior, HitEvent, PeerInfo, has_behavior
+from gubernator_tpu import tracing
 
 FORWARD_RETRIES = 5  # reference asyncRequest retries (gubernator.go:333-359)
 
@@ -63,12 +64,23 @@ def _hashkey_fp(key: str) -> int:
 class Daemon:
     """One serving process. Use `await Daemon.spawn(conf)`."""
 
-    def __init__(self, conf: DaemonConfig, engine: Optional[LocalEngine] = None):
+    def __init__(
+        self,
+        conf: DaemonConfig,
+        engine: Optional[LocalEngine] = None,
+        event_channel: Optional[asyncio.Queue] = None,
+    ):
         conf.validate()
         self.conf = conf
+        # optional audit hook: HitEvent per owner-side hit (reference
+        # config.go:128-135); non-blocking — events drop when the consumer
+        # lags rather than stalling the serving path
+        self.event_channel = event_channel
+        self.events_dropped = 0
         self.metrics = DaemonMetrics()
         self.engine = engine if engine is not None else LocalEngine(
-            capacity=conf.cache_size
+            capacity=conf.cache_size,
+            created_at_tolerance_ms=int(conf.created_at_tolerance_ms),
         )
         self.runner = EngineRunner(self.engine, metrics=self.metrics)
         self.batcher = Batcher(
@@ -89,10 +101,15 @@ class Daemon:
 
     # ---------------------------------------------------------------- spawn
     @classmethod
-    async def spawn(cls, conf: DaemonConfig, engine: Optional[LocalEngine] = None):
+    async def spawn(
+        cls,
+        conf: DaemonConfig,
+        engine: Optional[LocalEngine] = None,
+        event_channel: Optional[asyncio.Queue] = None,
+    ):
         """SpawnDaemon analog (reference daemon.go:75-88): build, restore
         checkpoint, start listeners + loops + discovery."""
-        d = cls(conf, engine=engine)
+        d = cls(conf, engine=engine, event_channel=event_channel)
         d.maybe_restore()
         await d.warm_up()
         from gubernator_tpu.service.server import start_servers
@@ -229,9 +246,20 @@ class Daemon:
                 f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'"
             )
         self.metrics.concurrent_checks.inc()
+        # ingress scope: adopt the client's trace when one is propagated in
+        # request metadata, else start a fresh root span
+        token = None
+        for it in items:
+            parent = tracing.extract(it.metadata)
+            if parent is not None:
+                token = tracing.start_scope("GetRateLimits", parent)
+                break
+        if token is None:
+            token = tracing.start_scope("GetRateLimits")
         try:
             return await self._route(items)
         finally:
+            tracing.end_scope(token)
             self.metrics.concurrent_checks.dec()
 
     async def _route(self, items) -> List["pb.RateLimitResp"]:
@@ -291,12 +319,25 @@ class Daemon:
         # getLocalRateLimit → QueueUpdate, gubernator.go:670-672)
         for i in owner_global_rows:
             self.global_manager.queue_update(hash_keys[i], items[i])
+        # audit events fire for locally-executed (owner-side) hits only
+        # (reference gubernator.go:676-688)
+        if self.event_channel is not None:
+            for i in local_rows:
+                self._emit_event(items[i], out[i])
         for i in range(n):
             if out[i] is None:  # pragma: no cover - defensive
                 out[i] = pb.RateLimitResp(error="internal: row not routed")
             if out[i].status == pb.OVER_LIMIT:
                 self.metrics.over_limit_counter.inc()
         return out  # type: ignore[return-value]
+
+    def _emit_event(self, item, resp) -> None:
+        if resp is None:  # pragma: no cover - defensive
+            return
+        try:
+            self.event_channel.put_nowait(HitEvent(request=item, response=resp))
+        except asyncio.QueueFull:
+            self.events_dropped += 1
 
     async def _check_rows(self, cols, rows: np.ndarray, out) -> None:
         await self._check_subset(subset_columns(cols, rows), rows, out)
@@ -347,7 +388,23 @@ class Daemon:
         gubernator.go:476-559). GLOBAL-accumulated hits apply with
         DRAIN_OVER_LIMIT forced (gubernator.go:526-532)."""
         items = list(req.requests)
-        keys = []
+        # pick up the forwarder's trace context (reference gubernator.go:522-524
+        # extracts the propagated TraceContext from request metadata)
+        token = None
+        for it in items:
+            parent = tracing.extract(it.metadata)
+            if parent is not None:
+                token = tracing.start_scope("GetPeerRateLimits", parent)
+                break
+        try:
+            return await self._get_peer_rate_limits(items)
+        finally:
+            if token is not None:
+                tracing.end_scope(token)
+
+    async def _get_peer_rate_limits(
+        self, items
+    ) -> "peers_pb.GetPeerRateLimitsResp":
         for it in items:
             if has_behavior(it.behavior, Behavior.GLOBAL):
                 it.behavior |= int(Behavior.DRAIN_OVER_LIMIT)
@@ -359,9 +416,13 @@ class Daemon:
         for i, it in enumerate(items):
             if has_behavior(it.behavior, Behavior.GLOBAL) and cols.err[i] == 0:
                 self.global_manager.queue_update(hash_keys[i], it)
-        return peers_pb.GetPeerRateLimitsResp(
-            rate_limits=pb_from_response_columns(rc)
-        )
+        resps = pb_from_response_columns(rc)
+        if self.event_channel is not None:
+            # peer-batch execution is owner-side too (the reference's event
+            # fires inside getLocalRateLimit, on every owner execution)
+            for it, r in zip(items, resps):
+                self._emit_event(it, r)
+        return peers_pb.GetPeerRateLimitsResp(rate_limits=resps)
 
     async def update_peer_globals(
         self, req: "peers_pb.UpdatePeerGlobalsReq"

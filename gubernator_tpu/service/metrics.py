@@ -139,6 +139,11 @@ class DaemonMetrics:
             "Authoritative GLOBAL statuses installed from owner broadcasts",
             registry=r,
         )
+        self.created_at_clamped = Counter(
+            "gubernator_created_at_clamped_count",
+            "Requests whose client created_at was outside the skew tolerance",
+            registry=r,
+        )
 
     def observe_engine(self, stats) -> None:
         """Refresh counter families from an EngineStats snapshot (engine
@@ -148,7 +153,9 @@ class DaemonMetrics:
         # the difference.
         last = getattr(self, "_last_engine", None)
         if last is None:
-            last = dict(hits=0, misses=0, over=0, evic=0, dropped=0, disp=0)
+            last = dict(
+                hits=0, misses=0, over=0, evic=0, dropped=0, disp=0, clamped=0
+            )
         d_hits = stats.cache_hits - last["hits"]
         d_miss = stats.cache_misses - last["misses"]
         d_over = stats.over_limit - last["over"]
@@ -167,6 +174,9 @@ class DaemonMetrics:
             self.dropped_rows.inc(d_drop)
         if d_disp > 0:
             self.dispatch_count.inc(d_disp)
+        d_clamp = stats.created_at_clamped - last.get("clamped", 0)
+        if d_clamp > 0:
+            self.created_at_clamped.inc(d_clamp)
         self._last_engine = dict(
             hits=stats.cache_hits,
             misses=stats.cache_misses,
@@ -174,6 +184,7 @@ class DaemonMetrics:
             evic=stats.evicted_unexpired,
             dropped=stats.dropped,
             disp=stats.dispatches,
+            clamped=stats.created_at_clamped,
         )
 
     def render(self) -> bytes:

@@ -18,6 +18,7 @@ from typing import List, Optional, Tuple
 
 import grpc
 
+from gubernator_tpu import tracing
 from gubernator_tpu.proto import gubernator_pb2 as pb
 from gubernator_tpu.proto import peers_pb2 as peers_pb
 from gubernator_tpu.types import Behavior, PeerInfo, has_behavior
@@ -120,6 +121,9 @@ class PeerClient:
         sends go direct (reference peer_client.go:126-162)."""
         if self._closed:
             raise PeerError(self.info.grpc_address, RuntimeError("peer client closed"))
+        # propagate the active trace to the owner via request metadata
+        # (reference peer_client.go:140-142, 364-367)
+        tracing.inject(item.metadata)
         if has_behavior(item.behavior, Behavior.NO_BATCHING):
             resp = await self.get_peer_rate_limits(
                 peers_pb.GetPeerRateLimitsReq(requests=[item])

@@ -1,0 +1,100 @@
+"""Trace propagation across the peer mesh — the MetadataCarrier analog.
+
+The reference injects W3C TraceContext into `RateLimitReq.Metadata` on the
+forwarding side and extracts it on the owner so one client request is a single
+distributed trace across daemons (reference metadata_carrier.go:19-40,
+peer_client.go:140-142, gubernator.go:522-524). OTEL itself is not a baked-in
+dependency here, so this module implements the W3C `traceparent` header format
+directly (https://www.w3.org/TR/trace-context/) over a contextvar, plus an
+optional span-event hook embedders can point at their own tracer.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import secrets
+from dataclasses import dataclass
+from typing import Callable, Mapping, Optional
+
+TRACEPARENT_KEY = "traceparent"
+_FLAG_SAMPLED = 0x01
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    trace_id: str  # 32 lowercase hex chars
+    span_id: str  # 16 lowercase hex chars
+    flags: int = _FLAG_SAMPLED
+
+    def to_traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-{self.flags:02x}"
+
+
+_current: contextvars.ContextVar[Optional[SpanContext]] = contextvars.ContextVar(
+    "gubernator_tpu_span", default=None
+)
+
+# embedder hook: called with (name, SpanContext) whenever a scope starts;
+# wire this to a real tracer (OTEL etc.) if you have one
+span_hook: Optional[Callable[[str, SpanContext], None]] = None
+
+
+def current_span() -> Optional[SpanContext]:
+    return _current.get()
+
+
+def new_span(parent: Optional[SpanContext] = None) -> SpanContext:
+    """A child of `parent` (same trace), or a fresh root."""
+    return SpanContext(
+        trace_id=parent.trace_id if parent else secrets.token_hex(16),
+        span_id=secrets.token_hex(8),
+        flags=parent.flags if parent else _FLAG_SAMPLED,
+    )
+
+
+def start_scope(name: str, parent: Optional[SpanContext] = None):
+    """Begin a scope: set the current span (child of parent or of the ambient
+    span) and return a contextvars token to pass to end_scope. The
+    tracing.StartNamedScope analog."""
+    span = new_span(parent if parent is not None else _current.get())
+    if span_hook is not None:
+        span_hook(name, span)
+    return _current.set(span)
+
+
+def end_scope(token) -> None:
+    _current.reset(token)
+
+
+def parse_traceparent(value: str) -> Optional[SpanContext]:
+    """Parse a W3C traceparent header; None on anything malformed (invalid
+    inbound context must not break serving)."""
+    parts = value.strip().lower().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, flags = parts
+    if len(version) != 2 or len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    try:
+        int(version, 16), int(trace_id, 16), int(span_id, 16)
+        f = int(flags, 16)
+    except ValueError:
+        return None
+    return SpanContext(trace_id=trace_id, span_id=span_id, flags=f)
+
+
+def inject(metadata) -> None:
+    """Write the current span into a RateLimitReq.metadata map (the carrier's
+    Set side, metadata_carrier.go:33-36). No-op when there is no active span."""
+    span = _current.get()
+    if span is not None:
+        metadata[TRACEPARENT_KEY] = span.to_traceparent()
+
+
+def extract(metadata: Mapping[str, str]) -> Optional[SpanContext]:
+    """Read a span from a RateLimitReq.metadata map (the carrier's Get side,
+    metadata_carrier.go:24-31)."""
+    raw = metadata.get(TRACEPARENT_KEY, "")
+    return parse_traceparent(raw) if raw else None

@@ -109,3 +109,14 @@ class PeerInfo:
 
     def hash_key(self) -> str:
         return self.grpc_address
+
+
+@dataclass
+class HitEvent:
+    """One owner-side hit: the request and the response it produced — the
+    audit/sampling hook payload (reference config.go:128-135,
+    gubernator.go:676-688). Delivered on the daemon's event channel when one
+    is configured; fields are pb messages (RateLimitReq / RateLimitResp)."""
+
+    request: object
+    response: object

@@ -1,0 +1,152 @@
+"""Event channel + trace propagation tests (reference TestEventChannel
+functional_test.go:2169 and the MetadataCarrier propagation,
+metadata_carrier.go / peer_client.go:140-142)."""
+
+import asyncio
+import functools
+
+import pytest
+
+from gubernator_tpu import tracing
+from gubernator_tpu.client import V1Client
+from gubernator_tpu.types import Behavior, RateLimitRequest
+
+from tests.cluster import Cluster, daemon_config, wait_for
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **k):
+        asyncio.run(fn(*a, **k))
+
+    return wrapper
+
+
+def req(key, name="ev", hits=1, limit=10, **kw):
+    return RateLimitRequest(
+        name=name, unique_key=key, hits=hits, limit=limit, duration=60_000, **kw
+    )
+
+
+# ------------------------------------------------------------- event channel
+
+
+@async_test
+async def test_event_channel_fires_for_owner_hits():
+    from gubernator_tpu.service.daemon import Daemon
+
+    events: asyncio.Queue = asyncio.Queue()
+    d = await Daemon.spawn(daemon_config(), event_channel=events)
+    client = V1Client(d.conf.grpc_address)
+    try:
+        await client.get_rate_limits([req("a"), req("b", hits=3)])
+        got = [await asyncio.wait_for(events.get(), 5) for _ in range(2)]
+        by_key = {e.request.unique_key: e for e in got}
+        assert set(by_key) == {"a", "b"}
+        assert by_key["a"].response.remaining == 9
+        assert by_key["b"].response.remaining == 7
+        assert by_key["b"].request.hits == 3
+    finally:
+        await client.close()
+        await d.close()
+
+
+@async_test
+async def test_event_channel_fires_on_owner_for_forwarded_hits():
+    """Forwarded items raise the event on the OWNER daemon, not the
+    forwarder (the reference's event fires inside getLocalRateLimit)."""
+    channels = {}
+
+    async def start(n):
+        ds = []
+        from gubernator_tpu.service.daemon import Daemon
+        from gubernator_tpu.types import PeerInfo
+
+        for i in range(n):
+            q = asyncio.Queue()
+            dd = await Daemon.spawn(daemon_config(), event_channel=q)
+            channels[dd.conf.advertise_address] = q
+            ds.append(dd)
+        peers = [dd.peer_info() for dd in ds]
+        for dd in ds:
+            dd.set_peers([PeerInfo(**vars(p)) for p in peers])
+        return Cluster(ds)
+
+    c = await start(3)
+    try:
+        owner = c.find_owning_daemon("ev", "fwd-key")
+        non_owner = c.non_owning_daemons("ev", "fwd-key")[0]
+        client = V1Client(non_owner.conf.grpc_address)
+        try:
+            resp = await client.get_rate_limits([req("fwd-key")])
+            assert resp.responses[0].error == ""
+        finally:
+            await client.close()
+        ev = await asyncio.wait_for(
+            channels[owner.conf.advertise_address].get(), 5
+        )
+        assert ev.request.unique_key == "fwd-key"
+        assert channels[non_owner.conf.advertise_address].empty()
+    finally:
+        await c.stop()
+
+
+# ------------------------------------------------------------------ tracing
+
+
+def test_traceparent_roundtrip_and_malformed():
+    span = tracing.new_span()
+    meta = {}
+    tok = tracing._current.set(span)
+    try:
+        tracing.inject(meta)
+    finally:
+        tracing._current.reset(tok)
+    got = tracing.extract(meta)
+    assert got == span
+
+    assert tracing.parse_traceparent("nonsense") is None
+    assert tracing.parse_traceparent("00-" + "0" * 32 + "-" + "1" * 16 + "-01") is None
+    assert tracing.parse_traceparent("00-" + "a" * 32 + "-" + "0" * 16 + "-01") is None
+    assert tracing.parse_traceparent("zz-" + "a" * 32 + "-" + "b" * 16 + "-xx") is None
+    ok = tracing.parse_traceparent("00-" + "a" * 32 + "-" + "b" * 16 + "-01")
+    assert ok is not None and ok.trace_id == "a" * 32
+
+
+@async_test
+async def test_trace_propagates_to_owner_across_forwarding():
+    """A client-supplied traceparent must arrive at the owner daemon with the
+    same trace_id (one distributed trace per request)."""
+    c = await Cluster.start(3)
+    seen = []
+    old_hook = tracing.span_hook
+    tracing.span_hook = lambda name, span: seen.append((name, span))
+    try:
+        non_owner = c.non_owning_daemons("trace", "tkey")[0]
+        client = V1Client(non_owner.conf.grpc_address)
+        trace_id = "ab" * 16
+        try:
+            resp = await client.get_rate_limits(
+                [
+                    req(
+                        "tkey",
+                        name="trace",
+                        metadata={"traceparent": f"00-{trace_id}-{'cd' * 8}-01"},
+                    )
+                ]
+            )
+            assert resp.responses[0].error == ""
+        finally:
+            await client.close()
+        await wait_for(
+            lambda: asyncio.sleep(0, [n for n, s in seen if n == "GetPeerRateLimits"])
+        )
+        peer_scopes = [s for n, s in seen if n == "GetPeerRateLimits"]
+        assert any(s.trace_id == trace_id for s in peer_scopes), (
+            f"owner never saw trace {trace_id}: {seen}"
+        )
+        ingress = [s for n, s in seen if n == "GetRateLimits"]
+        assert any(s.trace_id == trace_id for s in ingress)
+    finally:
+        tracing.span_hook = old_hook
+        await c.stop()

@@ -178,3 +178,33 @@ def test_peers_package_imports():
     assert {o.data_center for o in owners} == {"dc-a", "dc-b"}
     assert rp.get_by_address("10.0.1.1:81").data_center == "dc-b"
     assert rp.size() == 3
+
+
+def test_clamp_is_counted_and_configurable(frozen_now):
+    from gubernator_tpu.ops import batch as batch_mod
+    from gubernator_tpu.ops.batch import (
+        columns_from_requests,
+        created_at_tolerance_ms,
+        set_created_at_tolerance_ms,
+    )
+
+    eng = LocalEngine(capacity=256)
+    skewed = RateLimitRequest(
+        name="t", unique_key="skew", hits=1, limit=10, duration=MINUTE,
+        created_at=frozen_now - 10 * batch_mod.CREATED_AT_TOLERANCE_MS,
+    )
+    eng.check_columns(columns_from_requests([req("ok"), skewed]), now_ms=frozen_now)
+    assert eng.stats.created_at_clamped == 1
+
+    # widening the tolerance stops the clamping (GUBER_CREATED_AT_TOLERANCE)
+    old = created_at_tolerance_ms()
+    try:
+        set_created_at_tolerance_ms(20 * batch_mod.CREATED_AT_TOLERANCE_MS)
+        eng.check_columns(
+            columns_from_requests([skewed]), now_ms=frozen_now
+        )
+        assert eng.stats.created_at_clamped == 1  # unchanged
+    finally:
+        set_created_at_tolerance_ms(old)
+    with pytest.raises(ValueError):
+        set_created_at_tolerance_ms(0)
