@@ -61,13 +61,31 @@ class OpenLoopLimiter:
             await asyncio.sleep(delay)
 
 
+RESERVOIR_CAP = 100_000
+
+
 class Stats:
+    """Latencies go into a bounded reservoir sample (uniform over the run) so
+    endless soak runs report percentiles in O(1) memory; max is exact."""
+
     def __init__(self):
         self.requests = 0
         self.checks = 0
         self.over_limit = 0
         self.errors = 0
         self.latencies: List[float] = []
+        self.max_latency = 0.0
+        self._observed = 0
+
+    def observe(self, latency_s: float) -> None:
+        self.max_latency = max(self.max_latency, latency_s)
+        self._observed += 1
+        if len(self.latencies) < RESERVOIR_CAP:
+            self.latencies.append(latency_s)
+        else:
+            j = random.randrange(self._observed)
+            if j < RESERVOIR_CAP:
+                self.latencies[j] = latency_s
 
     def report(self, elapsed: float) -> dict:
         lat = sorted(self.latencies)
@@ -85,7 +103,7 @@ class Stats:
             "latency_ms": {
                 "p50": round(pct(0.50), 2),
                 "p99": round(pct(0.99), 2),
-                "max": round(lat[-1] * 1e3, 2) if lat else 0.0,
+                "max": round(self.max_latency * 1e3, 2),
             },
         }
 
@@ -111,7 +129,7 @@ async def run(args, stats: Stats) -> None:
                 if not args.quiet:
                     log.error("GetRateLimits: %s", exc)
                 return
-            stats.latencies.append(time.perf_counter() - t0)
+            stats.observe(time.perf_counter() - t0)
             stats.requests += 1
             stats.checks += len(batch)
             for item, r in zip(batch, resp.responses):

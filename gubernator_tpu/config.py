@@ -116,17 +116,40 @@ class DaemonConfig:
     instance_id: str = ""
 
     cache_size: int = 50_000  # CacheSize (config.go:151) → table capacity
+    # auto-grow: double the device table when live keys pass 60% of capacity
+    # (0 = fixed size like the reference's LRU; >0 = growth ceiling in slots)
+    cache_max_size: int = 0
     engine: str = "local"  # "local" (one device) | "sharded" (mesh)
     workers: int = 0  # 0 = auto; host-side executor width
 
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
 
     # peer discovery (reference config.go:359-363: {none, dns, k8s, etcd,
-    # member-list}; TPU build implements none + dns, the set the reference
-    # test-suite itself relies on)
+    # member-list})
     peer_discovery_type: str = "none"
     dns_fqdn: str = ""
     dns_poll_ms: float = 5_000.0
+
+    # etcd discovery (reference etcd.go; GUBER_ETCD_*)
+    etcd_endpoint: str = ""  # http(s)://host:port of the v3 JSON gateway
+    etcd_key_prefix: str = "/gubernator/peers/"
+    etcd_lease_ttl_s: int = 30
+    etcd_poll_ms: float = 2_000.0
+
+    # member-list gossip discovery (reference memberlist.go; GUBER_MEMBERLIST_*)
+    memberlist_address: str = ""  # gossip bind address (host:port)
+    memberlist_advertise_address: str = ""
+    memberlist_known_nodes: str = ""  # comma-separated seed gossip addresses
+    memberlist_gossip_interval_ms: float = 500.0
+
+    # kubernetes discovery (reference kubernetes.go; GUBER_K8S_*)
+    k8s_namespace: str = "default"
+    k8s_pod_ip: str = ""
+    k8s_pod_port: str = ""
+    k8s_selector: str = ""  # endpoints/pods label selector
+    k8s_mechanism: str = "endpointslices"  # or "pods"
+    k8s_api_url: str = ""  # override for tests; default in-cluster
+    k8s_poll_ms: float = 5_000.0
 
     # TLS (reference tls.go); empty = plaintext
     tls_ca_file: str = ""
@@ -152,14 +175,38 @@ class DaemonConfig:
             self.instance_id = instance_id()
 
     def validate(self) -> None:
-        if self.peer_discovery_type not in ("none", "dns"):
+        if self.peer_discovery_type not in (
+            "none", "dns", "etcd", "member-list", "k8s",
+        ):
             raise ConfigError(
                 f"GUBER_PEER_DISCOVERY_TYPE: unknown type "
-                f"{self.peer_discovery_type!r}; must be one of: none, dns "
-                "(k8s/etcd/member-list are not implemented in the TPU build)"
+                f"{self.peer_discovery_type!r}; must be one of: none, dns, "
+                "etcd, member-list, k8s"
             )
         if self.peer_discovery_type == "dns" and not self.dns_fqdn:
             raise ConfigError("GUBER_DNS_FQDN is required when GUBER_PEER_DISCOVERY_TYPE=dns")
+        if self.peer_discovery_type == "etcd" and not self.etcd_endpoint:
+            raise ConfigError(
+                "GUBER_ETCD_ENDPOINT is required when GUBER_PEER_DISCOVERY_TYPE=etcd"
+            )
+        if self.peer_discovery_type == "member-list" and not self.memberlist_address:
+            raise ConfigError(
+                "GUBER_MEMBERLIST_ADDRESS is required when "
+                "GUBER_PEER_DISCOVERY_TYPE=member-list"
+            )
+        if self.k8s_mechanism not in ("endpointslices", "pods"):
+            raise ConfigError(
+                "GUBER_K8S_WATCH_MECHANISM must be endpointslices or pods"
+            )
+        if self.peer_discovery_type == "k8s" and not self.k8s_selector:
+            # without a selector the pool would list EVERY workload in the
+            # namespace and forward rate-limit RPCs to unrelated pods
+            raise ConfigError(
+                "GUBER_K8S_ENDPOINTS_SELECTOR is required when "
+                "GUBER_PEER_DISCOVERY_TYPE=k8s (e.g. "
+                "kubernetes.io/service-name=gubernator for endpointslices, "
+                "app=gubernator for pods)"
+            )
         if self.engine not in ("local", "sharded"):
             raise ConfigError(f"GUBER_ENGINE: must be local or sharded, got {self.engine!r}")
         if self.cache_size <= 0:
@@ -190,6 +237,7 @@ def setup_daemon_config(
         data_center=_get(env, "GUBER_DATA_CENTER", ""),
         instance_id=_get(env, "GUBER_INSTANCE_ID", ""),
         cache_size=_get_int(env, "GUBER_CACHE_SIZE", 50_000),
+        cache_max_size=_get_int(env, "GUBER_CACHE_MAX_SIZE", 0),
         engine=_get(env, "GUBER_ENGINE", "local"),
         workers=_get_int(env, "GUBER_WORKER_COUNT", 0),
         behaviors=BehaviorConfig(
@@ -207,6 +255,25 @@ def setup_daemon_config(
         peer_discovery_type=_get(env, "GUBER_PEER_DISCOVERY_TYPE", "none"),
         dns_fqdn=_get(env, "GUBER_DNS_FQDN", ""),
         dns_poll_ms=_get_float_ms(env, "GUBER_DNS_POLL", 5_000.0),
+        etcd_endpoint=_get(env, "GUBER_ETCD_ENDPOINT", ""),
+        etcd_key_prefix=_get(env, "GUBER_ETCD_KEY_PREFIX", "/gubernator/peers/"),
+        etcd_lease_ttl_s=_get_int(env, "GUBER_ETCD_LEASE_TTL", 30),
+        etcd_poll_ms=_get_float_ms(env, "GUBER_ETCD_POLL", 2_000.0),
+        memberlist_address=_get(env, "GUBER_MEMBERLIST_ADDRESS", ""),
+        memberlist_advertise_address=_get(
+            env, "GUBER_MEMBERLIST_ADVERTISE_ADDRESS", ""
+        ),
+        memberlist_known_nodes=_get(env, "GUBER_MEMBERLIST_KNOWN_NODES", ""),
+        memberlist_gossip_interval_ms=_get_float_ms(
+            env, "GUBER_MEMBERLIST_GOSSIP_INTERVAL", 500.0
+        ),
+        k8s_namespace=_get(env, "GUBER_K8S_NAMESPACE", "default"),
+        k8s_pod_ip=_get(env, "GUBER_K8S_POD_IP", ""),
+        k8s_pod_port=_get(env, "GUBER_K8S_POD_PORT", ""),
+        k8s_selector=_get(env, "GUBER_K8S_ENDPOINTS_SELECTOR", ""),
+        k8s_mechanism=_get(env, "GUBER_K8S_WATCH_MECHANISM", "endpointslices"),
+        k8s_api_url=_get(env, "GUBER_K8S_API_URL", ""),
+        k8s_poll_ms=_get_float_ms(env, "GUBER_K8S_POLL", 5_000.0),
         tls_ca_file=_get(env, "GUBER_TLS_CA", ""),
         tls_cert_file=_get(env, "GUBER_TLS_CERT", ""),
         tls_key_file=_get(env, "GUBER_TLS_KEY", ""),

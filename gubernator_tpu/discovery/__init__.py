@@ -1,8 +1,12 @@
-"""Peer discovery pools: "none" (explicit set_peers, the test-cluster mode,
-reference daemon.go:258-262) and DNS polling (dns.py). The reference's etcd /
-k8s / memberlist pools depend on infrastructure clients that are out of scope
-for the TPU build; DNS + none cover its own test suite's needs."""
+"""Peer discovery pools (reference §L6): "none" (explicit set_peers, the
+test-cluster mode, reference daemon.go:258-262), DNS polling (dns.py), etcd
+lease registration (etcd.py), member-list gossip (memberlist.py), and
+Kubernetes EndpointSlices/Pods (kubernetes.py). All speak plain
+sockets/HTTP — no infrastructure client libraries required."""
 
 from gubernator_tpu.discovery.dns import DNSPool, system_resolver
+from gubernator_tpu.discovery.etcd import EtcdPool
+from gubernator_tpu.discovery.kubernetes import K8sPool
+from gubernator_tpu.discovery.memberlist import MemberlistPool
 
-__all__ = ["DNSPool", "system_resolver"]
+__all__ = ["DNSPool", "EtcdPool", "K8sPool", "MemberlistPool", "system_resolver"]

@@ -297,6 +297,7 @@ def pack_requests(
     requests: Sequence[RateLimitRequest],
     now_ms: int,
     pad_to: Optional[int] = None,
+    tolerance_ms: Optional[int] = None,
 ) -> "tuple[HostBatch, List[Optional[str]]]":
     """Resolve and pack requests into numpy SoA (host hot path).
 
@@ -348,10 +349,11 @@ def pack_requests(
             errors[i] = "field 'burst' must fit int32"
             continue
         created = r.created_at if r.created_at is not None and r.created_at != 0 else now_ms
-        if created > now_ms + _created_at_tolerance_ms:
-            created = now_ms + _created_at_tolerance_ms
-        elif created < now_ms - _created_at_tolerance_ms:
-            created = now_ms - _created_at_tolerance_ms
+        tol = _created_at_tolerance_ms if tolerance_ms is None else tolerance_ms
+        if created > now_ms + tol:
+            created = now_ms + tol
+        elif created < now_ms - tol:
+            created = now_ms - tol
         b.fp[i] = fingerprint(r.name, r.unique_key)
         b.algo[i] = int(r.algorithm)
         b.behavior[i] = int(r.behavior)

@@ -100,6 +100,7 @@ class LocalEngine:
         decide_fn: Optional[Callable] = None,
         table=None,
         created_at_tolerance_ms: Optional[int] = None,
+        store=None,
     ):
         self.table = table if table is not None else new_table2(capacity)
         self.write_mode = write_mode or default_write_mode()
@@ -108,7 +109,12 @@ class LocalEngine:
         self.max_claim_retries = 3
         # per-engine clock-skew bound; None = the ops.batch process default
         self.created_at_tolerance_ms = created_at_tolerance_ms
+        # optional write-through hook (gubernator_tpu.store.Store): fires a
+        # ChangeSet of persisted fingerprints after every check — the
+        # Store.OnChange analog (reference store.go:63-78, algorithms.go:148)
+        self.store = store
         self.stats = EngineStats()
+        self._seen_pad_sizes: set = set()  # compiled batch shapes (for resize warm)
 
     def _decide_packed(self, rb) -> np.ndarray:
         """One dispatch → ONE host fetch: the packed (B+2, 4) i64 output
@@ -185,6 +191,14 @@ class LocalEngine:
                 reset[rows] = t
                 err[rows[dropped]] = ERR_DROPPED
         self.stats.checks += n
+        if self.store is not None:
+            persisted = hb.fp[(err == 0) & (hb.fp != 0)]
+            if persisted.shape[0]:
+                from gubernator_tpu.store import ChangeSet
+
+                self.store.on_change(
+                    ChangeSet(fps=np.unique(persisted), created_at=now)
+                )
         return ResponseColumns(
             status=status, limit=limit_o, remaining=remaining,
             reset_time=reset, err=err,
@@ -195,6 +209,7 @@ class LocalEngine:
         bucket within a single dispatch) are re-dispatched — the decision is
         only authoritative once persisted. Rows still unpersisted after
         `max_claim_retries` surface a per-item error (`ERR_NOT_PERSISTED`)."""
+        self._seen_pad_sizes.add(int(batch.fp.shape[0]))
         arr = self._decide_packed(to_device(batch))
         self.stats.cache_hits += int(arr[-2, 0])
         self.stats.cache_misses += int(arr[-2, 1])
@@ -296,3 +311,74 @@ class LocalEngine:
         from gubernator_tpu.ops.table2 import live_count2
 
         return live_count2(self.table, now_ms if now_ms is not None else ms_now())
+
+    # -------------------------------------------------------------- resizing
+
+    def resize(self, new_capacity: int, now_ms: Optional[int] = None) -> int:
+        """Grow (or shrink) the table to `new_capacity` slots, re-placing
+        every live entry (host-orchestrated rehash — SURVEY §7 hard-parts).
+        The reference's LRU never resizes (CacheSize is fixed, config.go:151);
+        here growth is cheap enough to expose: one device→host snapshot, a
+        vectorized host rehash, one host→device put. Every previously-compiled
+        batch shape is re-warmed against the new bucket count BEFORE serving
+        resumes (a new (NB, ·) geometry means fresh XLA compiles — paying them
+        inside resize() keeps them out of the request path, the same incident
+        Daemon.warm_up prevents at startup). Returns the number of live
+        entries dropped by per-bucket overflow in the new geometry (counted as
+        unexpired evictions)."""
+        import jax
+        import jax.numpy as jnp
+
+        from gubernator_tpu.ops.batch import HostBatch
+        from gubernator_tpu.ops.table2 import n_buckets_for, rehash_rows
+
+        now = now_ms if now_ms is not None else ms_now()
+        new_rows, dropped = rehash_rows(
+            self.snapshot(), n_buckets_for(new_capacity), now
+        )
+        self.table = Table2(rows=jax.device_put(jnp.asarray(new_rows)))
+        self.stats.evicted_unexpired += dropped
+        # warm compiles for the new geometry with all-inactive dummy batches
+        # (no state mutation beyond a no-op write of zeros rows)
+        dispatches_before = self.stats.dispatches
+        for size in sorted(self._seen_pad_sizes):
+            z64 = np.zeros(size, dtype=np.int64)
+            dummy = HostBatch(
+                fp=z64, algo=np.zeros(size, dtype=np.int32),
+                behavior=np.zeros(size, dtype=np.int32), hits=z64,
+                limit=np.ones(size, dtype=np.int64), burst=z64,
+                duration=np.ones(size, dtype=np.int64), created_at=z64,
+                expire_new=z64, greg_interval=z64,
+                duration_eff=np.ones(size, dtype=np.int64),
+                active=np.zeros(size, dtype=bool),
+            )
+            self._decide_packed(to_device(dummy))
+        self.stats.dispatches = dispatches_before  # warms aren't traffic
+        return dropped
+
+    def maybe_grow(
+        self,
+        threshold: float = 0.6,
+        factor: int = 2,
+        max_capacity: Optional[int] = None,
+        now_ms: Optional[int] = None,
+    ) -> bool:
+        """Auto-grow policy: double the table when live slots exceed
+        `threshold` of capacity (open-addressed buckets degrade past ~0.6
+        load). Call from a maintenance tick. Returns True if resized.
+        `max_capacity` bounds the REALIZED capacity: bucket counts round up to
+        a valid sweep geometry (n_buckets_for), so the clamp picks the largest
+        conforming geometry that stays under the ceiling."""
+        from gubernator_tpu.ops.table2 import K, n_buckets_for
+
+        cap = self.table.capacity
+        if self.live_count(now_ms) <= threshold * cap:
+            return False
+        new_cap = cap * factor
+        if max_capacity is not None:
+            while new_cap > cap and n_buckets_for(new_cap) * K > max_capacity:
+                new_cap //= factor
+            if new_cap <= cap:
+                return False
+        self.resize(new_cap, now_ms)
+        return True

@@ -88,3 +88,46 @@ def live_count2(table: Table2, now_ms: int) -> int:
     )
     nonempty = (lo != 0) | (hi != 0)
     return int((nonempty & (exp >= now_ms)).sum())
+
+
+def decode_live_slots(rows: np.ndarray, now_ms: int):
+    """Flatten an (NB, 128) rows array into live slot records:
+    (slot_fields (N, F) i32, fp (N,) i64, exp (N,) i64) for slots that are
+    non-empty and unexpired at now_ms."""
+    slots = rows.reshape(-1, F)
+    lo = slots[:, FP_LO].astype(np.int64) & 0xFFFFFFFF
+    hi = slots[:, FP_HI].astype(np.int64)
+    fp = (hi << 32) | lo
+    exp = (slots[:, EXP_LO].astype(np.int64) & 0xFFFFFFFF) | (
+        slots[:, EXP_HI].astype(np.int64) << 32
+    )
+    live = (fp != 0) & (exp >= now_ms)
+    return slots[live], fp[live], exp[live]
+
+
+def rehash_rows(
+    rows: np.ndarray, new_n_buckets: int, now_ms: int
+) -> "tuple[np.ndarray, int]":
+    """Re-place every live slot into a table with `new_n_buckets` buckets —
+    the host side of a resize (SURVEY §7 hard-parts: table growth is
+    host-orchestrated; the kernel's placement rule is bucket = fp % NB).
+    Buckets receiving more than K live entries keep the K latest-expiring and
+    drop the rest (the same preference order as in-kernel eviction). Returns
+    (new rows array, dropped count)."""
+    slots, fp, exp = decode_live_slots(rows, now_ms)
+    out = np.zeros((new_n_buckets, ROW), dtype=np.int32)
+    if fp.shape[0] == 0:
+        return out, 0
+    bucket = fp % new_n_buckets
+    # rank entries within their new bucket, latest-expiring first
+    order = np.lexsort((-exp, bucket))
+    b_sorted = bucket[order]
+    first = np.concatenate([[True], b_sorted[1:] != b_sorted[:-1]])
+    pos = np.arange(b_sorted.shape[0])
+    start = np.maximum.accumulate(np.where(first, pos, -1))
+    lane = (pos - start).astype(np.int64)
+    keep = lane < K
+    dropped = int((~keep).sum())
+    tgt = b_sorted[keep] * K + lane[keep]
+    out.reshape(-1, F)[tgt] = slots[order[keep]]
+    return out, dropped

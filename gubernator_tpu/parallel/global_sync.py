@@ -171,11 +171,13 @@ class GlobalShardedEngine(ShardedEngine):
         capacity_per_shard: int = 50_000,
         max_exact_passes: int = 8,
         sync_out: int = 256,
+        created_at_tolerance_ms=None,
     ):
         super().__init__(
             mesh,
             capacity_per_shard=capacity_per_shard,
             max_exact_passes=max_exact_passes,
+            created_at_tolerance_ms=created_at_tolerance_ms,
         )
         self.replica = new_sharded_table(mesh, capacity_per_shard)
         self.sync_out = sync_out
@@ -231,7 +233,7 @@ class GlobalShardedEngine(ShardedEngine):
         (reference getLocalRateLimit + QueueUpdate, gubernator.go:653-690);
         everything else is answered from the home replica and its hits are
         queued for the owner (getGlobalRateLimit, gubernator.go:401-429)."""
-        hb, errors = pack_requests(requests, now)
+        hb, errors = pack_requests(requests, now, tolerance_ms=self.created_at_tolerance_ms)
         out: List[Optional[RateLimitResponse]] = [None] * len(requests)
         for i, err in enumerate(errors):
             if err is not None:

@@ -80,8 +80,11 @@ class ShardedEngine:
         mesh: Mesh,
         capacity_per_shard: int = 50_000,
         max_exact_passes: int = 8,
+        created_at_tolerance_ms=None,
     ):
         self.mesh = mesh
+        # per-engine clock-skew bound; None = the ops.batch process default
+        self.created_at_tolerance_ms = created_at_tolerance_ms
         self.n_shards = int(mesh.devices.size)
         self.table = new_sharded_table(mesh, capacity_per_shard)
         self._decide = make_sharded_decide(mesh)
@@ -97,7 +100,7 @@ class ShardedEngine:
         if not requests:
             return []
         now = now_ms if now_ms is not None else ms_now()
-        hb, errors = pack_requests(requests, now)
+        hb, errors = pack_requests(requests, now, tolerance_ms=self.created_at_tolerance_ms)
         out: List[Optional[RateLimitResponse]] = [None] * len(requests)
         for i, err in enumerate(errors):
             if err is not None:

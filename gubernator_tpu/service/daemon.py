@@ -47,6 +47,10 @@ from gubernator_tpu.service.wire import (
 from gubernator_tpu.types import Behavior, HitEvent, PeerInfo, has_behavior
 from gubernator_tpu import tracing
 
+import logging
+
+log = logging.getLogger("gubernator_tpu.daemon")
+
 FORWARD_RETRIES = 5  # reference asyncRequest retries (gubernator.go:333-359)
 
 
@@ -69,6 +73,7 @@ class Daemon:
         conf: DaemonConfig,
         engine: Optional[LocalEngine] = None,
         event_channel: Optional[asyncio.Queue] = None,
+        store=None,
     ):
         conf.validate()
         self.conf = conf
@@ -81,7 +86,10 @@ class Daemon:
         self.engine = engine if engine is not None else LocalEngine(
             capacity=conf.cache_size,
             created_at_tolerance_ms=int(conf.created_at_tolerance_ms),
+            store=store,
         )
+        if engine is not None and store is not None:
+            engine.store = store
         self.runner = EngineRunner(self.engine, metrics=self.metrics)
         self.batcher = Batcher(
             self.runner,
@@ -89,6 +97,10 @@ class Daemon:
             metrics=self.metrics,
         )
         self.global_manager = GlobalManager(self)
+        from gubernator_tpu.service.region_manager import RegionManager
+
+        self.region_manager = RegionManager(self)
+        self._maintenance_task = None
         self._local_picker = ReplicatedConsistentHash()
         self._region_picker = RegionPicker()
         self._peer_clients: Dict[str, PeerClient] = {}
@@ -106,18 +118,45 @@ class Daemon:
         conf: DaemonConfig,
         engine: Optional[LocalEngine] = None,
         event_channel: Optional[asyncio.Queue] = None,
+        store=None,
     ):
         """SpawnDaemon analog (reference daemon.go:75-88): build, restore
         checkpoint, start listeners + loops + discovery."""
-        d = cls(conf, engine=engine, event_channel=event_channel)
+        d = cls(conf, engine=engine, event_channel=event_channel, store=store)
         d.maybe_restore()
         await d.warm_up()
         from gubernator_tpu.service.server import start_servers
 
         await start_servers(d)
         d.global_manager.start()
+        d.region_manager.start()
         await d._start_discovery()
+        if conf.cache_max_size > conf.cache_size:
+            d._maintenance_task = asyncio.create_task(
+                d._maintenance_loop(), name="table-maintenance"
+            )
         return d
+
+    async def _maintenance_loop(self) -> None:
+        """Auto-grow tick: double the table when live keys pass 60% of
+        capacity, up to GUBER_CACHE_MAX_SIZE."""
+        while not self._shutting_down:
+            await asyncio.sleep(2.0)
+            try:
+                grew = await self.runner.maybe_grow(
+                    max_capacity=self.conf.cache_max_size
+                )
+                if grew:
+                    live = await self.runner.live_count()
+                    self.metrics.cache_size.set(live)
+                    log.info(
+                        "table grew to %d slots (%d live)",
+                        self.engine.table.capacity, live,
+                    )
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # pragma: no cover - defensive
+                log.exception("table maintenance tick failed")
 
     async def warm_up(self) -> None:
         """Compile the decision + install kernels for the smallest batch shape
@@ -154,7 +193,8 @@ class Daemon:
         self.metrics._last_engine = None
 
     async def _start_discovery(self) -> None:
-        if self.conf.peer_discovery_type == "dns":
+        kind = self.conf.peer_discovery_type
+        if kind == "dns":
             from gubernator_tpu.discovery.dns import DNSPool
 
             self._pool = DNSPool(
@@ -165,6 +205,47 @@ class Daemon:
                 http_address=self.conf.http_address,
                 data_center=self.conf.data_center,
             )
+        elif kind == "etcd":
+            from gubernator_tpu.discovery.etcd import EtcdPool
+
+            self._pool = EtcdPool(
+                endpoint=self.conf.etcd_endpoint,
+                on_update=self.set_peers,
+                peer_info=self.peer_info(),
+                key_prefix=self.conf.etcd_key_prefix,
+                lease_ttl_s=self.conf.etcd_lease_ttl_s,
+                poll_ms=self.conf.etcd_poll_ms,
+            )
+        elif kind == "member-list":
+            from gubernator_tpu.discovery.memberlist import MemberlistPool
+
+            self._pool = MemberlistPool(
+                bind_address=self.conf.memberlist_address,
+                advertise_address=self.conf.memberlist_advertise_address,
+                known_nodes=[
+                    n.strip()
+                    for n in self.conf.memberlist_known_nodes.split(",")
+                    if n.strip()
+                ],
+                on_update=self.set_peers,
+                peer_info=self.peer_info(),
+                gossip_interval_ms=self.conf.memberlist_gossip_interval_ms,
+            )
+        elif kind == "k8s":
+            from gubernator_tpu.discovery.kubernetes import K8sPool
+
+            self._pool = K8sPool(
+                on_update=self.set_peers,
+                pod_ip=self.conf.k8s_pod_ip,
+                pod_port=self.conf.k8s_pod_port
+                or self.conf.grpc_address.rsplit(":", 1)[-1],
+                namespace=self.conf.k8s_namespace,
+                selector=self.conf.k8s_selector,
+                mechanism=self.conf.k8s_mechanism,
+                api_url=self.conf.k8s_api_url,
+                poll_ms=self.conf.k8s_poll_ms,
+            )
+        if self._pool is not None:
             await self._pool.start()
         # "none": explicit set_peers calls (reference daemon.go:258-262)
 
@@ -225,6 +306,11 @@ class Daemon:
     def region_peers(self) -> List[PeerInfo]:
         return self._region_picker.peers()
 
+    def region_owners(self, key: str) -> List[PeerInfo]:
+        """The key's owner in every OTHER datacenter (region picker holds only
+        non-local DCs, see set_peers)."""
+        return self._region_picker.get_clients(key)
+
     def get_peer(self, key: str) -> PeerInfo:
         return self._local_picker.get(key)
 
@@ -275,21 +361,27 @@ class Daemon:
         global_rows: List[int] = []
         forwards: List[tuple] = []  # (row, key, item)
         owner_global_rows: List[int] = []
+        owner_region_rows: List[int] = []
         for i in range(n):
             if cols.err[i] != 0:
                 out[i] = pb.RateLimitResp(error=ERROR_STRINGS[int(cols.err[i])])
                 continue
             is_global = bool(cols.behavior[i] & int(Behavior.GLOBAL))
+            is_mr = bool(cols.behavior[i] & int(Behavior.MULTI_REGION))
             if standalone:
                 local_rows.append(i)
                 if is_global:
                     owner_global_rows.append(i)
+                if is_mr:
+                    owner_region_rows.append(i)
                 continue
             info = self.get_peer(hash_keys[i])
             if self.is_self(info):
                 local_rows.append(i)
                 if is_global:
                     owner_global_rows.append(i)
+                if is_mr:
+                    owner_region_rows.append(i)
             elif is_global:
                 global_rows.append(i)
             else:
@@ -319,6 +411,9 @@ class Daemon:
         # getLocalRateLimit → QueueUpdate, gubernator.go:670-672)
         for i in owner_global_rows:
             self.global_manager.queue_update(hash_keys[i], items[i])
+        # owner-side MULTI_REGION hits replicate to the other DCs' owners
+        for i in owner_region_rows:
+            self.region_manager.queue_hit(hash_keys[i], items[i])
         # audit events fire for locally-executed (owner-side) hits only
         # (reference gubernator.go:676-688)
         if self.event_channel is not None:
@@ -414,8 +509,15 @@ class Daemon:
         cols = cols._replace(behavior=cols.behavior & ~np.int32(int(Behavior.GLOBAL)))
         rc = await self.batcher.check(cols)
         for i, it in enumerate(items):
-            if has_behavior(it.behavior, Behavior.GLOBAL) and cols.err[i] == 0:
+            if cols.err[i] != 0:
+                continue
+            if has_behavior(it.behavior, Behavior.GLOBAL):
                 self.global_manager.queue_update(hash_keys[i], it)
+            # forwarded MULTI_REGION hits reach the owner HERE, not in _route
+            # — they must replicate cross-region too (replicated copies have
+            # MULTI_REGION stripped by RegionManager, so no ping-pong)
+            if has_behavior(it.behavior, Behavior.MULTI_REGION):
+                self.region_manager.queue_hit(hash_keys[i], it)
         resps = pb_from_response_columns(rc)
         if self.event_channel is not None:
             # peer-batch execution is owner-side too (the reference's event
@@ -517,9 +619,16 @@ class Daemon:
         if self._shutting_down:
             return
         self._shutting_down = True
+        if self._maintenance_task is not None:
+            self._maintenance_task.cancel()
+            try:
+                await self._maintenance_task
+            except asyncio.CancelledError:
+                pass
         if self._pool is not None:
             await self._pool.close()
         await self.global_manager.close()
+        await self.region_manager.close()
         await self.batcher.drain()
         await asyncio.gather(
             *(c.shutdown() for c in self._peer_clients.values()),

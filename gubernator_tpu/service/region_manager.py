@@ -1,0 +1,138 @@
+"""MULTI_REGION behavior — async cross-datacenter hit replication.
+
+The reference declares MULTI_REGION (gubernator.proto:131-134) and builds the
+per-region machinery (RegionPicker rings + a request queue,
+region_picker.go:19-103) but ships no cross-region push loop; its README
+marks the behavior "not fully implemented". This module supplies the flow the
+reference's design sketches, reusing the GLOBAL manager's two-stage batching
+shape (global.go:102-199):
+
+* the OWNER of a MULTI_REGION key (within its own DC) aggregates its hits per
+  key (sum Hits, OR RESET_REMAINING) exactly like the GLOBAL hits loop;
+* every sync tick it forwards each key's aggregate to the key's owner in
+  EVERY OTHER region (one peer per DC, via the RegionPicker rings) through
+  GetPeerRateLimits, so each region's replica bucket drains by the remote
+  hits too;
+* MULTI_REGION is stripped and DRAIN_OVER_LIMIT forced on the replicated
+  items (mirror of the GLOBAL owner rule, gubernator.go:526-532) — the
+  receiving owner applies them locally and must NOT re-replicate, which would
+  ping-pong hits between DCs forever.
+
+Eventual consistency: each region's count converges to the union of all
+regions' hits within one sync interval; send failures are counted and
+dropped, never retried (same loss model as GLOBAL, global.go:190-195).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Dict
+
+from gubernator_tpu.proto import gubernator_pb2 as pb
+from gubernator_tpu.proto import peers_pb2 as peers_pb
+from gubernator_tpu.types import Behavior
+
+log = logging.getLogger("gubernator_tpu.region")
+
+
+class RegionManager:
+    def __init__(self, daemon):
+        self.daemon = daemon
+        b = daemon.conf.behaviors
+        self.sync_wait_s = b.global_sync_wait_ms / 1e3
+        self.batch_limit = b.global_batch_limit
+        self.timeout_s = b.global_timeout_ms / 1e3
+        self.concurrency = b.global_peer_concurrency
+        self.metrics = daemon.metrics
+        self._hits: Dict[str, pb.RateLimitReq] = {}
+        self._wake = asyncio.Event()
+        self._task = None
+        self._closed = False
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._loop(), name="region-hits")
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        await self._send()
+
+    def queue_hit(self, key: str, item: "pb.RateLimitReq") -> None:
+        """Owner-side MULTI_REGION hit to replicate across DCs."""
+        if item.hits == 0 or self.daemon.region_peers() == []:
+            return
+        agg = self._hits.get(key)
+        if agg is None:
+            agg = pb.RateLimitReq()
+            agg.CopyFrom(item)
+            self._hits[key] = agg
+        else:
+            hits = agg.hits + item.hits
+            reset = (agg.behavior | item.behavior) & int(Behavior.RESET_REMAINING)
+            agg.CopyFrom(item)
+            agg.hits = hits
+            agg.behavior |= reset
+        if len(self._hits) >= self.batch_limit:
+            self._wake.set()
+
+    async def _loop(self) -> None:
+        while not self._closed:
+            try:
+                await asyncio.wait_for(self._wake.wait(), self.sync_wait_s)
+            except asyncio.TimeoutError:
+                pass
+            self._wake.clear()
+            try:
+                await self._send()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("multi-region sync round failed")
+
+    async def _send(self) -> None:
+        if not self._hits:
+            return
+        batch, self._hits = self._hits, {}
+        t0 = time.perf_counter()
+        # per remote region, group this batch's items by that region's owner
+        by_peer: Dict[str, list] = {}
+        infos = {}
+        for key, item in batch.items():
+            rep = pb.RateLimitReq()
+            rep.CopyFrom(item)
+            rep.behavior = (
+                rep.behavior & ~int(Behavior.MULTI_REGION)
+            ) | int(Behavior.DRAIN_OVER_LIMIT)
+            for info in self.daemon.region_owners(key):
+                by_peer.setdefault(info.grpc_address, []).append(rep)
+                infos[info.grpc_address] = info
+        sem = asyncio.Semaphore(self.concurrency)
+
+        async def send(addr, items):
+            client = self.daemon.peer_client(infos[addr])
+            if client is None:
+                return
+            async with sem:
+                try:
+                    await client.get_peer_rate_limits(
+                        peers_pb.GetPeerRateLimitsReq(requests=items),
+                        timeout=self.timeout_s,
+                    )
+                    self.metrics.broadcast_counter.labels(
+                        condition="multi_region"
+                    ).inc()
+                except Exception:
+                    self.metrics.check_error_counter.labels(
+                        error="multi_region_send"
+                    ).inc()
+
+        await asyncio.gather(*(send(a, i) for a, i in by_peer.items()))
+        if by_peer:
+            self.metrics.global_send_duration.observe(time.perf_counter() - t0)

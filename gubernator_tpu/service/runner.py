@@ -65,6 +65,12 @@ class EngineRunner:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(self._exec, self.engine.snapshot)
 
+    async def maybe_grow(self, **kw) -> bool:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._exec, lambda: self.engine.maybe_grow(**kw)
+        )
+
     def snapshot_sync(self) -> np.ndarray:
         """Synchronous snapshot for shutdown paths with no running loop."""
         return self._exec.submit(self.engine.snapshot).result()

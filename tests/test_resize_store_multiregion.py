@@ -1,0 +1,197 @@
+"""Table resize/rehash, Store write-through hook, and MULTI_REGION
+cross-datacenter replication tests."""
+
+import asyncio
+import functools
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.ops.engine import LocalEngine
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest, MINUTE
+
+from gubernator_tpu.proto import gubernator_pb2 as pb
+from tests.cluster import Cluster, daemon_config, wait_for
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **k):
+        asyncio.run(fn(*a, **k))
+
+    return wrapper
+
+
+def req(key, name="t", hits=1, limit=100, **kw):
+    return RateLimitRequest(
+        name=name, unique_key=key, hits=hits, limit=limit, duration=MINUTE, **kw
+    )
+
+
+# ----------------------------------------------------------------- resize
+
+
+def test_resize_preserves_all_live_state(frozen_now):
+    eng = LocalEngine(capacity=2048)  # low load factor: no insert evictions
+    keys = [f"k{i}" for i in range(300)]
+    out = eng.check([req(k, hits=3) for k in keys], now_ms=frozen_now)
+    assert all(r.error == "" for r in out)
+    before = {k: r.remaining for k, r in zip(keys, out)}
+    assert eng.table.capacity == 2048
+    assert eng.live_count(frozen_now) == 300
+
+    dropped = eng.resize(8192, now_ms=frozen_now)
+    assert dropped == 0
+    assert eng.table.capacity == 8192
+    assert eng.live_count(frozen_now) == 300
+
+    # every bucket keeps counting where it left off
+    out = eng.check([req(k, hits=1) for k in keys], now_ms=frozen_now)
+    for k, r in zip(keys, out):
+        assert r.remaining == before[k] - 1, k
+
+
+def test_resize_drops_overflow_and_counts_it(frozen_now):
+    # shrink 300 live keys into a 4-bucket table (32 slots): per-bucket
+    # overflow must drop deterministically and be counted
+    eng = LocalEngine(capacity=512)
+    eng.check([req(f"k{i}") for i in range(300)], now_ms=frozen_now)
+    live_before = eng.live_count(frozen_now)
+    dropped = eng.resize(8, now_ms=frozen_now)
+    assert dropped == live_before - eng.live_count(frozen_now) > 0
+    assert eng.stats.evicted_unexpired >= dropped
+    assert eng.live_count(frozen_now) <= 8
+
+
+def test_maybe_grow_policy(frozen_now):
+    eng = LocalEngine(capacity=64)
+    eng.check([req(f"g{i}") for i in range(50)], now_ms=frozen_now)
+    # 50/64 > 0.6 → grows
+    assert eng.maybe_grow(now_ms=frozen_now) is True
+    assert eng.table.capacity == 128
+    # below threshold now → no further growth
+    assert eng.maybe_grow(now_ms=frozen_now) is False
+    # ceiling respected
+    eng2 = LocalEngine(capacity=64)
+    eng2.check([req(f"h{i}") for i in range(50)], now_ms=frozen_now)
+    assert eng2.maybe_grow(max_capacity=64, now_ms=frozen_now) is False
+
+
+# ------------------------------------------------------------------- store
+
+
+def test_store_on_change_receives_persisted_fingerprints(frozen_now):
+    from gubernator_tpu.hashing import fingerprint
+    from gubernator_tpu.store import Store
+
+    changes = []
+
+    class Recorder(Store):
+        def on_change(self, change):
+            changes.append(change)
+
+    eng = LocalEngine(capacity=256, store=Recorder())
+    eng.check(
+        [
+            req("a"),
+            RateLimitRequest(name="t", unique_key="", hits=1, limit=5, duration=MINUTE),
+            req("b"),
+        ],
+        now_ms=frozen_now,
+    )
+    assert len(changes) == 1
+    assert changes[0].created_at == frozen_now
+    want = sorted([fingerprint("t", "a"), fingerprint("t", "b")])
+    assert sorted(changes[0].fps.tolist()) == want  # invalid row excluded
+
+
+# ------------------------------------------------------------ multi-region
+
+
+@async_test
+async def test_multi_region_hits_replicate_across_dcs():
+    """Owner-side MULTI_REGION hits drain the replica bucket in the other DC
+    within one sync interval."""
+    c = await Cluster.start(4, dcs=["dc-a", "dc-a", "dc-b", "dc-b"])
+    try:
+        owner_a = c.find_owning_daemon("mr", "key-1")
+        # find_owning_daemon resolves via daemons[0] (dc-a); the dc-b owner:
+        dc_b = [d for d in c.daemons if d.conf.data_center == "dc-b"]
+        owner_b_addr = dc_b[0].region_owners("mr_key-1")
+        # from a dc-a daemon's view the dc-b owner is in ITS region picker
+        owner_b_info = [
+            p for p in c.daemons[0].region_owners("mr_key-1")
+        ]
+        assert len(owner_b_info) == 1
+        owner_b = next(
+            d for d in c.daemons
+            if d.conf.advertise_address == owner_b_info[0].grpc_address
+        )
+        assert owner_b.conf.data_center == "dc-b"
+
+        # 3 hits at the dc-a owner with MULTI_REGION
+        out = await owner_a.get_rate_limits(
+            [
+                pb.RateLimitReq(
+                    name="mr", unique_key="key-1", hits=3, limit=100,
+                    duration=60_000, behavior=int(Behavior.MULTI_REGION),
+                )
+            ]
+        )
+        assert out[0].error == ""
+        assert out[0].remaining == 97
+
+        # dc-b owner's local bucket converges to the same drained count
+        async def converged():
+            r = await owner_b.get_rate_limits(
+                [
+                    pb.RateLimitReq(
+                        name="mr", unique_key="key-1", hits=0, limit=100,
+                        duration=60_000,
+                    )
+                ]
+            )
+            return r[0].remaining == 97
+        await wait_for(converged, timeout_s=10)
+
+        # and the hits do NOT ping-pong back: dc-a owner still at 97
+        r = await owner_a.get_rate_limits(
+            [
+                pb.RateLimitReq(
+                    name="mr", unique_key="key-1", hits=0, limit=100,
+                    duration=60_000,
+                )
+            ]
+        )
+        await asyncio.sleep(0.3)  # two extra sync intervals
+        assert r[0].remaining == 97
+
+        # hits arriving at a NON-owner (forwarded via GetPeerRateLimits)
+        # must also replicate: the owner-side peer path queues them too
+        non_owner_a = next(
+            d for d in c.daemons
+            if d.conf.data_center == "dc-a" and d is not owner_a
+        )
+        out = await non_owner_a.get_rate_limits(
+            [
+                pb.RateLimitReq(
+                    name="mr", unique_key="key-1", hits=2, limit=100,
+                    duration=60_000, behavior=int(Behavior.MULTI_REGION),
+                )
+            ]
+        )
+        assert out[0].error == "" and out[0].remaining == 95
+
+        async def converged2():
+            r = await owner_b.get_rate_limits(
+                [
+                    pb.RateLimitReq(
+                        name="mr", unique_key="key-1", hits=0, limit=100,
+                        duration=60_000,
+                    )
+                ]
+            )
+            return r[0].remaining == 95
+        await wait_for(converged2, timeout_s=10)
+    finally:
+        await c.stop()
