@@ -1,0 +1,206 @@
+"""Phase breakdown of decide2 on the real TPU at headline scale.
+
+Times (slope method — pipelined dispatches between two run lengths, so tunnel
+RTT cancels): full decide2, probe+claim only, row-gather only, sweep write
+only, xla write variant, and a decide2 variant with the write disabled.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+import gubernator_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from gubernator_tpu.ops import kernel2 as k2
+from gubernator_tpu.ops.batch import ReqBatch
+from gubernator_tpu.ops.table2 import new_table2
+
+CAP = 1 << 24  # 16.7M slots → NB = 2M buckets
+BATCH = 1 << 17
+LIVE = 10_000_000
+
+
+def log(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def slope(fn, fetch, n_long=24):
+    fn()  # compile
+    fetch(fn())
+
+    def run(k):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(k):
+            out = fn()
+        fetch(out)
+        return time.perf_counter() - t0
+
+    run(2)
+    t_short = min(run(2) for _ in range(3))
+    t_long = min(run(2 + n_long) for _ in range(3))
+    return (t_long - t_short) / n_long
+
+
+def main():
+    rng = np.random.default_rng(7)
+    now = 1_700_000_000_000
+    table = new_table2(CAP)
+    keyspace = rng.integers(1, (1 << 63) - 1, size=LIVE, dtype=np.int64)
+
+    def mk(fps):
+        b = fps.shape[0]
+        return ReqBatch(
+            fp=jnp.asarray(fps),
+            algo=jnp.zeros(b, dtype=jnp.int32),
+            behavior=jnp.zeros(b, dtype=jnp.int32),
+            hits=jnp.ones(b, dtype=jnp.int64),
+            limit=jnp.full(b, 1000, dtype=jnp.int64),
+            burst=jnp.zeros(b, dtype=jnp.int64),
+            duration=jnp.full(b, 60_000, dtype=jnp.int64),
+            created_at=jnp.full(b, now, dtype=jnp.int64),
+            expire_new=jnp.full(b, now + 60_000, dtype=jnp.int64),
+            greg_interval=jnp.zeros(b, dtype=jnp.int64),
+            duration_eff=jnp.full(b, 60_000, dtype=jnp.int64),
+            active=jnp.ones(b, dtype=bool),
+        )
+
+    # seed all 10M keys
+    log("seeding 10M keys...")
+    for i in range(LIVE // BATCH):
+        table, _, _ = k2.decide2(table, mk(keyspace[i * BATCH : (i + 1) * BATCH]))
+    batches = [
+        jax.device_put(mk(keyspace[rng.permutation(LIVE)[:BATCH]])) for _ in range(4)
+    ]
+    state = {"t": table, "i": 0}
+
+    blk, u = k2.sweep_geometry(table.rows.shape[0], BATCH)
+    log(f"NB={table.rows.shape[0]} blk={blk} u={u} nblk={table.rows.shape[0]//blk}")
+
+    # --- full decide2 (sweep)
+    def full():
+        b = batches[state["i"] % 4]
+        state["i"] += 1
+        state["t"], resp, stats = k2.decide2(state["t"], b, write="sweep")
+        return stats.cache_hits
+
+    log(f"full decide2(sweep): {slope(full, lambda x: int(x)) * 1e3:.2f} ms")
+
+    # --- full decide2 (xla write)
+    def full_xla():
+        b = batches[state["i"] % 4]
+        state["i"] += 1
+        state["t"], resp, stats = k2.decide2(state["t"], b, write="xla")
+        return stats.cache_hits
+
+    log(f"full decide2(xla):   {slope(full_xla, lambda x: int(x)) * 1e3:.2f} ms")
+
+    tbl_rows = state["t"].rows
+
+    # --- probe+claim only
+    @jax.jit
+    def probe_only(rows, b):
+        c = k2._probe_claim2(rows, b.fp, b.created_at, b.active, blk, u)
+        return c.written.sum()
+
+    def probe():
+        b = batches[state["i"] % 4]
+        state["i"] += 1
+        return probe_only(tbl_rows, b)
+
+    log(f"probe+claim only:    {slope(probe, lambda x: int(x)) * 1e3:.2f} ms")
+
+    # --- row gather only
+    @jax.jit
+    def gather_only(rows, b):
+        bucket = (b.fp % rows.shape[0]).astype(jnp.int32)
+        return rows[bucket].sum(dtype=jnp.int32)
+
+    def gth():
+        b = batches[state["i"] % 4]
+        state["i"] += 1
+        return gather_only(tbl_rows, b)
+
+    log(f"row gather only:     {slope(gth, lambda x: int(x)) * 1e3:.2f} ms")
+
+    # --- sort machinery only (the 3 sorts without gather)
+    @jax.jit
+    def sorts_only(rows, b):
+        B = b.fp.shape[0]
+        NB = rows.shape[0]
+        bucket = (b.fp % NB).astype(jnp.int32)
+        idx = jnp.arange(B, dtype=jnp.int32)
+        k1, k2_, i1 = jax.lax.sort((bucket, idx, idx), num_keys=1)
+        skey = bucket * 2
+        s2, i2 = jax.lax.sort((skey, idx), num_keys=1)
+        _, i3 = jax.lax.sort((i2, i1), num_keys=1)
+        return (k1[-1] + s2[-1] + i3[-1]).astype(jnp.int32)
+
+    def srt():
+        b = batches[state["i"] % 4]
+        state["i"] += 1
+        return sorts_only(tbl_rows, b)
+
+    log(f"3x sort only:        {slope(srt, lambda x: int(x)) * 1e3:.2f} ms")
+
+    # --- sweep write only (fixed claim from one probe)
+    b0 = batches[0]
+    c0 = jax.jit(
+        lambda rows, b: k2._probe_claim2(rows, b.fp, b.created_at, b.active, blk, u)
+    )(tbl_rows, b0)
+    new16 = jnp.zeros((BATCH, 16), dtype=jnp.int32)
+
+    @jax.jit
+    def sweep_only(rows, c):
+        return k2._write_sweep(rows, new16, c, blk, u)
+
+    def swp():
+        return sweep_only(tbl_rows, c0)
+
+    log(f"sweep write only:    {slope(swp, lambda x: int(x[0, 0])) * 1e3:.2f} ms")
+
+    # --- everything except the write
+    def no_write(rows, b):
+        table_, resp, stats = k2.decide2_impl(
+            k2.Table2(rows=rows) if hasattr(k2, "Table2") else rows, b, write="xla"
+        )
+        return stats.cache_hits
+
+    from gubernator_tpu.ops.table2 import Table2
+
+    @jax.jit
+    def nw(rows, b):
+        c = k2._probe_claim2(rows, b.fp, b.created_at, b.active, blk, u)
+        lane16 = jnp.take_along_axis(c.slots, c.chosen[:, None, None], axis=1)[:, 0, :]
+        g = lambda f: lane16[:, f]
+        i64_ = jnp.int64
+        s_exp = k2._join64(g(k2.EXP_LO), g(k2.EXP_HI))
+        exists = c.owns & (s_exp >= b.created_at)
+        s_flags = g(k2.FLAGS)
+        from gubernator_tpu.ops.math import StoredState, bucket_math
+        f32 = jnp.float32
+        f64 = jnp.float64
+        stored = StoredState(
+            limit=g(k2.LIMIT).astype(i64_), burst=g(k2.BURST).astype(i64_),
+            rem_i=g(k2.REM_I).astype(i64_), algo=s_flags & 0xFF,
+            status=s_flags >> 8, duration=k2._join64(g(k2.DUR_LO), g(k2.DUR_HI)),
+            stamp=k2._join64(g(k2.STAMP_LO), g(k2.STAMP_HI)), exp=s_exp,
+            rem_f=jax.lax.bitcast_convert_type(g(k2.REMF_HI), f32).astype(f64)
+            + jax.lax.bitcast_convert_type(g(k2.REMF_LO), f32).astype(f64),
+        )
+        d = bucket_math(stored, b, exists)
+        return d.resp_rem.sum() + d.rem_i_out.sum()
+
+    def nwf():
+        b = batches[state["i"] % 4]
+        state["i"] += 1
+        return nw(tbl_rows, b)
+
+    log(f"probe+claim+math:    {slope(nwf, lambda x: int(x)) * 1e3:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""Decompose _write_sweep cost: routing-only vs pallas-call vs donation."""
+
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+import gubernator_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from gubernator_tpu.ops import kernel2 as k2
+from gubernator_tpu.ops.batch import ReqBatch
+from gubernator_tpu.ops.table2 import new_table2
+
+CAP = 1 << 24
+BATCH = 1 << 17
+
+
+def log(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def slope(fn, fetch, n_long=16):
+    fn()
+    fetch(fn())
+
+    def run(k):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(k):
+            out = fn()
+        fetch(out)
+        return time.perf_counter() - t0
+
+    run(2)
+    t_short = min(run(2) for _ in range(3))
+    t_long = min(run(2 + n_long) for _ in range(3))
+    return (t_long - t_short) / n_long
+
+
+def main():
+    rng = np.random.default_rng(7)
+    now = 1_700_000_000_000
+    table = new_table2(CAP)
+    NB = table.rows.shape[0]
+    blk, u = k2.sweep_geometry(NB, BATCH)
+    fps = rng.integers(1, (1 << 63) - 1, size=BATCH, dtype=np.int64)
+    b = ReqBatch(
+        fp=jnp.asarray(fps),
+        algo=jnp.zeros(BATCH, dtype=jnp.int32),
+        behavior=jnp.zeros(BATCH, dtype=jnp.int32),
+        hits=jnp.ones(BATCH, dtype=jnp.int64),
+        limit=jnp.full(BATCH, 1000, dtype=jnp.int64),
+        burst=jnp.zeros(BATCH, dtype=jnp.int64),
+        duration=jnp.full(BATCH, 60_000, dtype=jnp.int64),
+        created_at=jnp.full(BATCH, now, dtype=jnp.int64),
+        expire_new=jnp.full(BATCH, now + 60_000, dtype=jnp.int64),
+        greg_interval=jnp.zeros(BATCH, dtype=jnp.int64),
+        duration_eff=jnp.full(BATCH, 60_000, dtype=jnp.int64),
+        active=jnp.ones(BATCH, dtype=bool),
+    )
+    c0 = jax.jit(
+        lambda rows, bb: k2._probe_claim2(rows, bb.fp, bb.created_at, bb.active, blk, u)
+    )(table.rows, b)
+    c0 = jax.tree.map(jax.device_put, c0)
+    new16 = jax.device_put(jnp.zeros((BATCH, 16), dtype=jnp.int32))
+
+    # routing only (everything in _write_sweep before the pallas_call)
+    @jax.jit
+    def routing(c, n16):
+        nblk = NB // blk
+        starts = jnp.searchsorted(
+            c.tgt_sorted, (jnp.arange(nblk, dtype=jnp.int32) * (k2.K * blk)).astype(jnp.int32)
+        ).astype(jnp.int32)
+        win = (starts[:, None] + jnp.arange(u, dtype=jnp.int32)[None, :]).reshape(-1)
+        win_valid = win < BATCH
+        winc = jnp.clip(win, 0, BATCH - 1)
+        data_idx = c.order[winc]
+        tgt_w = c.tgt_sorted[winc]
+        blk_ids = jnp.repeat(jnp.arange(nblk, dtype=jnp.int32), u)
+        in_block = (tgt_w // jnp.int32(k2.K * blk)) == blk_ids
+        livew = win_valid & in_block & c.written[data_idx]
+        wnew = n16[data_idx] * livew[:, None].astype(jnp.int32)
+        wslot = jnp.where(livew, tgt_w % k2.K, -1).astype(jnp.int32)
+        wlb = jnp.where(livew, (tgt_w // k2.K) - blk_ids * blk, -1).astype(jnp.int32)
+        return wnew.sum() + wslot.sum() + wlb.sum()
+
+    log(f"routing only:            {slope(lambda: routing(c0, new16), lambda x: int(x)) * 1e3:.2f} ms")
+
+    # full _write_sweep WITHOUT donation (what exp_phase measured)
+    f_nodon = jax.jit(lambda rows, c: k2._write_sweep(rows, new16, c, blk, u))
+    log(f"_write_sweep (no donate): {slope(lambda: f_nodon(table.rows, c0), lambda x: int(x[0, 0])) * 1e3:.2f} ms")
+
+    # full _write_sweep WITH donation (what decide2 effectively gets)
+    f_don = jax.jit(
+        lambda rows, c: k2._write_sweep(rows, new16, c, blk, u), donate_argnums=(0,)
+    )
+    state = {"rows": table.rows}
+
+    def step():
+        state["rows"] = f_don(state["rows"], c0)
+        return state["rows"]
+
+    log(f"_write_sweep (donated):   {slope(step, lambda x: int(x[0, 0])) * 1e3:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -198,6 +198,13 @@ class DaemonConfig:
             raise ConfigError(
                 "GUBER_K8S_WATCH_MECHANISM must be endpointslices or pods"
             )
+        if self.peer_discovery_type == "k8s" and not self.k8s_pod_ip:
+            # self-recognition (not-ready-self inclusion, owner marking) keys
+            # on the pod IP; an empty value silently breaks it
+            raise ConfigError(
+                "GUBER_K8S_POD_IP is required when GUBER_PEER_DISCOVERY_TYPE="
+                "k8s (set it from the downward API: status.podIP)"
+            )
         if self.peer_discovery_type == "k8s" and not self.k8s_selector:
             # without a selector the pool would list EVERY workload in the
             # namespace and forward rate-limit RPCs to unrelated pods

@@ -245,15 +245,24 @@ class MemberlistPool:
     def _tick(self) -> None:
         self._self.heartbeat += 1
         expired = []
+        reap = []
         for m in self._members.values():
             if m.name == self.name:
                 continue
             m.age_ticks += 1
             if not m.dead and m.age_ticks > self.suspect_ticks:
                 expired.append(m.name)
+            elif m.dead and m.age_ticks > 8 * self.suspect_ticks:
+                # the tombstone has gossiped long enough — reap it, or the
+                # state blob grows forever under identity churn (pod restarts
+                # mint fresh names) and eventually overflows MAX_STATE_BYTES,
+                # wedging every future push-pull
+                reap.append(m.name)
         for name in expired:
             log.info("%s: suspect-timeout %s", self.name, name)
             self._members[name].dead = True
+        for name in reap:
+            del self._members[name]
         if expired:
             self._publish()
 

@@ -335,6 +335,7 @@ def pack_requests(
         duration_eff=np.zeros(size, dtype=np.int64),
         active=np.zeros(size, dtype=bool),
     )
+    tol = _created_at_tolerance_ms if tolerance_ms is None else tolerance_ms
     for i, r in enumerate(requests):
         if r.unique_key == "":
             errors[i] = "field 'unique_key' cannot be empty"
@@ -349,7 +350,6 @@ def pack_requests(
             errors[i] = "field 'burst' must fit int32"
             continue
         created = r.created_at if r.created_at is not None and r.created_at != 0 else now_ms
-        tol = _created_at_tolerance_ms if tolerance_ms is None else tolerance_ms
         if created > now_ms + tol:
             created = now_ms + tol
         elif created < now_ms - tol:

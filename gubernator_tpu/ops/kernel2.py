@@ -40,6 +40,7 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from gubernator_tpu.ops.batch import BatchStats, ReqBatch, RespBatch
 from gubernator_tpu.ops.math import StoredState, bucket_math
@@ -115,8 +116,11 @@ def sweep_geometry(n_buckets: int, batch: int) -> Tuple[int, int]:
         nblk = n_buckets // blk
         mean = batch / nblk
         u = int(mean + 5.0 * mean**0.5) + 64
-        u = -(-u // 64) * 64  # lane-friendly multiple
-        u = min(u, -(-batch // 64) * 64)
+        p = 64  # power of two so the window count divides the (pow2) batch —
+        # the sweep's dynamic index maps address u-aligned payload blocks
+        while p < u:
+            p *= 2
+        u = min(p, batch)
         if blk * u <= (1 << 21) or blk <= 256:
             return blk, u
         blk //= 2
@@ -133,6 +137,7 @@ class Claim2(NamedTuple):
     # sweep-write routing (sorted-by-target domain)
     order: jnp.ndarray  # (B,) i32 original index at each sorted position
     tgt_sorted: jnp.ndarray  # (B,) i32 target slot at each sorted position
+    written_sorted: jnp.ndarray  # (B,) bool — written flag at sorted position
 
 
 def _probe_claim2(
@@ -232,91 +237,114 @@ def _probe_claim2(
         slots=slots,
         order=idx_s2,
         tgt_sorted=tgt_s,
+        written_sorted=written_s,
     )
 
 
 # --------------------------------------------------------------------- write
 
 
-def _sweep_kernel(new16_ref, slot_ref, bkt_ref, in_ref, out_ref):
-    """One table block: compose update rows into bucket rows via int8 one-hot
-    matmuls (MXU) — the scatter-as-matmul trick. All update (row, lane)
-    targets are unique (claim dedup), so the sums place, never add.
+def _make_sweep_kernel(nwin: int, blk: int, u: int):
+    """Kernel factory for the scalar-prefetch sweep (closes over geometry).
 
-    Inputs are slot-granular (16 lanes of payload + slot index within the
-    bucket); the 128-lane expansion and lane mask are derived here — keeping
-    the host-side window gathers narrow (measured: gathering pre-expanded
-    (·,128) payload + int8 masks cost more than the whole table sweep)."""
-    blk_rows = in_ref[:]  # (BLK, 128) i32
-    new16 = new16_ref[:]  # (U, 16) i32 slot payload
-    slot = slot_ref[:]  # (U, 1) i32 slot-in-bucket, or -1 inactive
-    lb = bkt_ref[:]  # (U, 1) i32 local bucket row, or -1 inactive
-    BLK = blk_rows.shape[0]
-    U = new16.shape[0]
-    # 128-lane expansion: lane l belongs to slot l//16 and field l%16
-    lane_slot = jax.lax.broadcasted_iota(jnp.int32, (U, ROW), 1) // F
-    upd = jnp.concatenate([new16] * K, axis=1)  # (U, 128): field pattern x8
-    msk = (lane_slot == slot).astype(jnp.int8)  # (U, 128)
-    iot = jax.lax.broadcasted_iota(jnp.int32, (BLK, U), 0)
-    onehot = (iot == lb[:, 0][None, :]).astype(jnp.int8)
-    written = jax.lax.dot_general(
-        onehot, msk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32
-    )
-    acc = None
-    for s in range(4):
-        plane = (((upd >> (8 * s)) & 0xFF) * msk.astype(jnp.int32)).astype(jnp.int8)
-        p = jax.lax.dot_general(
-            onehot, plane, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32
+    Windowing lives IN the kernel: updates stay in target-sorted order; the
+    grid's dynamic block index maps (PrefetchScalarGridSpec) DMA the two
+    u-aligned payload blocks covering this table block's update run, and
+    slot/lane-mask/liveness derive from the raw sorted targets. Each half is
+    composed into the block rows via int8 one-hot matmuls (MXU — the
+    scatter-as-matmul trick); unique targets (claim dedup) mean the sums
+    place, never add. A run never extends past start+u (the probe's window
+    overflow marks the tail dropped), so two aligned u-blocks always cover
+    it; the second half is masked off when its block index clamps (window at
+    the array end).
+
+    The previous design materialized (nblk·u) host-side window gathers —
+    measured 8 ms of the 16 ms write at headline scale; in-kernel windowing
+    plus one payload gather runs the same sweep in ~3.3 ms (≈600 GB/s through
+    a 1 GiB table)."""
+    KBLK = K * blk
+
+    def kern(s_ref, p1, p2, t1, t2, tbl_in, tbl_out):
+        i = pl.program_id(0)
+        blk_base = i * KBLK
+        dot = functools.partial(
+            jax.lax.dot_general,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=i32,
         )
-        # targets are unique → p holds exactly one (sign-extended) byte;
-        # re-mask before reassembly
-        p = (p & 0xFF) << (8 * s)
-        acc = p if acc is None else acc | p
-    out_ref[:] = jnp.where(written > 0, acc, blk_rows)
+
+        def half(pay_ref, tgt_ref, valid):
+            pay = pay_ref[:]  # (u, F) i32 payload, sorted-by-target
+            tgt = tgt_ref[:]  # (u, 1) i32 global slot target (sentinel NBK)
+            rel = tgt - blk_base
+            live = (rel >= 0) & (rel < KBLK) & valid
+            slot = jnp.where(live, rel % K, -1)  # (u, 1)
+            lb = jnp.where(live, rel // K, -1)  # (u, 1)
+            # lane l of a bucket row belongs to slot l//16, field l%16
+            lane_slot = jax.lax.broadcasted_iota(i32, (u, ROW), 1) // F
+            upd = jnp.concatenate([pay] * K, axis=1)  # (u, 128)
+            msk = (lane_slot == slot).astype(jnp.int8)
+            iot = jax.lax.broadcasted_iota(i32, (blk, u), 0)
+            onehot = (iot == lb[:, 0][None, :]).astype(jnp.int8)
+            w = dot(onehot, msk)
+            acc = None
+            for s in range(4):
+                plane = (((upd >> (8 * s)) & 0xFF) * msk.astype(i32)).astype(
+                    jnp.int8
+                )
+                p = dot(onehot, plane)
+                # one (sign-extended) byte per hit — re-mask, then place
+                p = (p & 0xFF) << (8 * s)
+                acc = p if acc is None else acc | p
+            return acc, w
+
+        second_ok = s_ref[i] + 1 <= nwin - 1
+        acc1, w1 = half(p1, t1, True)
+        acc2, w2 = half(p2, t2, second_ok)
+        tbl_out[:] = jnp.where(w1 + w2 > 0, acc1 | acc2, tbl_in[:])
+
+    return kern
 
 
 def _write_sweep(rows_tbl, new16, c: Claim2, blk: int, u: int):
-    """Pallas sweep write: route sorted updates into per-block windows and
-    stream the table through VMEM once."""
+    """Pallas sweep write: stream the table through VMEM once, composing the
+    target-sorted update run of each block in-kernel (see _make_sweep_kernel)."""
     NB = rows_tbl.shape[0]
     B = new16.shape[0]
     nblk = NB // blk
+    nwin = B // u
+    assert nwin * u == B, f"batch {B} not divisible by window {u}"
 
-    # per-block run starts in the sorted-target order
+    pay_s = new16[c.order]  # the ONE payload gather: original → sorted order
+    tgt_eff = jnp.where(
+        c.written_sorted, c.tgt_sorted, jnp.int32(NB * K)
+    ).astype(i32)[:, None]
     starts = jnp.searchsorted(
         c.tgt_sorted, (jnp.arange(nblk, dtype=i32) * (K * blk)).astype(i32)
     ).astype(i32)
-    win = starts[:, None] + jnp.arange(u, dtype=i32)[None, :]  # (nblk, U)
-    win = win.reshape(-1)
-    win_valid = win < B
-    winc = jnp.clip(win, 0, B - 1)
-    data_idx = c.order[winc]  # original row at this sorted position
-    # a window slot is live iff it's inside the batch, targets this block,
-    # and survived dedup/overflow — written flags are per original row
-    tgt_w = c.tgt_sorted[winc]
-    blk_ids = jnp.repeat(jnp.arange(nblk, dtype=i32), u)
-    in_block = (tgt_w // jnp.int32(K * blk)) == blk_ids
-    livew = win_valid & in_block & c.written[data_idx]
+    s_blk = jnp.clip(starts // u, 0, nwin - 1)
 
-    wnew = new16[data_idx] * livew[:, None].astype(i32)
-    wslot = jnp.where(livew, tgt_w % K, -1).astype(i32)
-    wlb = jnp.where(livew, (tgt_w // K) - blk_ids * blk, -1).astype(i32)
-
+    second = lambda i, s: (jnp.minimum(s[i] + 1, nwin - 1), 0)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((u, F), lambda i, s: (s[i], 0)),
+            pl.BlockSpec((u, F), second),
+            pl.BlockSpec((u, 1), lambda i, s: (s[i], 0)),
+            pl.BlockSpec((u, 1), second),
+            pl.BlockSpec((blk, ROW), lambda i, s: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((blk, ROW), lambda i, s: (i, 0)),
+    )
     with jax.enable_x64(False):
         out = pl.pallas_call(
-            _sweep_kernel,
+            _make_sweep_kernel(nwin, blk, u),
             interpret=jax.default_backend() == "cpu",
             out_shape=jax.ShapeDtypeStruct(rows_tbl.shape, rows_tbl.dtype),
-            grid=(nblk,),
-            in_specs=[
-                pl.BlockSpec((u, F), lambda i: (i, 0)),
-                pl.BlockSpec((u, 1), lambda i: (i, 0)),
-                pl.BlockSpec((u, 1), lambda i: (i, 0)),
-                pl.BlockSpec((blk, ROW), lambda i: (i, 0)),
-            ],
-            out_specs=pl.BlockSpec((blk, ROW), lambda i: (i, 0)),
-            input_output_aliases={3: 0},
-        )(wnew, wslot.reshape(-1, 1), wlb.reshape(-1, 1), rows_tbl)
+            grid_spec=grid_spec,
+            input_output_aliases={5: 0},
+        )(s_blk, pay_s, pay_s, tgt_eff, tgt_eff, rows_tbl)
     return out
 
 

@@ -433,12 +433,15 @@ def test_config_validates_discovery_requirements():
     DaemonConfig(
         peer_discovery_type="member-list", memberlist_address="127.0.0.1:7946"
     ).validate()
-    # k8s requires a selector — without one the pool would join every
-    # workload in the namespace into the peer ring
+    # k8s requires a pod IP (self-recognition) and a selector — without one
+    # the pool would join every workload in the namespace into the peer ring
     with pytest.raises(ConfigError):
-        DaemonConfig(peer_discovery_type="k8s").validate()
+        DaemonConfig(peer_discovery_type="k8s", k8s_selector="a=b").validate()
+    with pytest.raises(ConfigError):
+        DaemonConfig(peer_discovery_type="k8s", k8s_pod_ip="10.0.0.1").validate()
     DaemonConfig(
-        peer_discovery_type="k8s", k8s_selector="app=gubernator"
+        peer_discovery_type="k8s", k8s_pod_ip="10.0.0.1",
+        k8s_selector="app=gubernator",
     ).validate()
 
 

@@ -69,10 +69,10 @@ async def test_global_hits_converge_via_owner_broadcast():
         assert r.remaining == 95  # local replica answered immediately
 
         # owner applies the async hits and broadcasts exactly once
-        await wait_for(lambda: broadcast_count(owner))
+        await wait_for(lambda: broadcast_count(owner), timeout_s=15)
         # every non-owner installed the authoritative status
         for d in non_owners:
-            await wait_for(lambda d=d: updates_installed(d))
+            await wait_for(lambda d=d: updates_installed(d), timeout_s=15)
 
         # all daemons now agree (each answers locally with hits=0)
         for d in c.daemons:
@@ -103,10 +103,10 @@ async def test_global_owner_hit_broadcasts():
     try:
         resp = await client.get_rate_limits([greq("gk2", hits=3)])
         assert resp.responses[0].remaining == 97
-        await wait_for(lambda: broadcast_count(owner))
+        await wait_for(lambda: broadcast_count(owner), timeout_s=15)
         assert await broadcast_count(owner) == 2.0
         for d in c.non_owning_daemons("glob", "gk2"):
-            await wait_for(lambda d=d: updates_installed(d))
+            await wait_for(lambda d=d: updates_installed(d), timeout_s=15)
             # non-owner answers from its replica without contacting the owner
             cl = V1Client(d.conf.grpc_address)
             r = (await cl.get_rate_limits([greq("gk2", hits=0)])).responses[0]
@@ -133,7 +133,7 @@ async def test_global_aggregates_hits_across_non_owners():
                 continue
             await clients[i].get_rate_limits([greq("gk3", hits=4)])
             total += 4
-        await wait_for(lambda: broadcast_count(owner))
+        await wait_for(lambda: broadcast_count(owner), timeout_s=15)
 
         async def converged():
             r = (
@@ -141,7 +141,7 @@ async def test_global_aggregates_hits_across_non_owners():
             ).responses[0]
             return r.remaining == 100 - total
 
-        await wait_for(converged)
+        await wait_for(converged, timeout_s=15)
     finally:
         for cl in clients:
             await cl.close()
