@@ -1,0 +1,25 @@
+# gubernator-tpu server image (reference: the Go repo's multi-stage
+# Dockerfile; here the runtime is Python + JAX, so one stage suffices).
+#
+# The base image must provide jax for your accelerator:
+#   CPU:  python:3.12 + pip install jax
+#   TPU:  a jax[tpu] image for your libtpu release
+ARG BASE_IMAGE=python:3.12-slim
+FROM ${BASE_IMAGE}
+
+WORKDIR /opt/gubernator-tpu
+
+RUN pip install --no-cache-dir \
+    "jax>=0.4.30" numpy aiohttp grpcio protobuf prometheus_client xxhash
+
+COPY gubernator_tpu/ ./gubernator_tpu/
+COPY example.conf ./
+
+ENV PYTHONPATH=/opt/gubernator-tpu
+ENV GUBER_GRPC_ADDRESS=0.0.0.0:1051
+ENV GUBER_HTTP_ADDRESS=0.0.0.0:1050
+
+EXPOSE 1050 1051 7946
+
+# k8s probes: python -m gubernator_tpu.cmd.healthcheck
+ENTRYPOINT ["python", "-m", "gubernator_tpu"]

@@ -281,6 +281,92 @@ def sweep_parity_smoke(rng, now):
     return ok
 
 
+def e2e_serving_case() -> dict:
+    """End-to-end serving: a real daemon (gRPC listener, batching front door,
+    engine on this device) driven by the async client over loopback —
+    the reference's headline is server-level req/s (README.md:131-154).
+    On the tunneled axon platform each dispatch pays a ~100 ms fetch RTT, so
+    this number is a LOWER bound for a co-located TPU host (where the fetch
+    is microseconds); the kernel-side ceiling is the headline metric."""
+    import asyncio
+
+    from gubernator_tpu.client import V1Client
+    from gubernator_tpu.config import BehaviorConfig, DaemonConfig
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.service.daemon import Daemon
+
+    CLIENTS = 16
+    BATCH = 1000  # the wire cap (MAX_BATCH_SIZE)
+    SECONDS = 12.0
+
+    async def run() -> dict:
+        conf = DaemonConfig(
+            grpc_address="127.0.0.1:0",
+            http_address="",
+            cache_size=1 << 20,
+            behaviors=BehaviorConfig(batch_wait_ms=2.0),
+        )
+        d = await Daemon.spawn(conf)
+        client = V1Client(d.conf.grpc_address, timeout_s=120.0)
+        rng = np.random.default_rng(9)
+        reqs = [
+            [
+                pb.RateLimitReq(
+                    name="bench", unique_key=f"c{c}k{i}", hits=1,
+                    limit=1 << 30, duration=60_000,
+                )
+                for i in range(BATCH)
+            ]
+            for c in range(CLIENTS)
+        ]
+        lat: list = []
+        counts = [0]
+
+        call = client._channel.unary_unary(
+            "/pb.gubernator.V1/GetRateLimits",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.GetRateLimitsResp.FromString,
+        )
+
+        async def worker(c):
+            my = pb.GetRateLimitsReq(requests=reqs[c])
+            while time.perf_counter() < deadline:
+                t0 = time.perf_counter()
+                resp = await call(my, timeout=120.0)
+                lat.append(time.perf_counter() - t0)
+                counts[0] += len(resp.responses)
+
+        # warm every coalesced shape first (different arrival timings produce
+        # different padded batch shapes; each compiles once)
+        warm_deadline = time.perf_counter() + 6
+        deadline = warm_deadline
+        await asyncio.gather(*(worker(c) for c in range(CLIENTS)))
+        lat.clear()
+        counts[0] = 0
+        t0 = time.perf_counter()
+        deadline = t0 + SECONDS
+        await asyncio.gather(*(worker(c) for c in range(CLIENTS)))
+        elapsed = time.perf_counter() - t0
+        await client.close()
+        await d.close()
+        arr = np.asarray(sorted(lat)) * 1e3
+        return {
+            "checks_per_sec": round(counts[0] / elapsed, 1),
+            "clients": CLIENTS,
+            "batch": BATCH,
+            "request_p50_ms": round(float(np.percentile(arr, 50)), 2),
+            "request_p99_ms": round(float(np.percentile(arr, 99)), 2),
+        }
+
+    out = asyncio.run(run())
+    log(
+        f"[e2e-serving] {out['checks_per_sec']/1e3:.1f}K checks/s through the "
+        f"gRPC front door; request p50={out['request_p50_ms']}ms "
+        f"p99={out['request_p99_ms']}ms ({CLIENTS} clients x {BATCH}-item batches)"
+    )
+    return out
+
+
 def main() -> None:
     dev = jax.devices()[0]
     log(f"device: {dev}  write mode: {WRITE}")
@@ -291,6 +377,11 @@ def main() -> None:
 
     headline = headline_case(rng, now).run()
     matrix = {"parity_sweep_vs_xla": parity_ok}
+    try:
+        matrix["e2e-serving"] = e2e_serving_case()
+    except Exception as exc:  # the serving bench must never sink the headline
+        log(f"[e2e-serving] FAILED: {type(exc).__name__}: {exc}")
+        matrix["e2e-serving"] = {"error": str(exc)[:200]}
     for builder in (config1_case, config2_case, config4_case):
         case = builder(rng, now)
         res = case.run(dispatches=24, latency_probes=12)

@@ -84,6 +84,56 @@ class EngineStats:
             self.dropped += int(stats.dropped)
 
 
+def serve_columns(engine, cols, now_ms, dispatch) -> ResponseColumns:
+    """The shared columns-in/columns-out serving loop: pack + clamp-count,
+    plan same-key passes, dispatch each (member-row fan-out, ERR_DROPPED for
+    unpersisted rows), fire the Store hook. `dispatch(pass_batch, n_rows)`
+    returns (status, limit, remaining, reset, dropped) over the pass rows —
+    the only thing that differs between the single-device and mesh engines."""
+    now = now_ms if now_ms is not None else ms_now()
+    hb, err = pack_columns(cols, now, tolerance_ms=engine.created_at_tolerance_ms)
+    engine.stats.created_at_clamped += int(
+        ((cols.created_at != 0) & (hb.created_at != cols.created_at)).sum()
+    )
+    n = hb.fp.shape[0]
+    status = np.zeros(n, dtype=np.int32)
+    limit_o = np.zeros(n, dtype=np.int64)
+    remaining = np.zeros(n, dtype=np.int64)
+    reset = np.zeros(n, dtype=np.int64)
+    for p in plan_passes(hb, max_exact=engine.max_exact_passes):
+        np_ = len(p.rows)
+        s, l, r, t, dropped = dispatch(p.batch, np_)
+        if p.member_rows:
+            # fan the aggregate's response out to every member row
+            members = np.concatenate(p.member_rows)
+            src = np.repeat(np.arange(np_), [len(m) for m in p.member_rows])
+            status[members] = s[src]
+            limit_o[members] = l[src]
+            remaining[members] = r[src]
+            reset[members] = t[src]
+            err[members[dropped[src]]] = ERR_DROPPED
+        else:
+            rows = p.rows
+            status[rows] = s
+            limit_o[rows] = l
+            remaining[rows] = r
+            reset[rows] = t
+            err[rows[dropped]] = ERR_DROPPED
+    engine.stats.checks += n
+    if engine.store is not None:
+        persisted = hb.fp[(err == 0) & (hb.fp != 0)]
+        if persisted.shape[0]:
+            from gubernator_tpu.store import ChangeSet
+
+            engine.store.on_change(
+                ChangeSet(fps=np.unique(persisted), created_at=now)
+            )
+    return ResponseColumns(
+        status=status, limit=limit_o, remaining=remaining,
+        reset_time=reset, err=err,
+    )
+
+
 class LocalEngine:
     """One device-resident rate-limit table + its dispatch loop.
 
@@ -91,6 +141,8 @@ class LocalEngine:
     (tests/oracle/ keeps the v1 plane kernel); production always runs the v2
     packed-row kernel (ops/kernel2.py).
     """
+
+    supports_grow = True  # resize()/maybe_grow() are real (cf. ShardedEngine)
 
     def __init__(
         self,
@@ -170,51 +222,12 @@ class LocalEngine:
         """Vectorized serving path: columns in, columns out (request order).
         Per-request validation errors come back as ERR_* codes instead of
         failing the batch (reference gubernator.go:215-237)."""
-        now = now_ms if now_ms is not None else ms_now()
-        hb, err = pack_columns(cols, now, tolerance_ms=self.created_at_tolerance_ms)
-        self.stats.created_at_clamped += int(
-            ((cols.created_at != 0) & (hb.created_at != cols.created_at)).sum()
-        )
-        n = hb.fp.shape[0]
-        status = np.zeros(n, dtype=np.int32)
-        limit_o = np.zeros(n, dtype=np.int64)
-        remaining = np.zeros(n, dtype=np.int64)
-        reset = np.zeros(n, dtype=np.int64)
-        for p in plan_passes(hb, max_exact=self.max_exact_passes):
-            np_ = len(p.rows)
-            batch = pad_batch(p.batch, _pad_size(np_))
-            s, l, r, t, dropped = self._dispatch_with_retry(batch, np_)
-            if p.member_rows:
-                # fan the aggregate's response out to every member row
-                members = np.concatenate(p.member_rows)
-                src = np.repeat(
-                    np.arange(np_), [len(m) for m in p.member_rows]
-                )
-                status[members] = s[src]
-                limit_o[members] = l[src]
-                remaining[members] = r[src]
-                reset[members] = t[src]
-                err[members[dropped[src]]] = ERR_DROPPED
-            else:
-                rows = p.rows
-                status[rows] = s
-                limit_o[rows] = l
-                remaining[rows] = r
-                reset[rows] = t
-                err[rows[dropped]] = ERR_DROPPED
-        self.stats.checks += n
-        if self.store is not None:
-            persisted = hb.fp[(err == 0) & (hb.fp != 0)]
-            if persisted.shape[0]:
-                from gubernator_tpu.store import ChangeSet
 
-                self.store.on_change(
-                    ChangeSet(fps=np.unique(persisted), created_at=now)
-                )
-        return ResponseColumns(
-            status=status, limit=limit_o, remaining=remaining,
-            reset_time=reset, err=err,
-        )
+        def dispatch(pass_batch, n_rows: int):
+            batch = pad_batch(pass_batch, _pad_size(n_rows))
+            return self._dispatch_with_retry(batch, n_rows)
+
+        return serve_columns(self, cols, now_ms, dispatch)
 
     def _dispatch_with_retry(self, batch, n: int):
         """Run one unique-fp pass; rows the claim auction dropped (contended

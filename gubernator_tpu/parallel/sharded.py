@@ -25,8 +25,19 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from gubernator_tpu.ops.batch import HostBatch, ReqBatch, pack_requests, pad_batch
-from gubernator_tpu.ops.kernel2 import decide2_impl
+from gubernator_tpu.ops.batch import (
+    ERR_DROPPED,
+    ERROR_STRINGS,
+    HostBatch,
+    InstallBatch,
+    ReqBatch,
+    RequestColumns,
+    ResponseColumns,
+    pack_columns,
+    pack_requests,
+    pad_batch,
+)
+from gubernator_tpu.ops.kernel2 import decide2_impl, install2_impl
 from gubernator_tpu.ops.engine import ERR_NOT_PERSISTED, EngineStats, default_write_mode, ms_now, _pad_size
 from gubernator_tpu.ops.plan import plan_passes, _subset
 from gubernator_tpu.ops.table2 import Table2, new_table2
@@ -58,6 +69,25 @@ def make_sharded_decide(mesh: Mesh):
     return jax.jit(fn, donate_argnums=(0,))
 
 
+def make_sharded_install(mesh: Mesh):
+    """All-shards install step for owner-authoritative GLOBAL statuses —
+    the UpdatePeerGlobals receive path on a sharded daemon."""
+    write = default_write_mode()
+
+    def per_device(table: Table2, inst: InstallBatch):
+        table = jax.tree.map(lambda x: x[0], table)
+        inst = jax.tree.map(lambda x: x[0], inst)
+        table, installed = install2_impl(table, inst, write=write)
+        expand = lambda t: jax.tree.map(lambda x: x[None], t)
+        return expand(table), expand(installed)
+
+    spec = P(SHARD_AXIS)
+    fn = jax.shard_map(
+        per_device, mesh=mesh, in_specs=(spec, spec), out_specs=(spec, spec)
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
 def new_sharded_table(mesh: Mesh, capacity_per_shard: int) -> Table2:
     """A (D, n_buckets, 128) packed-row table placed shard-per-device."""
     D = mesh.devices.size
@@ -81,6 +111,7 @@ class ShardedEngine:
         capacity_per_shard: int = 50_000,
         max_exact_passes: int = 8,
         created_at_tolerance_ms=None,
+        store=None,
     ):
         self.mesh = mesh
         # per-engine clock-skew bound; None = the ops.batch process default
@@ -88,8 +119,10 @@ class ShardedEngine:
         self.n_shards = int(mesh.devices.size)
         self.table = new_sharded_table(mesh, capacity_per_shard)
         self._decide = make_sharded_decide(mesh)
+        self._install = make_sharded_install(mesh)
         self._batch_sharding = NamedSharding(mesh, P(SHARD_AXIS))
         self.max_exact_passes = max_exact_passes
+        self.store = store  # write-through hook (gubernator_tpu.store.Store)
         self.stats = EngineStats()
 
     def check(
@@ -124,6 +157,96 @@ class ShardedEngine:
         self.stats.checks += len(requests)
         return out  # type: ignore[return-value]
 
+    # ----------------------------------------------- daemon serving surface
+    # The same columns-in/columns-out API as LocalEngine, so the daemon's
+    # Batcher/EngineRunner serve a whole mesh through one engine object
+    # (GUBER_ENGINE=sharded).
+
+    def check_columns(
+        self, cols: RequestColumns, now_ms: Optional[int] = None
+    ) -> ResponseColumns:
+        from gubernator_tpu.ops.engine import serve_columns
+
+        def dispatch(pass_batch, n_rows: int):
+            _, vals = self._dispatch(pass_batch)
+            return vals
+
+        return serve_columns(self, cols, now_ms, dispatch)
+
+    def install_columns(
+        self,
+        fp: np.ndarray,
+        algo: np.ndarray,
+        status: np.ndarray,
+        limit: np.ndarray,
+        remaining: np.ndarray,
+        reset_time: np.ndarray,
+        duration: np.ndarray,
+        now_ms: Optional[int] = None,
+    ) -> int:
+        """Install owner-authoritative GLOBAL statuses, routed to each
+        fingerprint's owning shard (UpdatePeerGlobals receive path)."""
+        now = now_ms if now_ms is not None else ms_now()
+        n = fp.shape[0]
+        if n == 0:
+            return 0
+        D = self.n_shards
+        routed = shard_of(fp, D)
+        order, rs, offset, b_local = _route_plan(routed, D)
+
+        def grid(field, dtype):
+            return jnp.asarray(
+                _to_grid(field[order].astype(dtype), rs, offset, D, b_local)
+            )
+
+        inst = InstallBatch(
+            fp=grid(fp, np.int64),
+            algo=grid(algo, np.int32),
+            status=grid(status, np.int32),
+            limit=grid(limit, np.int64),
+            remaining=grid(remaining, np.int64),
+            reset_time=grid(reset_time, np.int64),
+            duration=grid(duration, np.int64),
+            now=grid(np.full(n, now, dtype=np.int64), np.int64),
+            active=grid(np.ones(n, dtype=bool), bool),
+        )
+        inst = jax.tree.map(
+            lambda x: jax.device_put(x, self._batch_sharding), inst
+        )
+        self.table, installed = self._install(self.table, inst)
+        self.stats.dispatches += 1
+        return int(np.asarray(installed).sum())
+
+    # ------------------------------------------------- maintenance surface
+
+    def snapshot(self) -> np.ndarray:
+        """(D, NB, 128) device→host copy of every shard (Loader.Save analog)."""
+        return np.asarray(self.table.rows)
+
+    def restore(self, rows: np.ndarray) -> None:
+        if rows.shape != tuple(self.table.rows.shape):
+            raise ValueError(
+                f"snapshot shape {rows.shape} != table {tuple(self.table.rows.shape)}"
+            )
+        sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
+        self.table = Table2(
+            rows=jax.device_put(jnp.asarray(rows, dtype=jnp.int32), sharding)
+        )
+
+    def live_count(self, now_ms: Optional[int] = None) -> int:
+        from gubernator_tpu.ops.table2 import live_count2
+
+        # live_count2 reshapes (-1, K, F), so the leading shard axis folds in
+        return live_count2(self.table, now_ms if now_ms is not None else ms_now())
+
+    supports_grow = False  # the daemon must not start an auto-grow loop
+
+    def maybe_grow(self, **kw) -> bool:
+        """Sharded tables are sized at mesh construction; growth means a mesh
+        re-plan (host-orchestrated, like the reference's fixed CacheSize per
+        node). Not auto-grown."""
+        return False
+
     def _dispatch(
         self,
         batch: HostBatch,
@@ -142,19 +265,11 @@ class ShardedEngine:
         D = self.n_shards
         n = batch.fp.shape[0]
         routed = shard if shard is not None else shard_of(batch.fp, D)
-        order = np.argsort(routed, kind="stable")  # rows grouped by shard
-        counts = np.bincount(routed, minlength=D)
-        b_local = _pad_size(int(counts.max()))
+        order, rs, offset_in_shard, b_local = _route_plan(routed, D)
         # scatter rows into (D, b_local) position grid
         grouped = _subset(batch, order)
-        offset_in_shard = np.arange(n) - np.searchsorted(
-            routed[order], routed[order]
-        )
         stacked = HostBatch(
-            *[
-                _to_grid(f, routed[order], offset_in_shard, D, b_local)
-                for f in grouped
-            ]
+            *[_to_grid(f, rs, offset_in_shard, D, b_local) for f in grouped]
         )
         dev_batch = jax.tree.map(
             lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding), stacked
@@ -171,12 +286,12 @@ class ShardedEngine:
             )
         else:
             self.stats.evicted_unexpired += int(stats.evicted_unexpired.sum())
-        # gather responses back: row i lives at (routed[order][i], offset[i])
-        status = np.asarray(resp.status)[routed[order], offset_in_shard]
-        limit = np.asarray(resp.limit)[routed[order], offset_in_shard]
-        remaining = np.asarray(resp.remaining)[routed[order], offset_in_shard]
-        reset = np.asarray(resp.reset_time)[routed[order], offset_in_shard]
-        dropped = np.asarray(resp.dropped)[routed[order], offset_in_shard]
+        # gather responses back: row i lives at (rs[i], offset[i])
+        status = np.asarray(resp.status)[rs, offset_in_shard]
+        limit = np.asarray(resp.limit)[rs, offset_in_shard]
+        remaining = np.asarray(resp.remaining)[rs, offset_in_shard]
+        reset = np.asarray(resp.reset_time)[rs, offset_in_shard]
+        dropped = np.asarray(resp.dropped)[rs, offset_in_shard]
         inv = np.empty(n, dtype=np.int64)
         inv[order] = np.arange(n)
         status, limit, remaining, reset, dropped = (
@@ -200,6 +315,19 @@ class ShardedEngine:
             # surface ERR_NOT_PERSISTED per item instead of failing open
             self.stats.dropped += int(dropped.sum())
         return np.arange(n), (status, limit, remaining, reset, dropped)
+
+
+def _route_plan(routed: np.ndarray, D: int):
+    """Shared shard-routing plan: rows grouped by shard, each row's position
+    within its shard, and the padded per-shard width. Used by both the decide
+    and install paths so their grid geometry can never diverge."""
+    n = routed.shape[0]
+    order = np.argsort(routed, kind="stable")
+    counts = np.bincount(routed, minlength=D)
+    b_local = _pad_size(int(counts.max()))
+    rs = routed[order]
+    offset = np.arange(n) - np.searchsorted(rs, rs)
+    return order, rs, offset, b_local
 
 
 def _to_grid(field: np.ndarray, shard_sorted, offset, D: int, b_local: int) -> np.ndarray:

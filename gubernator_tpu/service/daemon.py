@@ -83,13 +83,31 @@ class Daemon:
         self.event_channel = event_channel
         self.events_dropped = 0
         self.metrics = DaemonMetrics()
-        self.engine = engine if engine is not None else LocalEngine(
-            capacity=conf.cache_size,
-            created_at_tolerance_ms=int(conf.created_at_tolerance_ms),
-            store=store,
-        )
-        if engine is not None and store is not None:
-            engine.store = store
+        if engine is not None:
+            self.engine = engine
+            if store is not None:
+                engine.store = store
+        elif conf.engine == "sharded":
+            # one daemon serving a whole device mesh: the table shards over
+            # every local device, ownership = fingerprint % n_shards
+            import jax
+
+            from gubernator_tpu.parallel import make_mesh
+            from gubernator_tpu.parallel.sharded import ShardedEngine
+
+            n_dev = len(jax.devices())
+            self.engine = ShardedEngine(
+                make_mesh(n_dev),
+                capacity_per_shard=max(1, conf.cache_size // n_dev),
+                created_at_tolerance_ms=int(conf.created_at_tolerance_ms),
+                store=store,
+            )
+        else:
+            self.engine = LocalEngine(
+                capacity=conf.cache_size,
+                created_at_tolerance_ms=int(conf.created_at_tolerance_ms),
+                store=store,
+            )
         self.runner = EngineRunner(self.engine, metrics=self.metrics)
         self.batcher = Batcher(
             self.runner,
@@ -132,9 +150,16 @@ class Daemon:
         d.region_manager.start()
         await d._start_discovery()
         if conf.cache_max_size > conf.cache_size:
-            d._maintenance_task = asyncio.create_task(
-                d._maintenance_loop(), name="table-maintenance"
-            )
+            if getattr(d.engine, "supports_grow", False):
+                d._maintenance_task = asyncio.create_task(
+                    d._maintenance_loop(), name="table-maintenance"
+                )
+            else:
+                log.warning(
+                    "GUBER_CACHE_MAX_SIZE is set but the %s engine cannot "
+                    "auto-grow; the table stays at its construction size",
+                    conf.engine,
+                )
         return d
 
     async def _maintenance_loop(self) -> None:
