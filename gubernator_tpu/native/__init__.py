@@ -1,0 +1,74 @@
+"""Native host ingress/egress — build + load the _guberhost C++ extension.
+
+`load()` returns the extension module (building it with g++ on first use) or
+None when no toolchain is available; callers keep a pure-Python fallback.
+The build is a single translation unit against Python.h only — no
+libprotobuf, no numpy C API (buffers cross as bytes; numpy wraps them with
+np.frombuffer zero-copy).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sysconfig
+from typing import Optional
+
+log = logging.getLogger("gubernator_tpu.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "guberhost.cpp")
+_mod = None
+_tried = False
+
+
+def _so_path() -> str:
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(_DIR, f"_guberhost{suffix}")
+
+
+def build(force: bool = False) -> Optional[str]:
+    """Compile the extension in-place; returns the .so path or None."""
+    so = _so_path()
+    if (
+        not force
+        and os.path.exists(so)
+        and os.path.getmtime(so) >= os.path.getmtime(_SRC)
+    ):
+        return so
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        f"-I{include}", "-o", so, _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as exc:
+        detail = getattr(exc, "stderr", b"") or b""
+        log.warning(
+            "native guberhost build failed (%s): %s — using the Python path",
+            exc, detail.decode(errors="replace")[:500],
+        )
+        return None
+    return so
+
+
+def load():
+    """The extension module, building if needed; None if unavailable."""
+    global _mod, _tried
+    if _mod is not None or _tried:
+        return _mod
+    _tried = True
+    if os.environ.get("GUBER_NATIVE", "").lower() in ("0", "false", "off"):
+        return None
+    if build() is None:
+        return None
+    try:
+        from gubernator_tpu.native import _guberhost  # type: ignore
+
+        _mod = _guberhost
+    except ImportError as exc:  # pragma: no cover - toolchain-specific
+        log.warning("native guberhost import failed: %s", exc)
+        _mod = None
+    return _mod

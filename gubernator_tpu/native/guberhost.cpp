@@ -1,0 +1,449 @@
+// guberhost — native host ingress/egress for gubernator-tpu.
+//
+// The serving hot path's host-side cost is per-item Python work: protobuf
+// message traversal, string hashing, and response object construction
+// (~1-2 µs/item), which caps a host at ~1M checks/s regardless of kernel
+// speed. This module parses the GetRateLimitsReq WIRE BYTES directly into
+// flat column buffers (consumed via np.frombuffer), computes both hashes
+// (63-bit seeded XXH64 fingerprint — ops/hashing.py parity; fnv1a_32 ring
+// point — peers/hash_ring.py parity) in the same pass, and serializes
+// GetRateLimitsResp straight from response columns.
+//
+// Wire schema parsed (proto/gubernator.proto):
+//   GetRateLimitsReq { repeated RateLimitReq requests = 1; }
+//   RateLimitReq { name=1 str; unique_key=2 str; hits=3; limit=4;
+//                  duration=5; algorithm=6; behavior=7; burst=8;
+//                  metadata=9 (skipped); created_at=10 }
+//   GetRateLimitsResp { repeated RateLimitResp responses = 1; }
+//   RateLimitResp { status=1; limit=2; remaining=3; reset_time=4;
+//                   error=5 str; metadata=6 }
+//
+// No libprotobuf dependency: varint/length-delimited framing is ~60 lines.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+// ------------------------------------------------------------------ XXH64
+// Standard XXH64 (public algorithm; matches python-xxhash output).
+
+static const uint64_t P1 = 11400714785074694791ULL;
+static const uint64_t P2 = 14029467366897019727ULL;
+static const uint64_t P3 = 1609587929392839161ULL;
+static const uint64_t P4 = 9650029242287828579ULL;
+static const uint64_t P5 = 2870177450012600261ULL;
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+static inline uint64_t read64(const uint8_t* p) {
+  uint64_t v;
+  memcpy(&v, p, 8);
+  return v;  // little-endian hosts only (x86/ARM)
+}
+static inline uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+static inline uint64_t xxh_round(uint64_t acc, uint64_t input) {
+  acc += input * P2;
+  acc = rotl64(acc, 31);
+  return acc * P1;
+}
+static inline uint64_t xxh_merge(uint64_t acc, uint64_t val) {
+  acc ^= xxh_round(0, val);
+  return acc * P1 + P4;
+}
+
+static uint64_t xxh64(const uint8_t* p, size_t len, uint64_t seed) {
+  const uint8_t* end = p + len;
+  uint64_t h;
+  if (len >= 32) {
+    uint64_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed, v4 = seed - P1;
+    const uint8_t* limit = end - 32;
+    do {
+      v1 = xxh_round(v1, read64(p)); p += 8;
+      v2 = xxh_round(v2, read64(p)); p += 8;
+      v3 = xxh_round(v3, read64(p)); p += 8;
+      v4 = xxh_round(v4, read64(p)); p += 8;
+    } while (p <= limit);
+    h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+    h = xxh_merge(h, v1); h = xxh_merge(h, v2);
+    h = xxh_merge(h, v3); h = xxh_merge(h, v4);
+  } else {
+    h = seed + P5;
+  }
+  h += (uint64_t)len;
+  while (p + 8 <= end) {
+    h ^= xxh_round(0, read64(p));
+    h = rotl64(h, 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h ^= (uint64_t)read32(p) * P1;
+    h = rotl64(h, 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= (*p) * P5;
+    h = rotl64(h, 11) * P1;
+    p++;
+  }
+  h ^= h >> 33;
+  h *= P2;
+  h ^= h >> 29;
+  h *= P3;
+  h ^= h >> 32;
+  return h;
+}
+
+static inline uint32_t fnv1a_32(const uint8_t* p, size_t len) {
+  uint32_t h = 2166136261u;
+  for (size_t i = 0; i < len; i++) {
+    h ^= p[i];
+    h *= 16777619u;
+  }
+  return h;
+}
+
+// ------------------------------------------------------------ proto frames
+
+struct Cursor {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 64) {
+      uint8_t b = *p++;
+      v |= (uint64_t)(b & 0x7F) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+
+  bool skip(uint32_t wt) {
+    switch (wt) {
+      case 0: varint(); return ok;
+      case 1: if (end - p < 8) return ok = false; p += 8; return true;
+      case 2: {
+        uint64_t n = varint();
+        if (!ok || (uint64_t)(end - p) < n) return ok = false;
+        p += n;
+        return true;
+      }
+      case 5: if (end - p < 4) return ok = false; p += 4; return true;
+      default: return ok = false;
+    }
+  }
+};
+
+static const uint64_t FP_SEED = 0x6775626572ULL;  // hashing.py _SEED
+static const uint64_t MASK63 = (1ULL << 63) - 1;
+
+// err codes — ops/batch.py ERR_*
+enum { ERR_OK = 0, ERR_EMPTY_KEY = 1, ERR_EMPTY_NAME = 2 };
+
+struct Item {
+  const uint8_t* name = nullptr; size_t name_len = 0;
+  const uint8_t* key = nullptr; size_t key_len = 0;
+  const uint8_t* traceparent = nullptr; size_t traceparent_len = 0;
+  int64_t hits = 0, limit = 0, duration = 0, burst = 0, created_at = 0;
+  int32_t algorithm = 0, behavior = 0;
+  size_t start = 0, len = 0;  // byte span of the item message in the input
+};
+
+// metadata map entry {1: key str, 2: value str} — only "traceparent" is
+// routing-relevant (trace propagation; docs/tracing.md)
+static void parse_metadata_entry(const uint8_t* p, const uint8_t* end,
+                                 Item& it) {
+  Cursor c{p, end};
+  const uint8_t* k = nullptr; size_t klen = 0;
+  const uint8_t* v = nullptr; size_t vlen = 0;
+  while (c.p < c.end && c.ok) {
+    uint64_t tag = c.varint();
+    if (!c.ok) return;
+    uint32_t field = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+    if ((field == 1 || field == 2) && wt == 2) {
+      uint64_t n = c.varint();
+      if (!c.ok || (uint64_t)(c.end - c.p) < n) return;
+      if (field == 1) { k = c.p; klen = n; } else { v = c.p; vlen = n; }
+      c.p += n;
+    } else if (!c.skip(wt)) {
+      return;
+    }
+  }
+  if (k && v && klen == 11 && memcmp(k, "traceparent", 11) == 0) {
+    it.traceparent = v;
+    it.traceparent_len = vlen;
+  }
+}
+
+static bool parse_item(Cursor& c, Item& it) {
+  while (c.p < c.end && c.ok) {
+    uint64_t tag = c.varint();
+    if (!c.ok) return false;
+    uint32_t field = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+    switch (field) {
+      case 1: case 2: {  // name / unique_key
+        if (wt != 2) return false;
+        uint64_t n = c.varint();
+        if (!c.ok || (uint64_t)(c.end - c.p) < n) return false;
+        if (field == 1) { it.name = c.p; it.name_len = n; }
+        else { it.key = c.p; it.key_len = n; }
+        c.p += n;
+        break;
+      }
+      case 3: it.hits = (int64_t)c.varint(); break;
+      case 4: it.limit = (int64_t)c.varint(); break;
+      case 5: it.duration = (int64_t)c.varint(); break;
+      case 6: it.algorithm = (int32_t)c.varint(); break;
+      case 7: it.behavior = (int32_t)c.varint(); break;
+      case 8: it.burst = (int64_t)c.varint(); break;
+      case 9: {  // metadata map entry
+        if (wt != 2) return false;
+        uint64_t n = c.varint();
+        if (!c.ok || (uint64_t)(c.end - c.p) < n) return false;
+        parse_metadata_entry(c.p, c.p + n, it);
+        c.p += n;
+        break;
+      }
+      case 10: it.created_at = (int64_t)c.varint(); break;
+      default:
+        if (!c.skip(wt)) return false;
+    }
+  }
+  return c.ok;
+}
+
+// parse_get_rate_limits(data: bytes)
+//   -> (n, fp, algo, behavior, hits, limit, burst, duration, created_at,
+//       err, ring_hash, spans)
+// Buffer layouts (np.frombuffer): fp/hits/limit/burst/duration/created_at
+// int64; algo/behavior int32; err int8; ring_hash uint32; spans int64 pairs
+// (start, len) of each item's bytes for lazy pb materialization.
+static PyObject* parse_get_rate_limits(PyObject*, PyObject* args) {
+  Py_buffer buf;
+  if (!PyArg_ParseTuple(args, "y*", &buf)) return nullptr;
+  const uint8_t* data = (const uint8_t*)buf.buf;
+  Cursor top{data, data + buf.len};
+
+  std::vector<Item> items;
+  items.reserve(64);
+  while (top.p < top.end && top.ok) {
+    uint64_t tag = top.varint();
+    if (!top.ok) break;
+    uint32_t field = (uint32_t)(tag >> 3), wt = (uint32_t)(tag & 7);
+    if (field == 1 && wt == 2) {
+      uint64_t n = top.varint();
+      if (!top.ok || (uint64_t)(top.end - top.p) < n) { top.ok = false; break; }
+      Item it;
+      it.start = (size_t)(top.p - data);
+      it.len = (size_t)n;
+      Cursor ic{top.p, top.p + n};
+      if (!parse_item(ic, it)) { top.ok = false; break; }
+      items.push_back(it);
+      top.p += n;
+    } else if (!top.skip(wt)) {
+      break;
+    }
+  }
+  if (!top.ok) {
+    PyBuffer_Release(&buf);
+    PyErr_SetString(PyExc_ValueError, "malformed GetRateLimitsReq");
+    return nullptr;
+  }
+
+  size_t n = items.size();
+  // first propagated trace context in the batch (the daemon adopts one
+  // scope per request, same as the pb path's first-match extraction)
+  PyObject* tp = nullptr;
+  for (size_t i = 0; i < n && !tp; i++) {
+    if (items[i].traceparent) {
+      tp = PyUnicode_DecodeUTF8((const char*)items[i].traceparent,
+                                (Py_ssize_t)items[i].traceparent_len,
+                                "replace");
+      if (!tp) PyErr_Clear();
+    }
+  }
+  if (!tp) {
+    tp = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject* out = PyTuple_New(13);
+  PyObject* fp_b = PyBytes_FromStringAndSize(nullptr, n * 8);
+  PyObject* algo_b = PyBytes_FromStringAndSize(nullptr, n * 4);
+  PyObject* beh_b = PyBytes_FromStringAndSize(nullptr, n * 4);
+  PyObject* hits_b = PyBytes_FromStringAndSize(nullptr, n * 8);
+  PyObject* lim_b = PyBytes_FromStringAndSize(nullptr, n * 8);
+  PyObject* burst_b = PyBytes_FromStringAndSize(nullptr, n * 8);
+  PyObject* dur_b = PyBytes_FromStringAndSize(nullptr, n * 8);
+  PyObject* ca_b = PyBytes_FromStringAndSize(nullptr, n * 8);
+  PyObject* err_b = PyBytes_FromStringAndSize(nullptr, n);
+  PyObject* ring_b = PyBytes_FromStringAndSize(nullptr, n * 4);
+  PyObject* span_b = PyBytes_FromStringAndSize(nullptr, n * 16);
+  if (!out || !fp_b || !algo_b || !beh_b || !hits_b || !lim_b || !burst_b ||
+      !dur_b || !ca_b || !err_b || !ring_b || !span_b) {
+    PyBuffer_Release(&buf);
+    Py_XDECREF(out);
+    return nullptr;
+  }
+  int64_t* fp = (int64_t*)PyBytes_AS_STRING(fp_b);
+  int32_t* algo = (int32_t*)PyBytes_AS_STRING(algo_b);
+  int32_t* beh = (int32_t*)PyBytes_AS_STRING(beh_b);
+  int64_t* hits = (int64_t*)PyBytes_AS_STRING(hits_b);
+  int64_t* lim = (int64_t*)PyBytes_AS_STRING(lim_b);
+  int64_t* burst = (int64_t*)PyBytes_AS_STRING(burst_b);
+  int64_t* dur = (int64_t*)PyBytes_AS_STRING(dur_b);
+  int64_t* ca = (int64_t*)PyBytes_AS_STRING(ca_b);
+  int8_t* err = (int8_t*)PyBytes_AS_STRING(err_b);
+  uint32_t* ring = (uint32_t*)PyBytes_AS_STRING(ring_b);
+  int64_t* span = (int64_t*)PyBytes_AS_STRING(span_b);
+
+  std::string hk;
+  for (size_t i = 0; i < n; i++) {
+    const Item& it = items[i];
+    algo[i] = it.algorithm;
+    beh[i] = it.behavior;
+    hits[i] = it.hits;
+    lim[i] = it.limit;
+    burst[i] = it.burst;
+    dur[i] = it.duration;
+    ca[i] = it.created_at;
+    span[2 * i] = (int64_t)it.start;
+    span[2 * i + 1] = (int64_t)it.len;
+    fp[i] = 0;
+    ring[i] = 0;
+    if (it.key_len == 0) { err[i] = ERR_EMPTY_KEY; continue; }
+    if (it.name_len == 0) { err[i] = ERR_EMPTY_NAME; continue; }
+    err[i] = ERR_OK;
+    hk.clear();
+    hk.append((const char*)it.name, it.name_len);
+    hk.push_back('_');
+    hk.append((const char*)it.key, it.key_len);
+    uint64_t h =
+        xxh64((const uint8_t*)hk.data(), hk.size(), FP_SEED) & MASK63;
+    fp[i] = (int64_t)(h ? h : 1);
+    ring[i] = fnv1a_32((const uint8_t*)hk.data(), hk.size());
+  }
+  PyBuffer_Release(&buf);
+
+  PyTuple_SET_ITEM(out, 0, PyLong_FromSize_t(n));
+  PyTuple_SET_ITEM(out, 1, fp_b);
+  PyTuple_SET_ITEM(out, 2, algo_b);
+  PyTuple_SET_ITEM(out, 3, beh_b);
+  PyTuple_SET_ITEM(out, 4, hits_b);
+  PyTuple_SET_ITEM(out, 5, lim_b);
+  PyTuple_SET_ITEM(out, 6, burst_b);
+  PyTuple_SET_ITEM(out, 7, dur_b);
+  PyTuple_SET_ITEM(out, 8, ca_b);
+  PyTuple_SET_ITEM(out, 9, err_b);
+  PyTuple_SET_ITEM(out, 10, ring_b);
+  PyTuple_SET_ITEM(out, 11, span_b);
+  PyTuple_SET_ITEM(out, 12, tp);
+  return out;
+}
+
+// ------------------------------------------------------------- encode side
+
+static inline void put_varint(std::string& out, uint64_t v) {
+  while (v >= 0x80) {
+    out.push_back((char)(v | 0x80));
+    v >>= 7;
+  }
+  out.push_back((char)v);
+}
+static inline void put_tag(std::string& out, uint32_t field, uint32_t wt) {
+  put_varint(out, ((uint64_t)field << 3) | wt);
+}
+
+// encode_responses(status_i64, limit_i64, remaining_i64, reset_i64,
+//                  errors: dict[int, str]) -> bytes(GetRateLimitsResp)
+// The column buffers are raw little-endian int64 (e.g. arr.tobytes()).
+static PyObject* encode_responses(PyObject*, PyObject* args) {
+  Py_buffer sb, lb, rb, tb;
+  PyObject* errs;
+  if (!PyArg_ParseTuple(args, "y*y*y*y*O", &sb, &lb, &rb, &tb, &errs))
+    return nullptr;
+  size_t n = (size_t)(sb.len / 8);
+  const int64_t* st = (const int64_t*)sb.buf;
+  const int64_t* li = (const int64_t*)lb.buf;
+  const int64_t* re = (const int64_t*)rb.buf;
+  const int64_t* rt = (const int64_t*)tb.buf;
+
+  std::string out;
+  out.reserve(n * 24);
+  std::string item;
+  for (size_t i = 0; i < n; i++) {
+    item.clear();
+    if (st[i]) { put_tag(item, 1, 0); put_varint(item, (uint64_t)st[i]); }
+    if (li[i]) { put_tag(item, 2, 0); put_varint(item, (uint64_t)li[i]); }
+    if (re[i]) { put_tag(item, 3, 0); put_varint(item, (uint64_t)re[i]); }
+    if (rt[i]) { put_tag(item, 4, 0); put_varint(item, (uint64_t)rt[i]); }
+    PyObject* key = PyLong_FromSize_t(i);
+    PyObject* es = PyDict_GetItem(errs, key);  // borrowed
+    Py_DECREF(key);
+    if (es) {
+      Py_ssize_t elen;
+      const char* ep = PyUnicode_AsUTF8AndSize(es, &elen);
+      if (!ep) {
+        PyBuffer_Release(&sb); PyBuffer_Release(&lb);
+        PyBuffer_Release(&rb); PyBuffer_Release(&tb);
+        return nullptr;
+      }
+      if (elen) {
+        put_tag(item, 5, 2);
+        put_varint(item, (uint64_t)elen);
+        item.append(ep, (size_t)elen);
+      }
+    }
+    put_tag(out, 1, 2);
+    put_varint(out, item.size());
+    out += item;
+  }
+  PyBuffer_Release(&sb);
+  PyBuffer_Release(&lb);
+  PyBuffer_Release(&rb);
+  PyBuffer_Release(&tb);
+  return PyBytes_FromStringAndSize(out.data(), (Py_ssize_t)out.size());
+}
+
+// fingerprint64(data: bytes) -> int — parity check hook for tests
+static PyObject* fingerprint64(PyObject*, PyObject* args) {
+  Py_buffer buf;
+  if (!PyArg_ParseTuple(args, "y*", &buf)) return nullptr;
+  uint64_t h = xxh64((const uint8_t*)buf.buf, (size_t)buf.len, FP_SEED) & MASK63;
+  PyBuffer_Release(&buf);
+  return PyLong_FromUnsignedLongLong(h ? h : 1);
+}
+
+static PyObject* fnv1a32_py(PyObject*, PyObject* args) {
+  Py_buffer buf;
+  if (!PyArg_ParseTuple(args, "y*", &buf)) return nullptr;
+  uint32_t h = fnv1a_32((const uint8_t*)buf.buf, (size_t)buf.len);
+  PyBuffer_Release(&buf);
+  return PyLong_FromUnsignedLong(h);
+}
+
+static PyMethodDef methods[] = {
+    {"parse_get_rate_limits", parse_get_rate_limits, METH_VARARGS,
+     "GetRateLimitsReq wire bytes -> column buffers"},
+    {"encode_responses", encode_responses, METH_VARARGS,
+     "response columns -> GetRateLimitsResp wire bytes"},
+    {"fingerprint64", fingerprint64, METH_VARARGS, "seeded 63-bit XXH64"},
+    {"fnv1a32", fnv1a32_py, METH_VARARGS, "fnv1a 32-bit"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static struct PyModuleDef mod = {PyModuleDef_HEAD_INIT, "_guberhost",
+                                 "native host ingress/egress", -1, methods};
+
+PyMODINIT_FUNC PyInit__guberhost(void) { return PyModule_Create(&mod); }

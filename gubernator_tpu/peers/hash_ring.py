@@ -76,6 +76,7 @@ class ReplicatedConsistentHash:
             point = self.hash_fn(f"{i}{digest}".encode())
             self._ring.append((point, peer))
         self._ring.sort(key=lambda t: t[0])
+        self._ring_pts = None  # invalidate the vectorized-lookup cache
 
     def get(self, key: str) -> PeerInfo:
         """Owner of `key` — first ring point at or after hash(key), wrapping
@@ -87,6 +88,25 @@ class ReplicatedConsistentHash:
         if idx == len(self._ring):
             idx = 0
         return self._ring[idx][1]
+
+    def owners_of(self, points) -> List[PeerInfo]:
+        """Vectorized get(): precomputed 32-bit ring points (numpy array) →
+        owner per element. Used by the native ingress path, which computes
+        fnv1a ring points during wire parsing so no key strings need to be
+        materialized for routing."""
+        if not self._ring:
+            raise RuntimeError("unable to pick a peer; pool is empty")
+        import numpy as np
+
+        if getattr(self, "_ring_pts", None) is None or len(self._ring_pts) != len(
+            self._ring
+        ):
+            self._ring_pts = np.fromiter(
+                (p for p, _ in self._ring), np.uint32, len(self._ring)
+            )
+        idx = np.searchsorted(self._ring_pts, points, side="left")
+        idx[idx == len(self._ring)] = 0
+        return [self._ring[i][1] for i in idx]
 
     def size(self) -> int:
         return len(self._peers)

@@ -451,6 +451,150 @@ class Daemon:
                 self.metrics.over_limit_counter.inc()
         return out  # type: ignore[return-value]
 
+    # ------------------------------------------------- native raw fast path
+    async def get_rate_limits_raw(self, data: bytes) -> bytes:
+        """Serve GetRateLimitsReq wire bytes → GetRateLimitsResp wire bytes.
+
+        The native ingress (gubernator_tpu/native) parses the request buffer
+        straight into column arrays — no per-item Python objects on the
+        owner-local path; only items that must travel as messages (forwards,
+        GLOBAL/MULTI_REGION queue entries) materialize lazily from their wire
+        spans. Falls back to the pb path when the extension is unavailable or
+        an event channel needs full request objects."""
+        from gubernator_tpu.service.wire import columns_from_wire
+
+        parsed = None
+        if self.event_channel is None:
+            parsed = columns_from_wire(data)
+        if parsed is None:
+            req = pb.GetRateLimitsReq.FromString(data)
+            resps = await self.get_rate_limits(list(req.requests))
+            return pb.GetRateLimitsResp(responses=resps).SerializeToString()
+        cols, ring, spans, traceparent = parsed
+        n = cols.fp.shape[0]
+        if n > MAX_BATCH_SIZE:
+            raise ValueError(
+                f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'"
+            )
+        self.metrics.concurrent_checks.inc()
+        parent = tracing.parse_traceparent(traceparent) if traceparent else None
+        token = tracing.start_scope("GetRateLimits", parent)
+        try:
+            return await self._route_raw(data, cols, ring, spans)
+        finally:
+            tracing.end_scope(token)
+            self.metrics.concurrent_checks.dec()
+
+    async def _route_raw(self, data, cols, ring, spans) -> bytes:
+        from gubernator_tpu.service.wire import (
+            encode_response_columns,
+            item_from_span,
+        )
+
+        n = cols.fp.shape[0]
+        force_global = self.conf.behaviors.force_global
+        if force_global:
+            cols = cols._replace(
+                behavior=cols.behavior | np.int32(int(Behavior.GLOBAL))
+            )
+
+        def materialize(i):
+            """Lazy pb item from its wire span; a forced GLOBAL bit must
+            follow the item into queues/forwards (the pb path mutates items
+            in place, gubernator.go:239-241)."""
+            item = item_from_span(data, spans[i])
+            if force_global:
+                item.behavior |= int(Behavior.GLOBAL)
+            return item
+        status = np.zeros(n, dtype=np.int64)
+        limit = np.zeros(n, dtype=np.int64)
+        remaining = np.zeros(n, dtype=np.int64)
+        reset = np.zeros(n, dtype=np.int64)
+        errors: Dict[int, str] = {
+            int(i): ERROR_STRINGS[int(cols.err[i])]
+            for i in np.nonzero(cols.err)[0]
+        }
+        valid = cols.err == 0
+        is_global = (cols.behavior & np.int32(int(Behavior.GLOBAL))) != 0
+        is_mr = (cols.behavior & np.int32(int(Behavior.MULTI_REGION))) != 0
+
+        if self._local_picker.size() == 0:
+            mine = valid
+        else:
+            owners = self._local_picker.owners_of(ring)
+            self_addr = self.conf.advertise_address
+            mine = valid & np.fromiter(
+                (o.grpc_address == self_addr for o in owners), bool, n
+            )
+        local_rows = np.nonzero(mine)[0]
+        global_rows = np.nonzero(valid & ~mine & is_global)[0]
+        fwd_rows = np.nonzero(valid & ~mine & ~is_global)[0]
+
+        def place(rows, rc) -> None:
+            status[rows] = rc.status
+            limit[rows] = rc.limit
+            remaining[rows] = rc.remaining
+            reset[rows] = rc.reset_time
+            for j, i in enumerate(rows):
+                if rc.err[j]:
+                    errors[int(i)] = ERROR_STRINGS[int(rc.err[j])]
+
+        async def run_local():
+            rc = await self.batcher.check(subset_columns(cols, local_rows))
+            place(local_rows, rc)
+
+        async def run_global():
+            # answer from local state with GLOBAL stripped + NO_BATCHING
+            # forced, and queue the async hits (gubernator.go:401-429)
+            g = subset_columns(cols, global_rows)
+            g = g._replace(
+                behavior=(g.behavior & ~np.int32(int(Behavior.GLOBAL)))
+                | np.int32(int(Behavior.NO_BATCHING))
+            )
+            for i in global_rows:
+                item = materialize(i)
+                self.global_manager.queue_hit(
+                    item.name + "_" + item.unique_key, item
+                )
+            rc = await self.batcher.check(g)
+            place(global_rows, rc)
+
+        async def run_forward(row: int):
+            item = materialize(row)
+            out: List[Optional[pb.RateLimitResp]] = [None]
+            await self._forward(0, item.name + "_" + item.unique_key, item, out)
+            r = out[0]
+            status[row] = r.status
+            limit[row] = r.limit
+            remaining[row] = r.remaining
+            reset[row] = r.reset_time
+            if r.error:
+                errors[int(row)] = r.error
+
+        tasks = []
+        if local_rows.size:
+            tasks.append(run_local())
+        if global_rows.size:
+            tasks.append(run_global())
+        tasks.extend(run_forward(int(i)) for i in fwd_rows)
+        if tasks:
+            await asyncio.gather(*tasks)
+        # owner-side GLOBAL broadcasts + MULTI_REGION replication
+        for i in local_rows[is_global[local_rows]]:
+            item = materialize(i)
+            self.global_manager.queue_update(
+                item.name + "_" + item.unique_key, item
+            )
+        for i in local_rows[is_mr[local_rows]]:
+            item = materialize(i)
+            self.region_manager.queue_hit(
+                item.name + "_" + item.unique_key, item
+            )
+        over = int((status == int(pb.OVER_LIMIT)).sum())
+        if over:
+            self.metrics.over_limit_counter.inc(over)
+        return encode_response_columns(status, limit, remaining, reset, errors)
+
     def _emit_event(self, item, resp) -> None:
         if resp is None:  # pragma: no cover - defensive
             return

@@ -51,12 +51,13 @@ def build_grpc_services(daemon):
     m = daemon.metrics
 
     @_timed(m, "/v1.GetRateLimits")
-    async def get_rate_limits(request: pb.GetRateLimitsReq, context):
+    async def get_rate_limits(request: bytes, context):
+        # raw wire bytes: the native ingress parses them straight into
+        # columns (daemon.get_rate_limits_raw); pb fallback inside
         try:
-            resps = await daemon.get_rate_limits(list(request.requests))
+            return await daemon.get_rate_limits_raw(request)
         except ValueError as exc:  # batch too large etc.
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
-        return pb.GetRateLimitsResp(responses=resps)
 
     @_timed(m, "/v1.HealthCheck")
     async def health_check(request: pb.HealthCheckReq, context):
@@ -87,8 +88,12 @@ def build_grpc_services(daemon):
     v1 = grpc.method_handlers_generic_handler(
         V1,
         {
-            "GetRateLimits": unary(
-                get_rate_limits, pb.GetRateLimitsReq, pb.GetRateLimitsResp
+            # GetRateLimits passes wire bytes through untouched — the
+            # native ingress owns (de)serialization
+            "GetRateLimits": grpc.unary_unary_rpc_method_handler(
+                get_rate_limits,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b,
             ),
             "HealthCheck": unary(health_check, pb.HealthCheckReq, pb.HealthCheckResp),
             "LiveCheck": unary(live_check, pb.LiveCheckReq, pb.LiveCheckResp),

@@ -135,3 +135,69 @@ def resp_pb_into_columns(
 
 def peer_req_pb(items: Sequence["pb.RateLimitReq"]) -> "peers_pb.GetPeerRateLimitsReq":
     return peers_pb.GetPeerRateLimitsReq(requests=items)
+
+
+# ----------------------------------------------------------- native ingress
+
+
+def columns_from_wire(data: bytes):
+    """Native parse of GetRateLimitsReq wire bytes (gubernator_tpu.native):
+    → (RequestColumns, ring_points uint32, spans (n,2) int64) or None when
+    the extension is unavailable. ring_points are fnv1a_32 of each item's
+    hash key (the ring lookup hash) and spans are each item's byte range in
+    `data` for lazy pb materialization — only items that must travel as
+    messages (forwards, GLOBAL queue entries) ever become Python objects."""
+    from gubernator_tpu import native
+
+    m = native.load()
+    if m is None:
+        return None
+    n, fp, algo, beh, hits, lim, burst, dur, ca, err, ring, span, traceparent = (
+        m.parse_get_rate_limits(data)
+    )
+    # np.frombuffer over bytes is read-only; routing mutates behavior/err
+    cols = RequestColumns(
+        fp=np.frombuffer(fp, np.int64),
+        algo=np.frombuffer(algo, np.int32),
+        behavior=np.frombuffer(beh, np.int32).copy(),
+        hits=np.frombuffer(hits, np.int64),
+        limit=np.frombuffer(lim, np.int64),
+        burst=np.frombuffer(burst, np.int64),
+        duration=np.frombuffer(dur, np.int64),
+        created_at=np.frombuffer(ca, np.int64),
+        err=np.frombuffer(err, np.int8).copy(),
+    )
+    return (
+        cols,
+        np.frombuffer(ring, np.uint32),
+        np.frombuffer(span, np.int64).reshape(-1, 2),
+        traceparent,  # first propagated trace context in the batch, or None
+    )
+
+
+def item_from_span(data: bytes, span) -> "pb.RateLimitReq":
+    """Materialize one request item from its wire span (lazy pb path)."""
+    s, ln = int(span[0]), int(span[1])
+    return pb.RateLimitReq.FromString(data[s : s + ln])
+
+
+def encode_response_columns(
+    status: np.ndarray,
+    limit: np.ndarray,
+    remaining: np.ndarray,
+    reset_time: np.ndarray,
+    errors: dict,
+) -> bytes:
+    """Native GetRateLimitsResp encode from response columns; `errors` is a
+    sparse {row: message} dict."""
+    from gubernator_tpu import native
+
+    m = native.load()
+    assert m is not None, "native module required (guarded by columns_from_wire)"
+    return m.encode_responses(
+        np.ascontiguousarray(status, dtype=np.int64).tobytes(),
+        np.ascontiguousarray(limit, dtype=np.int64).tobytes(),
+        np.ascontiguousarray(remaining, dtype=np.int64).tobytes(),
+        np.ascontiguousarray(reset_time, dtype=np.int64).tobytes(),
+        errors,
+    )

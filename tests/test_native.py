@@ -1,0 +1,201 @@
+"""Native ingress/egress (gubernator_tpu/native) parity tests: wire parsing,
+hashing, and response encoding must match the pure-Python pb path exactly."""
+
+import asyncio
+import functools
+import random
+
+import numpy as np
+import pytest
+
+from gubernator_tpu import native
+from gubernator_tpu.proto import gubernator_pb2 as pb
+
+m = native.load()
+pytestmark = pytest.mark.skipif(m is None, reason="native toolchain unavailable")
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **k):
+        asyncio.run(fn(*a, **k))
+
+    return wrapper
+
+
+def random_req(rng, i):
+    r = pb.RateLimitReq(
+        name=rng.choice(["svc", "üñïçødé-svc", "a" * 40, "x"]),
+        unique_key=f"key-{i}-{rng.randrange(1000)}",
+        hits=rng.choice([0, 1, 5, -3, 1 << 40]),
+        limit=rng.choice([0, 10, 1 << 31, -7]),
+        duration=rng.choice([1000, 60_000, 3]),  # 3 = a Gregorian enum value
+        algorithm=rng.choice([0, 1]),
+        behavior=rng.choice([0, 1, 2, 8, 32, 34]),
+        burst=rng.choice([0, 5]),
+    )
+    if rng.random() < 0.5:
+        r.created_at = rng.randrange(1, 1 << 45)
+    if rng.random() < 0.3:
+        r.metadata["traceparent"] = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        r.metadata["other"] = "värde"
+    return r
+
+
+def test_parse_matches_pb_path():
+    from gubernator_tpu.hashing import fingerprint
+    from gubernator_tpu.peers.hash_ring import fnv1a_32
+    from gubernator_tpu.service.wire import columns_from_pb, columns_from_wire
+
+    rng = random.Random(7)
+    items = [random_req(rng, i) for i in range(200)]
+    items.append(pb.RateLimitReq(name="no-key"))  # ERR_EMPTY_KEY
+    items.append(pb.RateLimitReq(unique_key="no-name"))  # ERR_EMPTY_NAME
+    data = pb.GetRateLimitsReq(requests=items).SerializeToString()
+
+    got = columns_from_wire(data)
+    assert got is not None
+    cols, ring, spans, traceparent = got
+    # at least one random item carried the traceparent metadata
+    assert traceparent == "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    ref_cols, hash_keys = columns_from_pb(items)
+
+    for field in ("fp", "algo", "behavior", "hits", "burst", "created_at", "err"):
+        np.testing.assert_array_equal(
+            getattr(cols, field), getattr(ref_cols, field), err_msg=field
+        )
+    # limit/duration are clipped by columns_from_pb only beyond ±2^62 —
+    # unclipped here, so compare raw
+    np.testing.assert_array_equal(cols.limit, [it.limit for it in items])
+    np.testing.assert_array_equal(cols.duration, [it.duration for it in items])
+    # ring points match the python ring hash of the hash key
+    for i, hk in enumerate(hash_keys):
+        if hk:
+            assert int(ring[i]) == fnv1a_32(hk.encode()), hk
+    # spans re-materialize the exact item
+    from gubernator_tpu.service.wire import item_from_span
+
+    for i in (0, 57, 199):
+        assert item_from_span(data, spans[i]) == items[i]
+
+
+def test_encode_matches_pb():
+    from gubernator_tpu.service.wire import encode_response_columns
+
+    n = 50
+    rng = np.random.default_rng(3)
+    status = rng.integers(0, 2, n).astype(np.int64)
+    limit = rng.integers(0, 1 << 40, n)
+    remaining = rng.integers(0, 1 << 40, n)
+    reset = rng.integers(0, 1 << 45, n)
+    errors = {0: "boom", 17: "fält-fel: üñï"}
+    data = encode_response_columns(status, limit, remaining, reset, errors)
+    resp = pb.GetRateLimitsResp.FromString(data)
+    assert len(resp.responses) == n
+    for i, r in enumerate(resp.responses):
+        assert r.status == status[i]
+        assert r.limit == limit[i]
+        assert r.remaining == remaining[i]
+        assert r.reset_time == reset[i]
+        assert r.error == errors.get(i, "")
+
+
+def test_malformed_wire_raises():
+    with pytest.raises(ValueError):
+        m.parse_get_rate_limits(b"\x0a\xff\xff\xff\xff\xff")  # truncated len
+
+
+@async_test
+async def test_raw_path_serves_cluster_traffic():
+    """The raw gRPC path end-to-end on a 3-daemon cluster: local, forwarded,
+    and GLOBAL items all answered from the native ingress."""
+    from gubernator_tpu.client import V1Client
+    from gubernator_tpu.types import Behavior
+
+    from tests.cluster import Cluster, wait_for
+
+    c = await Cluster.start(3)
+    try:
+        non_owner = c.non_owning_daemons("nat", "k1")[0]
+        owner = c.find_owning_daemon("nat", "k1")
+        client = V1Client(non_owner.conf.grpc_address)
+        try:
+            resp = await client.get_rate_limits(
+                [
+                    dict(name="nat", unique_key="k1", hits=2, limit=10, duration=60_000),
+                    dict(name="nat", unique_key="k2", hits=1, limit=10, duration=60_000),
+                    dict(name="", unique_key="bad", hits=1, limit=1, duration=1000),
+                    dict(
+                        name="nat", unique_key="g1", hits=3, limit=10,
+                        duration=60_000, behavior=int(Behavior.GLOBAL),
+                    ),
+                ]
+            )
+            r = resp.responses
+            assert r[0].error == "" and r[0].remaining == 8
+            assert r[1].error == "" and r[1].remaining == 9
+            assert "namespace" in r[2].error
+            assert r[3].error == "" and r[3].remaining == 7
+
+            # the GLOBAL hit reaches the owner asynchronously
+            async def owner_saw_hits():
+                ro = await owner.get_rate_limits(
+                    [pb.RateLimitReq(name="nat", unique_key="g1", hits=0,
+                                     limit=10, duration=60_000)]
+                )
+                return ro[0].remaining == 7
+
+            await wait_for(owner_saw_hits, timeout_s=15)
+        finally:
+            await client.close()
+    finally:
+        await c.stop()
+
+
+@async_test
+async def test_raw_path_force_global():
+    """GUBER_FORCE_GLOBAL on the native raw path: requests flip to GLOBAL,
+    serve locally, and the owner broadcast still fires (the forced bit must
+    survive lazy pb materialization)."""
+    from gubernator_tpu.client import V1Client
+
+    from tests.cluster import Cluster, daemon_config, metric_value, scrape, wait_for
+
+    from gubernator_tpu.config import BehaviorConfig
+
+    behaviors = BehaviorConfig(
+        batch_wait_ms=1.0, global_sync_wait_ms=50.0,
+        batch_timeout_ms=5000.0, global_timeout_ms=5000.0, force_global=True,
+    )
+    c = await Cluster.start(2, behaviors=behaviors)
+    try:
+        owner = c.find_owning_daemon("fg", "k1")
+        client = V1Client(owner.conf.grpc_address)
+        try:
+            resp = await client.get_rate_limits(
+                [dict(name="fg", unique_key="k1", hits=2, limit=10, duration=60_000)]
+            )
+            assert resp.responses[0].error == ""
+            assert resp.responses[0].remaining == 8
+        finally:
+            await client.close()
+
+        # forced-GLOBAL owner hits must broadcast to the peer
+        async def broadcasted():
+            s = await scrape(owner)
+            return metric_value(
+                s, "gubernator_broadcast_counter_total", condition="broadcast"
+            )
+
+        await wait_for(broadcasted, timeout_s=15)
+        other = c.non_owning_daemons("fg", "k1")[0]
+
+        async def installed():
+            s = await scrape(other)
+            return metric_value(
+                s, "gubernator_update_peer_globals_installed_total"
+            )
+
+        await wait_for(installed, timeout_s=15)
+    finally:
+        await c.stop()
