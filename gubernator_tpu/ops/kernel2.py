@@ -121,7 +121,11 @@ def sweep_geometry(n_buckets: int, batch: int) -> Tuple[int, int]:
         while p < u:
             p *= 2
         u = min(p, batch)
-        if blk * u <= (1 << 21) or blk <= 256:
+        # VMEM stack bound: the two-half kernel holds ~6 (blk,128) i32
+        # temps + 2 (blk,u) onehots; blk*u ≤ 2^19 keeps the scoped
+        # allocation under the 16 MiB limit (measured: u=512 × blk=2048
+        # overflows at 21.4 MiB)
+        if blk * u <= (1 << 19) or blk <= 256:
             return blk, u
         blk //= 2
 
@@ -156,7 +160,6 @@ def _probe_claim2(
     slots = rows.reshape(B, K, F)
     s_fp_lo = slots[:, :, FP_LO]
     s_fp_hi = slots[:, :, FP_HI]
-    s_exp = _join64(slots[:, :, EXP_LO], slots[:, :, EXP_HI])  # (B, K)
 
     empty = (s_fp_lo == 0) & (s_fp_hi == 0)
     match = (s_fp_lo == my_lo[:, None]) & (s_fp_hi == my_hi[:, None]) & ~empty
@@ -165,8 +168,17 @@ def _probe_claim2(
     own_j = jnp.argmax(match, axis=1).astype(i32)
 
     # exact lazy expiry (reference lrucache.go:111-128): expired slots are
-    # reclaimable by any key probing the bucket
-    dead = ~empty & (s_exp < now[:, None])
+    # reclaimable by any key probing the bucket. Compared in the split
+    # (hi, lo-as-unsigned) domain — int64 on TPU is emulated, and this is
+    # the kernel's only (B, K)-shaped 64-bit computation
+    exp_lo = slots[:, :, EXP_LO]
+    exp_hi = slots[:, :, EXP_HI]
+    now_hi = _hi32(now)
+    now_lo_b = _biased(_lo32(now))
+    dead = ~empty & (
+        (exp_hi < now_hi[:, None])
+        | ((exp_hi == now_hi[:, None]) & (_biased(exp_lo) < now_lo_b[:, None]))
+    )
     vacant = empty | dead
     live = ~vacant
 
