@@ -126,6 +126,10 @@ def sweep_geometry(n_buckets: int, batch: int) -> Tuple[int, int]:
         # allocation under the 16 MiB limit (measured: u=512 × blk=2048
         # overflows at 21.4 MiB)
         if blk * u <= (1 << 19) or blk <= 256:
+            # the blk floor must not re-open the VMEM bound (a small table
+            # under a huge batch otherwise walks to blk=256, u=batch): cap u
+            # hard — window-overflow rows just drop to the engine's retry
+            u = min(u, max(64, (1 << 19) // blk))
             return blk, u
         blk //= 2
 

@@ -193,3 +193,19 @@ def test_v2_live_count_and_expiry():
     )
     assert out[0].remaining == 4
     assert eng.stats.cache_hits == 0
+
+
+def test_sweep_geometry_respects_vmem_bound():
+    # a small table under a huge batch must not escape the VMEM cap through
+    # the blk floor (the two-half kernel's scoped stack overflows past
+    # blk*u = 2^19); u stays a power of two dividing the (pow2) batch
+    from gubernator_tpu.ops.kernel2 import sweep_geometry
+
+    for nb, batch in [(2048, 131072), (256, 1 << 20), (2048, 256),
+                      (1 << 21, 131072), (1 << 21, 1 << 19)]:
+        blk, u = sweep_geometry(nb, batch)
+        assert blk * u <= 1 << 19, (nb, batch, blk, u)
+        assert u & (u - 1) == 0 and u >= 64
+        assert nb % blk == 0
+        if batch >= u:
+            assert batch % u == 0
