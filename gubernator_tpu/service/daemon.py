@@ -68,6 +68,9 @@ def _hashkey_fp(key: str) -> int:
 class Daemon:
     """One serving process. Use `await Daemon.spawn(conf)`."""
 
+    cert_watch_interval_s = 30.0  # PEM rotation poll cadence (class-level
+    # so tests can speed it up before spawn)
+
     def __init__(
         self,
         conf: DaemonConfig,
@@ -128,6 +131,7 @@ class Daemon:
         self.grpc_port: Optional[int] = None
         self.http_port: Optional[int] = None
         self._client_creds = None  # set by TLS setup
+        self._cert_watch_task = None
 
     # ---------------------------------------------------------------- spawn
     @classmethod
@@ -148,6 +152,14 @@ class Daemon:
         await start_servers(d)
         d.global_manager.start()
         d.region_manager.start()
+        if d._client_creds is not None and conf.tls_cert_file:
+            # rotation watcher: the gRPC server hot-reloads per handshake,
+            # but peer-forwarding CLIENTS hold credentials from startup — on
+            # a cert rotation they must re-dial with the new pair or
+            # verify-mode clusters break both directions until restart
+            d._cert_watch_task = asyncio.create_task(
+                d._cert_watch_loop(), name="cert-watch"
+            )
         await d._start_discovery()
         if conf.cache_max_size > conf.cache_size:
             if getattr(d.engine, "supports_grow", False):
@@ -182,6 +194,36 @@ class Daemon:
                 raise
             except Exception:  # pragma: no cover - defensive
                 log.exception("table maintenance tick failed")
+
+    async def _cert_watch_loop(self) -> None:
+        """Rebuild peer-client credentials + channels when the PEM files
+        rotate (complements the server side's per-handshake hot reload)."""
+        from gubernator_tpu.service.tls import cert_files_mtimes, client_credentials
+
+        last = cert_files_mtimes(self.conf)
+        while not self._shutting_down:
+            await asyncio.sleep(self.cert_watch_interval_s)
+            try:
+                now_mt = cert_files_mtimes(self.conf)
+                if now_mt is None or now_mt == last:
+                    continue
+                last = now_mt
+                self._client_creds = client_credentials(self.conf)
+                # force-recreate every peer channel with the new credentials;
+                # set_peers reuses clients by address, so drop them first and
+                # drain the old ones
+                old = self._peer_clients
+                self._peer_clients = {}
+                peers = self.local_peers() + self.region_peers()
+                self.set_peers([PeerInfo(**vars(p)) for p in peers])
+                await asyncio.gather(
+                    *(c.shutdown() for c in old.values()), return_exceptions=True
+                )
+                log.info("TLS certificates rotated; peer channels re-dialed")
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # pragma: no cover - defensive
+                log.exception("certificate rotation check failed")
 
     async def warm_up(self) -> None:
         """Compile the decision + install kernels for the smallest batch shape
@@ -788,6 +830,12 @@ class Daemon:
         if self._shutting_down:
             return
         self._shutting_down = True
+        if self._cert_watch_task is not None:
+            self._cert_watch_task.cancel()
+            try:
+                await self._cert_watch_task
+            except asyncio.CancelledError:
+                pass
         if self._maintenance_task is not None:
             self._maintenance_task.cancel()
             try:

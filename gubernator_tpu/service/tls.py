@@ -105,13 +105,82 @@ def bundle_from_config(conf) -> CertBundle:
 
 
 def server_credentials(conf) -> grpc.ServerCredentials:
-    b = bundle_from_config(conf)
     require = conf.tls_client_auth in ("require", "verify")
+    if conf.tls_cert_file and conf.tls_key_file:
+        # hot certificate reload (the keypairReloader analog, reference
+        # tls.go:295-362): the per-handshake fetcher re-reads the PEM files
+        # when their mtimes change, so rotated certs take effect without a
+        # restart; a pair that fails validation (mid-rotation torn write,
+        # mismatched key) keeps the last good pair serving, like the Go
+        # reloader's LoadX509KeyPair guard
+        state = {"mtimes": None, "config": None}
+
+        def _maybe_load():
+            """New ServerCertificateConfiguration when the files changed and
+            validate, else None (the gRPC fetcher no-change contract)."""
+            import os
+
+            paths = [conf.tls_cert_file, conf.tls_key_file] + (
+                [conf.tls_ca_file] if conf.tls_ca_file else []
+            )
+            mtimes = tuple(os.path.getmtime(p) for p in paths)
+            if state["config"] is not None and mtimes == state["mtimes"]:
+                return None
+            b = bundle_from_config(conf)
+            _validate_keypair(b)  # raises on torn/mismatched rotation
+            state["config"] = grpc.ssl_server_certificate_configuration(
+                [(b.key_pem, b.cert_pem)],
+                root_certificates=b.ca_pem if require else None,
+            )
+            state["mtimes"] = mtimes
+            return state["config"]
+
+        initial = _maybe_load()
+
+        def fetcher():
+            try:
+                return _maybe_load()
+            except Exception:
+                return None  # keep serving the last good pair
+
+        return grpc.dynamic_ssl_server_credentials(
+            initial, fetcher, require_client_authentication=require
+        )
+    b = bundle_from_config(conf)
     return grpc.ssl_server_credentials(
         [(b.key_pem, b.cert_pem)],
         root_certificates=b.ca_pem if require else None,
         require_client_auth=require,
     )
+
+
+def _validate_keypair(b: CertBundle) -> None:
+    """Reject torn/mismatched cert+key pairs before they reach handshakes."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    with tempfile.NamedTemporaryFile(suffix=".pem") as cf, tempfile.NamedTemporaryFile(
+        suffix=".pem"
+    ) as kf:
+        cf.write(b.cert_pem)
+        cf.flush()
+        kf.write(b.key_pem)
+        kf.flush()
+        ctx.load_cert_chain(cf.name, kf.name)  # raises ssl.SSLError on mismatch
+
+
+def cert_files_mtimes(conf):
+    """Snapshot of the configured PEM files' mtimes (None when not
+    file-based) — the daemon's rotation watcher keys on this."""
+    import os
+
+    if not (conf.tls_cert_file and conf.tls_key_file):
+        return None
+    paths = [conf.tls_cert_file, conf.tls_key_file] + (
+        [conf.tls_ca_file] if conf.tls_ca_file else []
+    )
+    try:
+        return tuple(os.path.getmtime(p) for p in paths)
+    except OSError:
+        return None
 
 
 def client_credentials(conf) -> grpc.ChannelCredentials:
