@@ -165,6 +165,10 @@ class DaemonConfig:
     # clamped and counted (gubernator_created_at_clamped_count)
     created_at_tolerance_ms: float = 5 * 60 * 1000.0
 
+    # delay before graceful termination starts, giving load balancers time
+    # to de-register (reference config.go:215-217, daemon.go:389-391)
+    graceful_termination_delay_s: float = 0.0
+
     log_level: str = "info"
     metric_flags: str = ""
 
@@ -290,6 +294,10 @@ def setup_daemon_config(
         created_at_tolerance_ms=_get_float_ms(
             env, "GUBER_CREATED_AT_TOLERANCE", 5 * 60 * 1000.0
         ),
+        graceful_termination_delay_s=_get_float_ms(
+            env, "GUBER_GRACEFUL_TERMINATION_DELAY", 0.0
+        )
+        / 1e3,
         log_level=_get(env, "GUBER_LOG_LEVEL", "info"),
         metric_flags=_get(env, "GUBER_METRIC_FLAGS", ""),
     )

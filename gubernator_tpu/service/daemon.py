@@ -198,7 +198,12 @@ class Daemon:
     async def _cert_watch_loop(self) -> None:
         """Rebuild peer-client credentials + channels when the PEM files
         rotate (complements the server side's per-handshake hot reload)."""
-        from gubernator_tpu.service.tls import cert_files_mtimes, client_credentials
+        from gubernator_tpu.service.tls import (
+            _validate_keypair,
+            bundle_from_config,
+            cert_files_mtimes,
+            client_credentials,
+        )
 
         last = cert_files_mtimes(self.conf)
         while not self._shutting_down:
@@ -206,6 +211,17 @@ class Daemon:
             try:
                 now_mt = cert_files_mtimes(self.conf)
                 if now_mt is None or now_mt == last:
+                    continue
+                # a torn rotation (cert written, key not yet) must neither
+                # commit `last` (so the next tick retries) nor tear down
+                # working channels — same guard as the server-side reloader
+                try:
+                    _validate_keypair(bundle_from_config(self.conf))
+                except Exception:
+                    log.warning(
+                        "rotated TLS files failed validation; keeping the "
+                        "current peer credentials until the next check"
+                    )
                     continue
                 last = now_mt
                 self._client_creds = client_credentials(self.conf)
@@ -829,7 +845,11 @@ class Daemon:
         drain batches + global queues, checkpoint, stop listeners."""
         if self._shutting_down:
             return
-        self._shutting_down = True
+        self._shutting_down = True  # live_check now fails → LBs de-register
+        if self.conf.graceful_termination_delay_s > 0:
+            # keep serving while load balancers notice the failing liveness
+            # probe (reference daemon.go:389-391)
+            await asyncio.sleep(self.conf.graceful_termination_delay_s)
         if self._cert_watch_task is not None:
             self._cert_watch_task.cancel()
             try:

@@ -118,12 +118,11 @@ def server_credentials(conf) -> grpc.ServerCredentials:
         def _maybe_load():
             """New ServerCertificateConfiguration when the files changed and
             validate, else None (the gRPC fetcher no-change contract)."""
-            import os
-
-            paths = [conf.tls_cert_file, conf.tls_key_file] + (
-                [conf.tls_ca_file] if conf.tls_ca_file else []
-            )
-            mtimes = tuple(os.path.getmtime(p) for p in paths)
+            mtimes = cert_files_mtimes(conf)
+            if mtimes is None:
+                # unreadable files: loud at startup (initial load), treated
+                # as no-change by the fetcher's guard afterwards
+                raise FileNotFoundError("TLS cert/key files unreadable")
             if state["config"] is not None and mtimes == state["mtimes"]:
                 return None
             b = bundle_from_config(conf)

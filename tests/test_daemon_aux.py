@@ -425,3 +425,31 @@ async def test_mtls_rotation_rewires_peer_channels(tmp_path, monkeypatch):
     finally:
         for d in daemons:
             await d.close()
+
+
+@async_test
+async def test_graceful_termination_delay_keeps_serving():
+    """GUBER_GRACEFUL_TERMINATION_DELAY: liveness fails immediately on close
+    while requests still serve during the delay window (reference
+    daemon.go:389-391 LB de-registration)."""
+    from gubernator_tpu.service.daemon import Daemon
+
+    conf = daemon_config()
+    conf.graceful_termination_delay_s = 0.6
+    d = await Daemon.spawn(conf)
+    client = V1Client(d.conf.grpc_address, timeout_s=15.0)
+    try:
+        await client.get_rate_limits([req("gt")])
+        t0 = asyncio.get_running_loop().time()
+        closer = asyncio.create_task(d.close())
+        await asyncio.sleep(0.1)
+        # liveness already failing (LBs de-register)...
+        with pytest.raises(RuntimeError):
+            d.live_check()
+        # ...but traffic still serves inside the delay window
+        r = await client.get_rate_limits([req("gt")])
+        assert r.responses[0].error == ""
+        await closer
+        assert asyncio.get_running_loop().time() - t0 >= 0.6
+    finally:
+        await client.close()
