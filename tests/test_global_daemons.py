@@ -74,19 +74,22 @@ async def test_global_hits_converge_via_owner_broadcast():
         for d in non_owners:
             await wait_for(lambda d=d: updates_installed(d), timeout_s=15)
 
+        # EXACT counter accounting, scraped over the wire — asserted BEFORE
+        # the convergence reads below: a zero-hit GLOBAL read at the owner
+        # queues ANOTHER broadcast (owner-path QueueUpdate fires for every
+        # GLOBAL request, reference gubernator.go:670-672), which would bump
+        # these counters on the next sync tick
+        assert await broadcast_count(owner) == 2.0  # one per non-owner peer
+        for d in non_owners:
+            assert await broadcast_count(d) == 0.0
+            assert await updates_installed(d) == 1.0
+
         # all daemons now agree (each answers locally with hits=0)
         for d in c.daemons:
             resp = await clients[d.conf.advertise_address].get_rate_limits(
                 [greq("gk1", hits=0)]
             )
             assert resp.responses[0].remaining == 95, d.conf.advertise_address
-
-        # EXACT counter accounting, scraped over the wire:
-        # the owner broadcast to 2 peers (not itself)
-        assert await broadcast_count(owner) == 2.0
-        for d in non_owners:
-            assert await broadcast_count(d) == 0.0
-            assert await updates_installed(d) == 1.0
     finally:
         for cl in clients.values():
             await cl.close()

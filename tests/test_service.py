@@ -254,3 +254,68 @@ async def test_cluster_scrape_request_counters():
     finally:
         await client.close()
         await c.stop()
+
+
+@async_test
+async def test_peer_client_shutdown_races_inflight_requests():
+    """In-flight forwarded requests race Shutdown: each either completes or
+    fails with a peer error — never hangs, never loses its future (reference
+    TestPeerClientShutdown, peer_client_test.go:33)."""
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.service.daemon import Daemon
+    from gubernator_tpu.service.peer_client import PeerClient, PeerError
+    from gubernator_tpu.types import PeerInfo
+
+    d = await Daemon.spawn(daemon_config())
+    try:
+        client = PeerClient(
+            PeerInfo(grpc_address=d.conf.grpc_address),
+            batch_wait_ms=5.0,  # wide window so shutdown races the flush
+            batch_timeout_ms=5000.0,
+        )
+
+        async def one(i):
+            try:
+                r = await client.get_peer_rate_limit(
+                    pb.RateLimitReq(
+                        name="shut", unique_key=f"k{i}", hits=1, limit=100,
+                        duration=60_000,
+                    )
+                )
+                return ("ok", r.remaining)
+            except PeerError:
+                return ("err", None)
+
+        tasks = [asyncio.create_task(one(i)) for i in range(50)]
+        await asyncio.sleep(0.001)
+        await client.shutdown()
+        results = await asyncio.wait_for(asyncio.gather(*tasks), timeout=30)
+        assert len(results) == 50
+        oks = [r for r in results if r[0] == "ok"]
+        # the pre-shutdown flush drains queued requests; everything resolved
+        assert all(r[1] == 99 for r in oks)
+    finally:
+        await d.close()
+
+
+@async_test
+async def test_daemon_close_leaves_no_running_tasks():
+    """Graceful close cancels every loop the daemon started (the goleak
+    analog, reference lrucache_test.go via go.uber.org/goleak)."""
+    from gubernator_tpu.service.daemon import Daemon
+
+    before = {id(t) for t in asyncio.all_tasks()}
+    d = await Daemon.spawn(daemon_config())
+    client = V1Client(d.conf.grpc_address)
+    try:
+        await client.get_rate_limits([req("leak")])
+    finally:
+        await client.close()
+        await d.close()
+    await asyncio.sleep(0.1)
+    leaked = [
+        t for t in asyncio.all_tasks()
+        if id(t) not in before and not t.done()
+        and t is not asyncio.current_task()
+    ]
+    assert not leaked, [t.get_name() for t in leaked]
