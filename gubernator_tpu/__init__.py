@@ -34,6 +34,28 @@ if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
     if jax.config.jax_platforms != "cpu":
         jax.config.update("jax_platforms", "cpu")
 
+# Persistent kernel-compile cache: the decision kernel compiles per batch
+# shape (~15-40 s each on TPU); caching across process restarts turns daemon
+# boots and bench reruns into cache hits (measured 19.6 s → 7.5 s boot).
+# Explicit settings win — env var OR a programmatic jax.config choice.
+if (
+    not os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    and not jax.config.jax_compilation_cache_dir
+):
+    _home = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    if not _home.startswith("~"):  # no HOME + no passwd entry: skip the cache
+        _cache = os.path.join(_home, "gubernator_tpu_jit")
+        try:
+            os.makedirs(_cache, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", _cache)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except OSError:  # read-only cache home: run without the cache
+            pass
+        del _cache
+    del _home
+
 from gubernator_tpu.types import (  # noqa: E402
     Algorithm,
     Behavior,
