@@ -77,9 +77,13 @@ class Daemon:
         engine: Optional[LocalEngine] = None,
         event_channel: Optional[asyncio.Queue] = None,
         store=None,
+        loader=None,
     ):
         conf.validate()
         self.conf = conf
+        # optional Loader hook (startup restore / shutdown save); None falls
+        # back to GUBER_CHECKPOINT_PATH file snapshots
+        self.loader = loader
         # optional audit hook: HitEvent per owner-side hit (reference
         # config.go:128-135); non-blocking — events drop when the consumer
         # lags rather than stalling the serving path
@@ -141,10 +145,14 @@ class Daemon:
         engine: Optional[LocalEngine] = None,
         event_channel: Optional[asyncio.Queue] = None,
         store=None,
+        loader=None,
     ):
         """SpawnDaemon analog (reference daemon.go:75-88): build, restore
         checkpoint, start listeners + loops + discovery."""
-        d = cls(conf, engine=engine, event_channel=event_channel, store=store)
+        d = cls(
+            conf, engine=engine, event_channel=event_channel, store=store,
+            loader=loader,
+        )
         d.maybe_restore()
         await d.warm_up()
         from gubernator_tpu.service.server import start_servers
@@ -821,23 +829,31 @@ class Daemon:
         return pb.LiveCheckResp()
 
     # ------------------------------------------------------------ checkpoint
+    def _loader(self):
+        """The active Loader: an injected one, else a FileLoader over
+        GUBER_CHECKPOINT_PATH, else None (reference wires Loader the same
+        way — an embedding hook the server binary points at a file,
+        store.go:49-60)."""
+        if self.loader is not None:
+            return self.loader
+        if self.conf.checkpoint_path:
+            from gubernator_tpu.store import FileLoader
+
+            return FileLoader(self.conf.checkpoint_path)
+        return None
+
     def maybe_restore(self) -> None:
-        if not self.conf.checkpoint_path:
+        loader = self._loader()
+        if loader is None:
             return
-        import os
-
-        if os.path.exists(self.conf.checkpoint_path):
-            from gubernator_tpu.store import load_snapshot
-
-            rows = load_snapshot(self.conf.checkpoint_path)
+        rows = loader.load()
+        if rows is not None:
             self.engine.restore(rows)
 
     def maybe_checkpoint(self) -> None:
-        if not self.conf.checkpoint_path:
-            return
-        from gubernator_tpu.store import save_snapshot
-
-        save_snapshot(self.conf.checkpoint_path, self.runner.snapshot_sync())
+        loader = self._loader()
+        if loader is not None:
+            loader.save(self.runner.snapshot_sync())
 
     # ---------------------------------------------------------------- close
     async def close(self) -> None:

@@ -97,3 +97,37 @@ class FileLoader(Loader):
 
     def save(self, rows: np.ndarray) -> None:
         save_snapshot(self.path, rows)
+
+
+class MemoryLoader(Loader):
+    """In-memory Loader for tests/embedders (the MockLoader analog, reference
+    store.go:80-109): `save()` keeps the snapshot on the instance; a new
+    daemon restoring from it continues the old counts."""
+
+    def __init__(self, rows: Optional[np.ndarray] = None):
+        self.rows = rows
+        self.load_called = 0
+        self.save_called = 0
+
+    def load(self) -> Optional[np.ndarray]:
+        self.load_called += 1
+        return self.rows
+
+    def save(self, rows: np.ndarray) -> None:
+        self.save_called += 1
+        self.rows = rows
+
+
+class RecordingStore(Store):
+    """Write-through Store that records every ChangeSet (the MockStore
+    analog, reference store.go:111-150)."""
+
+    def __init__(self):
+        self.changes: list = []
+
+    def on_change(self, change: ChangeSet) -> None:
+        self.changes.append(change)
+
+    @property
+    def touched_fps(self) -> set:
+        return {int(fp) for c in self.changes for fp in c.fps}

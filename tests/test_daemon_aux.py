@@ -453,3 +453,53 @@ async def test_graceful_termination_delay_keeps_serving():
         assert asyncio.get_running_loop().time() - t0 >= 0.6
     finally:
         await client.close()
+
+
+@async_test
+async def test_memory_loader_and_recording_store_hooks():
+    """Custom Loader/Store hooks through the daemon lifecycle — the
+    reference's embedding pattern (TestLoader/TestStore, store_test.go:76,127
+    over in-tree MockLoader/MockStore)."""
+    from gubernator_tpu.hashing import fingerprint
+    from gubernator_tpu.service.daemon import Daemon
+    from gubernator_tpu.store import MemoryLoader, RecordingStore
+
+    loader = MemoryLoader()
+    store = RecordingStore()
+    d = await Daemon.spawn(daemon_config(), store=store, loader=loader)
+    client = V1Client(d.conf.grpc_address)
+    try:
+        await client.get_rate_limits(
+            [dict(name="ld", unique_key="k1", hits=3, limit=9, duration=60_000)]
+        )
+    finally:
+        await client.close()
+        await d.close()
+    assert loader.load_called == 1
+    assert loader.save_called == 1  # shutdown snapshot landed in memory
+    assert fingerprint("ld", "k1") in store.touched_fps
+
+    # a fresh daemon restoring from the SAME loader continues the counts
+    d2 = await Daemon.spawn(daemon_config(), loader=loader)
+    client = V1Client(d2.conf.grpc_address)
+    try:
+        r = await client.get_rate_limits(
+            [dict(name="ld", unique_key="k1", hits=0, limit=9, duration=60_000)]
+        )
+        assert r.responses[0].remaining == 6  # 9 - 3 survived via MemoryLoader
+    finally:
+        await client.close()
+        await d2.close()
+
+
+def test_example_conf_parses_and_validates():
+    """example.conf documents every knob; loading it must parse cleanly and
+    produce a valid config (all entries are commented defaults, and any
+    uncommented sample must round-trip)."""
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", "example.conf")
+    env = {}
+    load_config_file(path, env)
+    conf = setup_daemon_config(env=env)
+    conf.validate()
