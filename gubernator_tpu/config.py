@@ -95,6 +95,12 @@ class BehaviorConfig:
     batch_timeout_ms: float = 500.0  # forwarding RPC timeout (BatchTimeout 500ms)
     batch_wait_ms: float = 0.5  # coalescing window (BatchWait 500µs)
     batch_limit: int = 1000  # max items per forwarded batch (BatchLimit)
+    # per-DEVICE-dispatch row cap for the front-door batcher: oversized
+    # flushes split into whole sub-batches (one oversized enqueue dispatches
+    # alone). Bigger caps amortize kernel fixed costs, smaller caps bound
+    # per-dispatch latency; no reference analog (device batches replace the
+    # worker channels)
+    coalesce_limit: int = 16384
 
     global_timeout_ms: float = 500.0  # GLOBAL rpc timeout (GlobalTimeout)
     global_sync_wait_ms: float = 100.0  # hit-sync cadence (GlobalSyncWait)
@@ -225,6 +231,8 @@ class DaemonConfig:
         if self.behaviors.batch_limit <= 0 or self.behaviors.batch_limit > 1000:
             # the reference hard-caps batches at 1000 (gubernator.go:41-42)
             raise ConfigError("GUBER_BATCH_LIMIT must be in (0, 1000]")
+        if self.behaviors.coalesce_limit <= 0:
+            raise ConfigError("GUBER_BATCH_COALESCE_LIMIT must be positive")
         if self.tls_client_auth not in ("", "require", "verify"):
             raise ConfigError("GUBER_TLS_CLIENT_AUTH must be require or verify")
         if self.created_at_tolerance_ms <= 0:
@@ -255,6 +263,7 @@ def setup_daemon_config(
             batch_timeout_ms=_get_float_ms(env, "GUBER_BATCH_TIMEOUT", 500.0),
             batch_wait_ms=_get_float_ms(env, "GUBER_BATCH_WAIT", 0.5),
             batch_limit=_get_int(env, "GUBER_BATCH_LIMIT", 1000),
+            coalesce_limit=_get_int(env, "GUBER_BATCH_COALESCE_LIMIT", 16384),
             global_timeout_ms=_get_float_ms(env, "GUBER_GLOBAL_TIMEOUT", 500.0),
             global_sync_wait_ms=_get_float_ms(env, "GUBER_GLOBAL_SYNC_WAIT", 100.0),
             global_batch_limit=_get_int(env, "GUBER_GLOBAL_BATCH_LIMIT", 1000),

@@ -94,13 +94,24 @@ class Batcher:
             await self._flush()
 
     async def _flush(self) -> None:
-        batch = self._pending
+        pending = self._pending
         self._pending = []
         self._pending_rows = 0
         if self.metrics is not None:
             self.metrics.queue_length.set(0)
-        if not batch:
-            return
+        # the coalesce limit is a real per-dispatch cap: flush in chunks of
+        # whole enqueued batches (a single oversized enqueue dispatches
+        # alone), bounding dispatch latency and compile-shape spread
+        while pending:
+            chunk = [pending.pop(0)]
+            rows = chunk[0][0].fp.shape[0]
+            while pending and rows + pending[0][0].fp.shape[0] <= self.coalesce_limit:
+                cols, fut = pending.pop(0)
+                chunk.append((cols, fut))
+                rows += cols.fp.shape[0]
+            await self._dispatch(chunk)
+
+    async def _dispatch(self, batch) -> None:
         t0 = time.perf_counter()
         cat = concat_columns([c for c, _ in batch])
         try:

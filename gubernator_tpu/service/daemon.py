@@ -119,6 +119,7 @@ class Daemon:
         self.batcher = Batcher(
             self.runner,
             batch_wait_ms=conf.behaviors.batch_wait_ms,
+            coalesce_limit=conf.behaviors.coalesce_limit,
             metrics=self.metrics,
         )
         self.global_manager = GlobalManager(self)

@@ -503,3 +503,61 @@ def test_example_conf_parses_and_validates():
     load_config_file(path, env)
     conf = setup_daemon_config(env=env)
     conf.validate()
+
+
+def test_coalesce_limit_env_reaches_the_batcher():
+    from gubernator_tpu.service.daemon import Daemon
+
+    conf = setup_daemon_config(
+        env={
+            "GUBER_GRPC_ADDRESS": "127.0.0.1:0",
+            "GUBER_HTTP_ADDRESS": "",
+            "GUBER_BATCH_COALESCE_LIMIT": "4096",
+            "GUBER_CACHE_SIZE": "4096",
+        }
+    )
+    d = Daemon(conf)  # batcher wiring happens in __init__, no spawn needed
+    assert d.batcher.coalesce_limit == 4096
+    d.runner.close()
+    with pytest.raises(ConfigError):
+        setup_daemon_config(env={"GUBER_BATCH_COALESCE_LIMIT": "0"})
+
+
+@async_test
+async def test_coalesce_limit_caps_dispatch_size():
+    """The limit is a real per-dispatch cap: concurrent enqueues exceeding it
+    split into multiple kernel dispatches of whole sub-batches."""
+    from gubernator_tpu.ops.batch import columns_from_requests
+    from gubernator_tpu.ops.engine import LocalEngine
+    from gubernator_tpu.service.batcher import Batcher
+    from gubernator_tpu.service.runner import EngineRunner
+
+    engine = LocalEngine(capacity=4096)
+    runner = EngineRunner(engine)
+    sizes = []
+    orig = runner.check_columns
+
+    async def spy(cols, now_ms=None):
+        sizes.append(cols.fp.shape[0])
+        return await orig(cols, now_ms=now_ms)
+
+    runner.check_columns = spy
+    b = Batcher(runner, batch_wait_ms=5.0, coalesce_limit=32)
+    reqs = lambda tag, n: columns_from_requests(
+        [
+            RateLimitRequest(
+                name="cl", unique_key=f"{tag}-{i}", hits=1, limit=100,
+                duration=60_000,
+            )
+            for i in range(n)
+        ]
+    )
+    outs = await asyncio.gather(
+        b.check(reqs("a", 20)), b.check(reqs("b", 20)), b.check(reqs("c", 20))
+    )
+    assert [o.status.shape[0] for o in outs] == [20, 20, 20]
+    assert all(o.err.max() == 0 for o in outs)
+    assert max(sizes) <= 32  # whole sub-batches, never past the cap
+    assert len(sizes) >= 2  # really split
+    await b.drain()
+    runner.close()
