@@ -40,9 +40,20 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from gubernator_tpu.ops.batch import HostBatch, InstallBatch, ReqBatch, pack_requests, pad_batch
+from gubernator_tpu.ops.batch import (
+    ERR_DROPPED,
+    HostBatch,
+    InstallBatch,
+    ReqBatch,
+    RequestColumns,
+    ResponseColumns,
+    pack_columns,
+    pack_requests,
+    pad_batch,
+)
 from gubernator_tpu.ops.kernel2 import decide2_impl, install2_impl
 from gubernator_tpu.ops.plan import plan_passes, _subset
+from gubernator_tpu.ops.table2 import Table2
 from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_of
 from gubernator_tpu.parallel.sharded import ShardedEngine, new_sharded_table
 from gubernator_tpu.types import (
@@ -163,7 +174,16 @@ class GlobalShardedEngine(ShardedEngine):
     `home_shard` models which node a client connected to (the reference's
     non-owner): GLOBAL requests are answered from that device's replica table
     and their hits accumulate until the next sync tick (GlobalSyncWait analog,
-    default 100 ms, reference config.go:142-146)."""
+    default 100 ms, reference config.go:142-146).
+
+    The daemon serving surface (`check_columns`) assigns each GLOBAL batch a
+    rotating home device: successive front-door dispatches land on successive
+    devices, modeling clients spread over the peer group — the replica plane
+    absorbs the reads/hits and the collective sync reconciles them, which is
+    the BASELINE #3 topology (8-peer cluster ↦ v5e-8 mesh over ICI)."""
+
+    mesh_global = True  # daemon marker: this engine serves the GLOBAL
+    # behavior through replica tables + collective sync
 
     def __init__(
         self,
@@ -172,18 +192,39 @@ class GlobalShardedEngine(ShardedEngine):
         max_exact_passes: int = 8,
         sync_out: int = 256,
         created_at_tolerance_ms=None,
+        store=None,
     ):
         super().__init__(
             mesh,
             capacity_per_shard=capacity_per_shard,
             max_exact_passes=max_exact_passes,
             created_at_tolerance_ms=created_at_tolerance_ms,
+            store=store,
         )
-        self.replica = new_sharded_table(mesh, capacity_per_shard)
+        # the replica table + collective step materialize on first GLOBAL
+        # use: clustered daemons route GLOBAL over the host peer plane and
+        # must not pay a second table's HBM or the sync-step compile
+        self._capacity_per_shard = capacity_per_shard
+        self.replica: Optional[Table2] = None
+        self._sync_step = None
         self.sync_out = sync_out
         self.pending: List[Dict[int, dict]] = [dict() for _ in range(self.n_shards)]
-        self._sync_step = _mk_sync_step(mesh, self.n_shards, sync_out)
         self.global_stats = GlobalStats()
+        self._rr = 0  # rotating home-device assignment for served batches
+
+    def _ensure_global_plane(self) -> None:
+        if self.replica is None:
+            self.replica = new_sharded_table(self.mesh, self._capacity_per_shard)
+        if self._sync_step is None:
+            self._sync_step = _mk_sync_step(self.mesh, self.n_shards, self.sync_out)
+
+    def _next_home(self) -> int:
+        h = self._rr % self.n_shards
+        self._rr += 1
+        return h
+
+    def has_pending(self) -> bool:
+        return any(self.pending)
 
     # ------------------------------------------------------------------ check
     def check(
@@ -228,20 +269,91 @@ class GlobalShardedEngine(ShardedEngine):
     def _check_global(
         self, requests: Sequence[RateLimitRequest], now: int, home: int
     ) -> List[RateLimitResponse]:
-        """GLOBAL dispatch. Requests whose owner shard IS the home device run
-        the owner path against the authoritative table and queue a broadcast
-        (reference getLocalRateLimit + QueueUpdate, gubernator.go:653-690);
-        everything else is answered from the home replica and its hits are
-        queued for the owner (getGlobalRateLimit, gubernator.go:401-429)."""
+        """GLOBAL dispatch (object API). Array core shared with the daemon's
+        columns path (`check_columns`)."""
         hb, errors = pack_requests(requests, now, tolerance_ms=self.created_at_tolerance_ms)
         out: List[Optional[RateLimitResponse]] = [None] * len(requests)
         for i, err in enumerate(errors):
             if err is not None:
                 out[i] = RateLimitResponse(error=err)
+        status, limit, remaining, reset, dropped = self._global_hb(hb, home)
+        for i in range(len(requests)):
+            if out[i] is None:
+                out[i] = RateLimitResponse(
+                    status=int(status[i]),
+                    limit=int(limit[i]),
+                    remaining=int(remaining[i]),
+                    reset_time=int(reset[i]),
+                    error=ERR_NOT_PERSISTED if dropped[i] else "",
+                )
+        self.stats.checks += len(requests)
+        return out  # type: ignore[return-value]
+
+    # ------------------------------------------------ daemon serving surface
+    def check_columns(
+        self, cols: RequestColumns, now_ms: Optional[int] = None
+    ) -> ResponseColumns:
+        """Columns-in/columns-out with the GLOBAL behavior honored on-mesh:
+        GLOBAL rows are answered from a rotating home device's replica table
+        (non-owner semantics, reference gubernator.go:401-429) with their hits
+        accumulated for the collective sync tick; everything else takes the
+        ownership-routed authoritative path."""
+        gmask = (np.asarray(cols.behavior) & np.int32(Behavior.GLOBAL)) != 0
+        if not gmask.any():
+            return super().check_columns(cols, now_ms=now_ms)
+        now = now_ms if now_ms is not None else ms_now()
+        n = cols.fp.shape[0]
+        status = np.zeros(n, dtype=np.int32)
+        limit = np.zeros(n, dtype=np.int64)
+        remaining = np.zeros(n, dtype=np.int64)
+        reset = np.zeros(n, dtype=np.int64)
+        err = np.zeros(n, dtype=np.int8)
+        rest = np.nonzero(~gmask)[0]
+        if rest.size:
+            rc = super().check_columns(
+                RequestColumns(*[f[rest] for f in cols]), now_ms=now
+            )
+            status[rest] = rc.status
+            limit[rest] = rc.limit
+            remaining[rest] = rc.remaining
+            reset[rest] = rc.reset_time
+            err[rest] = rc.err
+        g = np.nonzero(gmask)[0]
+        hb, perr = pack_columns(
+            RequestColumns(*[f[g] for f in cols]),
+            now,
+            tolerance_ms=self.created_at_tolerance_ms,
+        )
+        err[g] = perr
+        g_created = cols.created_at[g]
+        self.stats.created_at_clamped += int(
+            ((g_created != 0) & (hb.created_at != g_created)).sum()
+        )
+        s, l, r, t, dropped = self._global_hb(hb, self._next_home())
+        status[g] = s
+        limit[g] = l
+        remaining[g] = r
+        reset[g] = t
+        err[g[dropped]] = ERR_DROPPED
+        self.stats.checks += int(g.size)
+        return ResponseColumns(
+            status=status, limit=limit, remaining=remaining,
+            reset_time=reset, err=err,
+        )
+
+    def _global_hb(self, hb: HostBatch, home: int):
+        """The GLOBAL core over a packed batch: requests whose owner shard IS
+        the home device run the owner path against the authoritative table and
+        queue a broadcast (reference getLocalRateLimit + QueueUpdate,
+        gubernator.go:653-690); everything else is answered from the home
+        replica and its hits are queued for the owner (getGlobalRateLimit,
+        gubernator.go:401-429). Returns per-row response arrays."""
+        self._ensure_global_plane()
+        n = hb.fp.shape[0]
         owner = shard_of(hb.fp, self.n_shards)
         is_owner_here = (owner == home) & hb.active
 
-        for i in range(len(requests)):
+        for i in range(n):
             if not hb.active[i] or hb.hits[i] == 0:
                 continue  # zero-hit requests are never queued (global.go:85-95)
             if is_owner_here[i]:
@@ -253,6 +365,11 @@ class GlobalShardedEngine(ShardedEngine):
                 self.global_stats.hits_queued += 1
         self.global_stats.send_queue_length = sum(len(p) for p in self.pending)
 
+        status = np.zeros(n, dtype=np.int32)
+        limit = np.zeros(n, dtype=np.int64)
+        remaining = np.zeros(n, dtype=np.int64)
+        reset = np.zeros(n, dtype=np.int64)
+        dropped = np.zeros(n, dtype=bool)
         # non-owner rows answer from the home replica: strip GLOBAL, force
         # NO_BATCHING (reference gubernator.go:416-422)
         hb2 = hb._replace(
@@ -260,14 +377,18 @@ class GlobalShardedEngine(ShardedEngine):
             | np.int32(Behavior.NO_BATCHING),
             active=hb.active & ~is_owner_here,
         )
-        self._global_passes(hb2, out, table_attr="replica", home=home)
+        self._global_passes(hb2, status, limit, remaining, reset, dropped,
+                            table_attr="replica", home=home)
         # owner rows run the authoritative path on the primary shard
         hb3 = hb._replace(active=is_owner_here)
-        self._global_passes(hb3, out, table_attr="table", home=None)
-        self.stats.checks += len(requests)
-        return out  # type: ignore[return-value]
+        self._global_passes(hb3, status, limit, remaining, reset, dropped,
+                            table_attr="table", home=None)
+        return status, limit, remaining, reset, dropped
 
-    def _global_passes(self, hb: HostBatch, out, table_attr: str, home) -> None:
+    def _global_passes(
+        self, hb: HostBatch, status, limit, remaining, reset, dropped,
+        table_attr: str, home,
+    ) -> None:
         if not hb.active.any():
             return
         for p in plan_passes(hb, max_exact=self.max_exact_passes):
@@ -278,22 +399,26 @@ class GlobalShardedEngine(ShardedEngine):
                 if home is not None
                 else None
             )
-            _, (status, limit, remaining, reset, dropped) = self._dispatch(
+            _, (s, l, r, t, d) = self._dispatch(
                 batch, shard=shard, table_attr=table_attr
             )
-            for bi, orig in enumerate(p.rows):
-                r = RateLimitResponse(
-                    status=int(status[bi]),
-                    limit=int(limit[bi]),
-                    remaining=int(remaining[bi]),
-                    reset_time=int(reset[bi]),
-                    error=ERR_NOT_PERSISTED if dropped[bi] else "",
+            if p.member_rows:
+                members = np.concatenate(p.member_rows)
+                src = np.repeat(
+                    np.arange(nrows), [len(m) for m in p.member_rows]
                 )
-                if p.member_rows:
-                    for row in p.member_rows[bi]:
-                        out[int(row)] = r
-                else:
-                    out[int(orig)] = r
+                status[members] = s[src]
+                limit[members] = l[src]
+                remaining[members] = r[src]
+                reset[members] = t[src]
+                dropped[members] = d[src]
+            else:
+                rows = p.rows
+                status[rows] = s[:nrows]
+                limit[rows] = l[:nrows]
+                remaining[rows] = r[:nrows]
+                reset[rows] = t[:nrows]
+                dropped[rows] = d[:nrows]
 
     # ------------------------------------------------------------------- sync
     def sync(self, now_ms: Optional[int] = None) -> None:
@@ -308,6 +433,7 @@ class GlobalShardedEngine(ShardedEngine):
 
     def _sync_round(self, now_ms: Optional[int] = None) -> None:
         """One collective hit-sync + broadcast round."""
+        self._ensure_global_plane()
         now = now_ms if now_ms is not None else ms_now()
         OUT = self.sync_out
         boxes = []

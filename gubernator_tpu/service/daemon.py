@@ -96,14 +96,18 @@ class Daemon:
                 engine.store = store
         elif conf.engine == "sharded":
             # one daemon serving a whole device mesh: the table shards over
-            # every local device, ownership = fingerprint % n_shards
+            # every local device, ownership = fingerprint % n_shards. The
+            # mesh-global engine additionally serves the GLOBAL behavior as
+            # collectives (replica answers + all_gather sync over ICI) when
+            # this daemon runs standalone — the BASELINE #3 topology where
+            # the mesh IS the peer group.
             import jax
 
             from gubernator_tpu.parallel import make_mesh
-            from gubernator_tpu.parallel.sharded import ShardedEngine
+            from gubernator_tpu.parallel.global_sync import GlobalShardedEngine
 
             n_dev = len(jax.devices())
-            self.engine = ShardedEngine(
+            self.engine = GlobalShardedEngine(
                 make_mesh(n_dev),
                 capacity_per_shard=max(1, conf.cache_size // n_dev),
                 created_at_tolerance_ms=int(conf.created_at_tolerance_ms),
@@ -127,6 +131,7 @@ class Daemon:
 
         self.region_manager = RegionManager(self)
         self._maintenance_task = None
+        self._global_sync_task = None  # mesh-global collective sync tick
         self._local_picker = ReplicatedConsistentHash()
         self._region_picker = RegionPicker()
         self._peer_clients: Dict[str, PeerClient] = {}
@@ -161,6 +166,13 @@ class Daemon:
         await start_servers(d)
         d.global_manager.start()
         d.region_manager.start()
+        if getattr(d.engine, "mesh_global", False):
+            # collective GLOBAL sync tick (GlobalSyncWait cadence, reference
+            # config.go:142-146) — the in-mesh analog of runAsyncHits +
+            # runBroadcasts, collapsed into one collective step
+            d._global_sync_task = asyncio.create_task(
+                d._global_sync_loop(), name="mesh-global-sync"
+            )
         if d._client_creds is not None and conf.tls_cert_file:
             # rotation watcher: the gRPC server hot-reloads per handshake,
             # but peer-forwarding CLIENTS hold credentials from startup — on
@@ -182,6 +194,22 @@ class Daemon:
                     conf.engine,
                 )
         return d
+
+    async def _global_sync_loop(self) -> None:
+        """Mesh-global sync tick: drain accumulated GLOBAL hits through the
+        collective step every GlobalSyncWait. Empty ticks skip the dispatch —
+        the reference's timer also idles when no hits are queued
+        (global.go:125-151)."""
+        wait_s = self.conf.behaviors.global_sync_wait_ms / 1e3
+        while not self._shutting_down:
+            await asyncio.sleep(wait_s)
+            try:
+                if self.engine.has_pending():
+                    await self.runner.sync_global()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # pragma: no cover - defensive
+                log.exception("mesh global sync tick failed")
 
     async def _maintenance_loop(self) -> None:
         """Auto-grow tick: double the table when live keys pass 60% of
@@ -479,6 +507,14 @@ class Daemon:
             else:
                 forwards.append((i, hash_keys[i], items[i]))
 
+        if owner_global_rows and not standalone:
+            # clustered: owner-daemon GLOBAL answers must stay authoritative
+            # so the cross-daemon broadcast (queue_update below) carries a
+            # fresh status; the engine's mesh replica plane serves GLOBAL only
+            # when this daemon runs standalone (the mesh IS the peer group)
+            cols.behavior[np.asarray(owner_global_rows)] &= ~np.int32(
+                int(Behavior.GLOBAL)
+            )
         tasks = []
         if local_rows:
             rows = np.asarray(local_rows)
@@ -500,9 +536,12 @@ class Daemon:
         if tasks:
             await asyncio.gather(*tasks)
         # owner-side GLOBAL items broadcast their fresh status (reference
-        # getLocalRateLimit → QueueUpdate, gubernator.go:670-672)
-        for i in owner_global_rows:
-            self.global_manager.queue_update(hash_keys[i], items[i])
+        # getLocalRateLimit → QueueUpdate, gubernator.go:670-672). A
+        # standalone mesh-global daemon skips this: the collective plane IS
+        # the broadcast, and there are no peer daemons to push to.
+        if not (standalone and getattr(self.engine, "mesh_global", False)):
+            for i in owner_global_rows:
+                self.global_manager.queue_update(hash_keys[i], items[i])
         # owner-side MULTI_REGION hits replicate to the other DCs' owners
         for i in owner_region_rows:
             self.region_manager.queue_hit(hash_keys[i], items[i])
@@ -596,6 +635,11 @@ class Daemon:
         local_rows = np.nonzero(mine)[0]
         global_rows = np.nonzero(valid & ~mine & is_global)[0]
         fwd_rows = np.nonzero(valid & ~mine & ~is_global)[0]
+        if self._local_picker.size() > 0:
+            # clustered: keep owner-side GLOBAL authoritative (see _route)
+            lg = local_rows[is_global[local_rows]]
+            if lg.size:
+                cols.behavior[lg] &= ~np.int32(int(Behavior.GLOBAL))
 
         def place(rows, rc) -> None:
             status[rows] = rc.status
@@ -646,12 +690,17 @@ class Daemon:
         tasks.extend(run_forward(int(i)) for i in fwd_rows)
         if tasks:
             await asyncio.gather(*tasks)
-        # owner-side GLOBAL broadcasts + MULTI_REGION replication
-        for i in local_rows[is_global[local_rows]]:
-            item = materialize(i)
-            self.global_manager.queue_update(
-                item.name + "_" + item.unique_key, item
-            )
+        # owner-side GLOBAL broadcasts + MULTI_REGION replication (standalone
+        # mesh-global daemons skip queue_update — see _route)
+        if not (
+            self._local_picker.size() == 0
+            and getattr(self.engine, "mesh_global", False)
+        ):
+            for i in local_rows[is_global[local_rows]]:
+                item = materialize(i)
+                self.global_manager.queue_update(
+                    item.name + "_" + item.unique_key, item
+                )
         for i in local_rows[is_mr[local_rows]]:
             item = materialize(i)
             self.region_manager.queue_hit(
@@ -879,6 +928,12 @@ class Daemon:
                 await self._maintenance_task
             except asyncio.CancelledError:
                 pass
+        if self._global_sync_task is not None:
+            self._global_sync_task.cancel()
+            try:
+                await self._global_sync_task
+            except asyncio.CancelledError:
+                pass
         if self._pool is not None:
             await self._pool.close()
         await self.global_manager.close()
@@ -890,5 +945,9 @@ class Daemon:
         )
         for s in self._servers:
             await s.stop()
+        if getattr(self.engine, "mesh_global", False) and self.engine.has_pending():
+            # final collective flush so queued GLOBAL hits reach their owner
+            # shards before the checkpoint (global_manager.close analog)
+            await self.runner.sync_global()
         self.maybe_checkpoint()
         self.runner.close()

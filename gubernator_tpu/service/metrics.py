@@ -139,6 +139,34 @@ class DaemonMetrics:
             "Authoritative GLOBAL statuses installed from owner broadcasts",
             registry=r,
         )
+        # --- mesh-global collective plane (parallel/global_sync.py; the
+        # in-mesh analog of the global.go series — convergence tests scrape
+        # these for exact counts, like waitForBroadcast does)
+        self.mesh_sync_rounds = Counter(
+            "gubernator_mesh_sync_rounds",
+            "Collective GLOBAL sync rounds executed over the device mesh",
+            registry=r,
+        )
+        self.mesh_broadcasts_applied = Counter(
+            "gubernator_mesh_broadcasts_applied",
+            "GLOBAL entries applied+broadcast as owner during mesh sync",
+            registry=r,
+        )
+        self.mesh_updates_installed = Counter(
+            "gubernator_mesh_updates_installed",
+            "Authoritative GLOBAL statuses installed into replica tables",
+            registry=r,
+        )
+        self.mesh_hits_queued = Counter(
+            "gubernator_mesh_global_hits_queued",
+            "GLOBAL hits accumulated for the collective sync tick",
+            registry=r,
+        )
+        self.mesh_global_queue_length = Gauge(
+            "gubernator_mesh_global_queue_length",
+            "Pending mesh-GLOBAL outbox entries awaiting the collective sync",
+            registry=r,
+        )
         self.created_at_clamped = Counter(
             "gubernator_created_at_clamped_count",
             "Requests whose client created_at was outside the skew tolerance",
@@ -185,6 +213,34 @@ class DaemonMetrics:
             dropped=stats.dropped,
             disp=stats.dispatches,
             clamped=stats.created_at_clamped,
+        )
+
+    def observe_global(self, gs) -> None:
+        """Refresh mesh-global counters from a GlobalStats snapshot (same
+        delta pattern as observe_engine). NOT thread-safe: every caller runs
+        on the EngineRunner thread (dispatch path and sync path both), which
+        serializes the _last_global read-modify-write."""
+        last = getattr(self, "_last_global", None)
+        if last is None:
+            last = dict(rounds=0, bcast=0, inst=0, queued=0)
+        d = gs.sync_rounds - last["rounds"]
+        if d > 0:
+            self.mesh_sync_rounds.inc(d)
+        d = gs.broadcasts_applied - last["bcast"]
+        if d > 0:
+            self.mesh_broadcasts_applied.inc(d)
+        d = gs.updates_installed - last["inst"]
+        if d > 0:
+            self.mesh_updates_installed.inc(d)
+        d = gs.hits_queued - last["queued"]
+        if d > 0:
+            self.mesh_hits_queued.inc(d)
+        self.mesh_global_queue_length.set(gs.send_queue_length)
+        self._last_global = dict(
+            rounds=gs.sync_rounds,
+            bcast=gs.broadcasts_applied,
+            inst=gs.updates_installed,
+            queued=gs.hits_queued,
         )
 
     def render(self) -> bytes:

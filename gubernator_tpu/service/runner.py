@@ -40,6 +40,9 @@ class EngineRunner:
             if self.metrics is not None:
                 self.metrics.dispatch_duration.observe(time.perf_counter() - t0)
                 self.metrics.observe_engine(self.engine.stats)
+                gs = getattr(self.engine, "global_stats", None)
+                if gs is not None:
+                    self.metrics.observe_global(gs)
             return rc
 
         return await loop.run_in_executor(self._exec, run)
@@ -54,6 +57,24 @@ class EngineRunner:
             return n
 
         return await loop.run_in_executor(self._exec, run)
+
+    async def sync_global(self) -> None:
+        """One collective GLOBAL sync (mesh engines): drain pending hits
+        through the all_gather/aggregate/install step, serialized onto the
+        engine thread like every other table mutation. Metric observation
+        happens HERE (on the engine thread) so observe_global's read-modify-
+        write of its delta baseline is never concurrent with the dispatch
+        path's."""
+        loop = asyncio.get_running_loop()
+
+        def run():
+            t0 = time.perf_counter()
+            self.engine.sync()
+            if self.metrics is not None:
+                self.metrics.global_send_duration.observe(time.perf_counter() - t0)
+                self.metrics.observe_global(self.engine.global_stats)
+
+        await loop.run_in_executor(self._exec, run)
 
     async def live_count(self) -> int:
         """Table live-key count, serialized onto the engine thread — reading

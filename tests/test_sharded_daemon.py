@@ -8,7 +8,7 @@ from gubernator_tpu.client import V1Client
 from gubernator_tpu.proto import gubernator_pb2 as pb
 from gubernator_tpu.types import Behavior, RateLimitRequest
 
-from tests.cluster import Cluster, daemon_config, wait_for
+from tests.cluster import Cluster, daemon_config, metric_value, scrape, wait_for
 
 
 def async_test(fn):
@@ -85,6 +85,77 @@ async def test_sharded_daemons_global_converges():
             await client.close()
     finally:
         await c.stop()
+
+
+@async_test
+async def test_standalone_mesh_global_over_grpc():
+    """BASELINE config #3 as an API-served path: a standalone sharded daemon
+    serves GLOBAL through the collective plane — replica answers at a rotating
+    home device, hits drained by the all_gather sync tick, convergence
+    asserted with EXACT mesh counters scraped over the wire (the reference's
+    TestGlobalBehavior technique, functional_test.go:1760-2167)."""
+    from gubernator_tpu.parallel.global_sync import GlobalShardedEngine
+    from gubernator_tpu.service.daemon import Daemon
+
+    d = await Daemon.spawn(daemon_config(engine="sharded", cache_size=8192))
+    assert isinstance(d.engine, GlobalShardedEngine)
+    D = d.engine.n_shards
+    client = V1Client(d.conf.grpc_address)
+    try:
+        keys = [f"g{i}" for i in range(16)]
+        r1 = await client.get_rate_limits(
+            [req(k, hits=3, behavior=Behavior.GLOBAL) for k in keys]
+        )
+        assert all(x.error == "" and x.remaining == 97 for x in r1.responses)
+
+        # exact counters BEFORE any convergence read (reference discipline):
+        # one sync round applies every key once as owner and installs its
+        # authoritative status on the other D-1 devices' replicas
+        async def synced():
+            m = await scrape(d)
+            return metric_value(m, "gubernator_mesh_sync_rounds_total") >= 1
+
+        await wait_for(synced, timeout_s=40)
+        m = await scrape(d)
+        assert metric_value(m, "gubernator_mesh_broadcasts_applied_total") == 16
+        assert metric_value(m, "gubernator_mesh_updates_installed_total") == 16 * (D - 1)
+
+        # convergence: a zero-hit GLOBAL read at EVERY home device (homes
+        # rotate per dispatch) must agree on the authoritative remaining
+        for _ in range(D):
+            r = await client.get_rate_limits(
+                [req(k, hits=0, behavior=Behavior.GLOBAL) for k in keys]
+            )
+            assert all(x.remaining == 97 for x in r.responses)
+        # zero-hit reads are never queued (global.go:85-95): counters frozen
+        m = await scrape(d)
+        assert metric_value(m, "gubernator_mesh_broadcasts_applied_total") == 16
+
+        # hits accumulated from several homes reconcile at the owner: 4
+        # dispatches × 2 hits on one key → authoritative remaining 97-8=89
+        for _ in range(4):
+            r = await client.get_rate_limits(
+                [req("g0", hits=2, behavior=Behavior.GLOBAL)]
+            )
+            assert r.responses[0].error == ""
+
+        async def converged():
+            if d.engine.has_pending():
+                return False
+            r = await client.get_rate_limits(
+                [req("g0", hits=0, behavior=Behavior.GLOBAL)]
+            )
+            return r.responses[0].remaining == 89
+
+        await wait_for(converged, timeout_s=40)
+        for _ in range(D):  # every home's replica agrees
+            r = await client.get_rate_limits(
+                [req("g0", hits=0, behavior=Behavior.GLOBAL)]
+            )
+            assert r.responses[0].remaining == 89
+    finally:
+        await client.close()
+        await d.close()
 
 
 @async_test
