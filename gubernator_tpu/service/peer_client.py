@@ -59,7 +59,8 @@ class PeerClient:
         self._creds = channel_credentials
         self._channel: Optional[grpc.aio.Channel] = None
         self._queue: List[Tuple[pb.RateLimitReq, asyncio.Future]] = []
-        self._flush_task: Optional[asyncio.Task] = None
+        self._wake: Optional[asyncio.Event] = None
+        self._loop_task: Optional[asyncio.Task] = None
         self._inflight = 0
         self._closed = False
         self.last_errs: collections.deque = collections.deque(maxlen=LAST_ERRS_CAP)
@@ -95,6 +96,8 @@ class PeerClient:
         )
         try:
             return await call(req, timeout=timeout or self.timeout_s)
+        except asyncio.CancelledError:
+            raise  # task cancellation must propagate, not become a PeerError
         except BaseException as exc:
             self._record_err(exc)
             raise PeerError(self.info.grpc_address, exc) from exc
@@ -139,39 +142,64 @@ class PeerClient:
         self._queue.append((item, fut))
         if self.metrics is not None:
             self.metrics.batch_queue_length.set(len(self._queue))
-        if len(self._queue) >= self.batch_limit:
-            self._kick(immediate=True)
-        else:
-            self._kick(immediate=False)
-        return await fut
-
-    def _kick(self, immediate: bool) -> None:
-        if self._flush_task is not None and not self._flush_task.done():
-            if immediate:
-                self._flush_task.cancel()
-            else:
-                return
-        self._flush_task = asyncio.get_running_loop().create_task(
-            self._flush_after(0.0 if immediate else self.batch_wait_s)
-        )
-
-    async def _flush_after(self, delay: float) -> None:
-        if delay > 0:
+        if self._loop_task is None or self._loop_task.done():
+            self._wake = asyncio.Event()
+            self._loop_task = loop.create_task(
+                self._run(), name=f"peer-batch:{self.info.grpc_address}"
+            )
+        self._wake.set()
+        # queue-wait deadline (BatchTimeout analog, reference config.go:138):
+        # a request must never strand in the queue awaiting a flush that does
+        # not come. The loop drains a deep queue in sequential chunks, so the
+        # budget scales with this item's chunk position — a burst's tail is
+        # legitimately behind several RPCs, not timed out.
+        chunks_ahead = (len(self._queue) + self.batch_limit - 1) // self.batch_limit
+        deadline = self.batch_wait_s + self.timeout_s * max(1, chunks_ahead) + 1.0
+        try:
+            return await asyncio.wait_for(asyncio.shield(fut), timeout=deadline)
+        except asyncio.TimeoutError:
             try:
-                await asyncio.sleep(delay)
-            except asyncio.CancelledError:
-                return
-        await self._flush()
+                self._queue.remove((item, fut))
+                if self.metrics is not None:
+                    self.metrics.batch_queue_length.set(len(self._queue))
+            except ValueError:
+                pass  # already picked up by a flush; its result is dropped
+            fut.cancel()
+            err = PeerError(
+                self.info.grpc_address,
+                TimeoutError("request timed out awaiting the batch flush"),
+            )
+            self._record_err(err)
+            raise err
 
-    async def _flush(self) -> None:
-        batch = self._queue[: self.batch_limit]
-        self._queue = self._queue[self.batch_limit :]
-        if self.metrics is not None:
-            self.metrics.batch_queue_length.set(len(self._queue))
-        if not batch:
-            return
-        if self._queue:
-            self._kick(immediate=len(self._queue) >= self.batch_limit)
+    async def _run(self) -> None:
+        """The long-lived flush loop (reference runBatch, one goroutine per
+        peer, peer_client.go:289-344): wake on enqueue, wait out the batch
+        window unless the limit is already met, then send chunks until the
+        queue is empty. Items enqueued while a send is in flight are picked
+        up by the next iteration — nothing strands, and a running send is
+        never cancelled by new arrivals (the one-shot-task design this loop
+        replaced could do both)."""
+        while not self._closed:
+            await self._wake.wait()
+            self._wake.clear()
+            if not self._queue:
+                continue
+            if len(self._queue) < self.batch_limit and self.batch_wait_s > 0:
+                await asyncio.sleep(self.batch_wait_s)
+            await self._drain()
+
+    async def _drain(self) -> None:
+        """Send the queue in batch_limit chunks until empty (shared by the
+        flush loop and shutdown so metrics/chunking can't diverge)."""
+        while self._queue:
+            batch = self._queue[: self.batch_limit]
+            self._queue = self._queue[self.batch_limit :]
+            if self.metrics is not None:
+                self.metrics.batch_queue_length.set(len(self._queue))
+            await self._send(batch)
+
+    async def _send(self, batch) -> None:
         self._inflight += 1
         try:
             req = peers_pb.GetPeerRateLimitsReq(requests=[i for i, _ in batch])
@@ -185,6 +213,19 @@ class PeerClient:
                 for (item, fut), r in zip(batch, resp.rate_limits):
                     if not fut.done():
                         fut.set_result(r)
+            except asyncio.CancelledError:
+                # loop-task cancellation mid-RPC: fail the batch, then let the
+                # cancellation end the task (never swallow it — the loop would
+                # otherwise survive cancel() forever)
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(
+                            PeerError(
+                                self.info.grpc_address,
+                                RuntimeError("peer client cancelled"),
+                            )
+                        )
+                raise
             except BaseException as exc:
                 for _, fut in batch:
                     if not fut.done():
@@ -198,14 +239,15 @@ class PeerClient:
 
     # -------------------------------------------------------------- shutdown
     async def shutdown(self) -> None:
-        """Drain: flush the queue, wait for in-flight sends, close the
-        channel (reference peer_client.go:415-451)."""
+        """Drain: stop the flush loop, send anything still queued, wait for
+        in-flight sends, close the channel (reference peer_client.go:415-451)."""
         self._closed = True
-        while self._queue or self._inflight:
-            if self._flush_task is not None and not self._flush_task.done():
-                self._flush_task.cancel()
-            await self._flush()
-            await asyncio.sleep(0)
+        if self._loop_task is not None and not self._loop_task.done():
+            self._wake.set()
+            await self._loop_task
+        # single-drainer invariant: the loop has exited, so no send is in
+        # flight here — this drain is the only sender left
+        await self._drain()
         if self._channel is not None:
             await self._channel.close()
             self._channel = None

@@ -299,6 +299,54 @@ async def test_peer_client_shutdown_races_inflight_requests():
 
 
 @async_test
+async def test_peer_client_residual_queue_and_midsend_enqueue_drain():
+    """2× batch_limit enqueued in one burst plus an enqueue landing while a
+    send is in flight, then silence: the long-lived flush loop must drain
+    everything without cancelling an in-flight batch or stranding a future
+    (reference runBatch, peer_client.go:289-344 — the one-shot-task design
+    this replaced could self-cancel mid-RPC and strand quiet-period items)."""
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.service.daemon import Daemon
+    from gubernator_tpu.service.peer_client import PeerClient
+    from gubernator_tpu.types import PeerInfo
+
+    d = await Daemon.spawn(daemon_config())
+    client = PeerClient(
+        PeerInfo(grpc_address=d.conf.grpc_address),
+        batch_wait_ms=1.0,
+        batch_limit=8,  # small limit so 16 items need multiple chunks
+        batch_timeout_ms=5000.0,
+    )
+    try:
+        async def one(i):
+            r = await client.get_peer_rate_limit(
+                pb.RateLimitReq(
+                    name="drain", unique_key=f"k{i}", hits=1, limit=100,
+                    duration=60_000,
+                )
+            )
+            return r.remaining
+
+        tasks = [asyncio.create_task(one(i)) for i in range(16)]
+        await asyncio.sleep(0)  # let the burst enqueue
+        # mid-send enqueue: wait for an in-flight send, then add one more
+        for _ in range(5000):
+            if client._inflight:
+                break
+            await asyncio.sleep(0.001)
+        tasks.append(asyncio.create_task(one(99)))
+        # go quiet: every future must resolve (no PeerError → gather raises)
+        results = await asyncio.wait_for(asyncio.gather(*tasks), timeout=20)
+        assert len(results) == 17
+        assert all(r == 99 for r in results)  # unique keys, one hit each
+        assert not client._queue  # nothing stranded
+    finally:
+        await client.shutdown()
+        assert client._loop_task is None or client._loop_task.done()
+        await d.close()
+
+
+@async_test
 async def test_daemon_close_leaves_no_running_tasks():
     """Graceful close cancels every loop the daemon started (the goleak
     analog, reference lrucache_test.go via go.uber.org/goleak)."""
