@@ -29,7 +29,15 @@ millisecond expiry (the whole bucket is already in registers) instead of v1's
 conservative coarse-expiry probe plane, and a burst of inserts into one full
 bucket may evict several soonest-expiring lanes at once (v1 evicted at most
 one per dispatch round; the reference's LRU evicts as many as needed,
-lrucache.go:138-149).
+lrucache.go:138-149). The leaky remainder is stored as a double-single f32
+pair (REMF_HI/LO, ~48-bit mantissa) vs the reference's float64 (store.go:32):
+exact for every integer remainder in the accepted config range — limits and
+bursts are validated to int32 (pack_columns ERR_LIMIT_I32/ERR_BURST_I32), so
+integer parts are ≤ 2^31 ≪ 2^48 — with fractional-refill resolution ≥ 2^-17
+tokens at the i32 extreme (measured worst roundtrip error 2^-19; bounds
+asserted in tests/test_leaky_bucket.py). Configs beyond i32, which COULD
+quantize, are rejected rather than served imprecisely; in-kernel math is
+float64 throughout (ops/math.py).
 """
 
 from __future__ import annotations
@@ -527,7 +535,13 @@ def install2_impl(
     is_token = inst.algo == int(Algorithm.TOKEN_BUCKET)
     rem_i = jnp.where(is_token, inst.remaining, i64(0))
     rem_f = jnp.where(is_token, f64(0.0), inst.remaining.astype(f64))
-    burst = jnp.where(is_token, i64(0), inst.limit)
+    burst = jnp.where(is_token, i64(0), inst.burst)
+    # expiry: token items expire at their authoritative reset (ExpireAt =
+    # CreatedAt + Duration = reset, store.go:29-35); leaky items at
+    # stamp + duration (UpdatedAt basis, cache.go:35-40) — NOT reset_time,
+    # whose leaky meaning (createdAt + (limit-rem)*rate) can lie in the past
+    # for a near-full bucket and would expire the install on arrival
+    exp = jnp.where(is_token, inst.reset_time, inst.stamp + inst.duration)
     flags = inst.algo | (inst.status << 8)
     sat32 = lambda x: jnp.clip(x, -(2**31), 2**31 - 1).astype(i32)
     remf_hi = rem_f.astype(f32)
@@ -543,10 +557,10 @@ def install2_impl(
             flags,
             _lo32(inst.duration),
             _hi32(inst.duration),
-            _lo32(inst.now),
-            _hi32(inst.now),
-            _lo32(inst.reset_time),
-            _hi32(inst.reset_time),
+            _lo32(inst.stamp),
+            _hi32(inst.stamp),
+            _lo32(exp),
+            _hi32(exp),
             jax.lax.bitcast_convert_type(remf_hi, i32),
             jax.lax.bitcast_convert_type(remf_lo, i32),
             zero,

@@ -151,3 +151,51 @@ def test_zero_hits_probe(eng, frozen_now):
     (r,) = eng.check([req(hits=0, created_at=t)], now_ms=t)
     assert r.status == Status.UNDER_LIMIT
     assert r.remaining == 3
+
+
+# ----------------------------------------------- remainder precision bounds
+
+
+def test_leaky_out_of_range_limit_and_burst_rejected(eng, frozen_now):
+    """Limits/bursts beyond int32 are REJECTED at validation (pack_columns
+    ERR_LIMIT_I32/ERR_BURST_I32) — the guard that keeps every storable leaky
+    remainder inside the double-single f32 domain. The reference accepts
+    int64 limits (store.go:31); divergence documented in ops/kernel2.py."""
+    for bad in (2**40, 2**47, 2**50):
+        (r,) = eng.check([req(limit=bad)], now_ms=frozen_now)
+        assert "32" in r.error and r.status == Status.UNDER_LIMIT
+        (r,) = eng.check([req(limit=5, burst=bad)], now_ms=frozen_now)
+        assert "32" in r.error
+
+
+def test_leaky_remainder_survives_roundtrips_at_i32_extremes(eng, frozen_now):
+    """Store/load roundtrips of the double-single f32 remainder stay exact
+    against a float64 oracle at the largest representable configs: integer
+    remainders are bit-exact, fractional refills within 2^-17 tokens (the
+    48-bit mantissa bound measured in ops/kernel2.py's divergence note)."""
+    limit = 2**31 - 1  # max accepted
+    dur = MINUTE
+    t = frozen_now
+    # drain in uneven chunks across dispatches → many store/load roundtrips
+    oracle = float(limit)
+    hits_seq = [1, 2**30, 3, 2**29 + 7, 11, 2**28 + 1]
+    for h in hits_seq:
+        (r,) = eng.check([req(key="big", hits=h, limit=limit, duration=dur,
+                              created_at=t)], now_ms=t)
+        oracle -= h
+        assert r.error == ""
+        assert r.remaining == int(oracle)  # integer domain: bit-exact
+    # fractional refill: advance by a prime ms count; rate = dur/limit ms/token
+    rate = dur / limit
+    adv = 104729  # ms
+    t2 = t + adv
+    (r,) = eng.check([req(key="big", hits=0, limit=limit, duration=dur,
+                          created_at=t2)], now_ms=t2)
+    oracle = min(float(limit), oracle + adv / rate)
+    # truncation boundary: allow 1 token of slack for the 2^-17 resolution
+    assert abs(r.remaining - int(oracle)) <= 1
+    # and further roundtrips must not drift: repeat zero-hit reads
+    for _ in range(5):
+        (r2,) = eng.check([req(key="big", hits=0, limit=limit, duration=dur,
+                               created_at=t2)], now_ms=t2)
+        assert r2.remaining == r.remaining
