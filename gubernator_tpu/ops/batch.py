@@ -103,6 +103,12 @@ class InstallBatch(NamedTuple):
     duration: jnp.ndarray  # int64
     now: jnp.ndarray  # int64 (B,)
     active: jnp.ndarray  # bool
+    # full-fidelity state (Store rehydrate): the leaky burst and the item's
+    # UpdatedAt/CreatedAt stamp. The UpdatePeerGlobals wire path has neither
+    # (reference rebuilds with Burst=Limit, CreatedAt=now,
+    # gubernator.go:434-474) — its callers pass burst=limit, stamp=now.
+    burst: jnp.ndarray  # int64
+    stamp: jnp.ndarray  # int64
 
 
 class HostBatch(NamedTuple):

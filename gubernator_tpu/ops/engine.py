@@ -87,9 +87,10 @@ class EngineStats:
 def serve_columns(engine, cols, now_ms, dispatch) -> ResponseColumns:
     """The shared columns-in/columns-out serving loop: pack + clamp-count,
     plan same-key passes, dispatch each (member-row fan-out, ERR_DROPPED for
-    unpersisted rows), fire the Store hook. `dispatch(pass_batch, n_rows)`
-    returns (status, limit, remaining, reset, dropped) over the pass rows —
-    the only thing that differs between the single-device and mesh engines."""
+    unpersisted rows), fire the Store hooks. `dispatch(pass_batch, n_rows)`
+    returns (status, limit, remaining, reset, dropped, cache_hit) over the
+    pass rows — the only thing that differs between the single-device and
+    mesh engines."""
     now = now_ms if now_ms is not None else ms_now()
     hb, err = pack_columns(cols, now, tolerance_ms=engine.created_at_tolerance_ms)
     engine.stats.created_at_clamped += int(
@@ -100,9 +101,15 @@ def serve_columns(engine, cols, now_ms, dispatch) -> ResponseColumns:
     limit_o = np.zeros(n, dtype=np.int64)
     remaining = np.zeros(n, dtype=np.int64)
     reset = np.zeros(n, dtype=np.int64)
-    for p in plan_passes(hb, max_exact=engine.max_exact_passes):
+    for pi, p in enumerate(plan_passes(hb, max_exact=engine.max_exact_passes)):
         np_ = len(p.rows)
-        s, l, r, t, dropped = dispatch(p.batch, np_)
+        outs = dispatch(p.batch, np_)
+        if pi == 0 and engine.store is not None:
+            # cache miss → consult the store and re-apply against hydrated
+            # state (reference algorithms.go:45-51). Only pass 0 can miss:
+            # later passes hit what pass 0 created.
+            outs = _rehydrate_misses(engine, p.batch, np_, outs, now, dispatch)
+        s, l, r, t, dropped, _hit = outs
         if p.member_rows:
             # fan the aggregate's response out to every member row
             members = np.concatenate(p.member_rows)
@@ -114,24 +121,90 @@ def serve_columns(engine, cols, now_ms, dispatch) -> ResponseColumns:
             err[members[dropped[src]]] = ERR_DROPPED
         else:
             rows = p.rows
-            status[rows] = s
-            limit_o[rows] = l
-            remaining[rows] = r
-            reset[rows] = t
-            err[rows[dropped]] = ERR_DROPPED
+            status[rows] = s[:np_]
+            limit_o[rows] = l[:np_]
+            remaining[rows] = r[:np_]
+            reset[rows] = t[:np_]
+            err[rows[dropped[:np_]]] = ERR_DROPPED
     engine.stats.checks += n
     if engine.store is not None:
-        persisted = hb.fp[(err == 0) & (hb.fp != 0)]
-        if persisted.shape[0]:
+        ok = (err == 0) & (hb.fp != 0)
+        if ok.any():
             from gubernator_tpu.store import ChangeSet
 
+            idx = np.nonzero(ok)[0]
+            # one row per unique fp, last occurrence wins — the changeset is
+            # a STATE delta, not a request log (reference OnChange carries
+            # the stored item, store.go:66-70)
+            rev = idx[::-1]
+            _, pos = np.unique(hb.fp[rev], return_index=True)
+            keep = rev[pos]
             engine.store.on_change(
-                ChangeSet(fps=np.unique(persisted), created_at=now)
+                ChangeSet(
+                    fps=hb.fp[keep],
+                    created_at=now,
+                    algo=hb.algo[keep],
+                    status=status[keep].astype(np.int32),
+                    limit=limit_o[keep],
+                    remaining=remaining[keep],
+                    reset_time=reset[keep],
+                    duration=hb.duration[keep],
+                    burst=hb.burst[keep],
+                    stamp=hb.created_at[keep],
+                )
             )
     return ResponseColumns(
         status=status, limit=limit_o, remaining=remaining,
         reset_time=reset, err=err,
     )
+
+
+def _rehydrate_misses(engine, batch, n: int, outs, now: int, dispatch):
+    """Re-hydrate device cache misses from the Store: install found rows and
+    re-dispatch just those requests against the stored state, overwriting
+    their phase-1 (fresh-create) responses. The phase-1 slot is overwritten
+    by the install, so hits apply exactly once — against the hydrated item."""
+    s, l, r, t, dropped, hit = outs
+    active = np.asarray(batch.active[:n])
+    miss = ~hit[:n] & active
+    if not miss.any():
+        return outs
+    rows = np.nonzero(miss)[0]
+    res = engine.store.get_many(np.asarray(batch.fp[rows]), now)
+    if res is None:
+        return outs
+    found = np.asarray(res["found"])
+    if not found.any():
+        return outs
+    fr = rows[found]
+    engine.install_columns(
+        fp=np.asarray(batch.fp[fr]),
+        algo=np.asarray(res["algo"])[found],
+        status=np.asarray(res["status"])[found],
+        limit=np.asarray(res["limit"])[found],
+        remaining=np.asarray(res["remaining"])[found],
+        reset_time=np.asarray(res["reset_time"])[found],
+        duration=np.asarray(res["duration"])[found],
+        now_ms=now,
+        burst=np.asarray(res["burst"])[found],
+        stamp=np.asarray(res["stamp"])[found],
+    )
+    sub = HostBatch(*[f[fr] for f in batch])
+    m = len(fr)
+    prev_status = s[fr].copy()
+    prev_dropped = dropped[fr].copy()
+    s2, l2, r2, t2, d2, h2 = dispatch(sub, m)
+    for dst, src in ((s, s2), (l, l2), (r, r2), (t, t2), (dropped, d2), (hit, h2)):
+        dst[fr] = src[:m]
+    # a rehydrated row is ONE miss-then-warm, not a miss plus a hit — undo
+    # the re-dispatch's double counting (reference counts Store.Get warms as
+    # plain misses); likewise drop phase-1 over_limit/dropped for rows the
+    # hydrated re-run superseded
+    engine.stats.cache_hits -= int(h2[:m].sum())
+    engine.stats.cache_misses -= int((~h2[:m]).sum())
+    engine.stats.over_limit -= int((prev_status == 1).sum())
+    engine.stats.dropped -= int((prev_dropped & ~d2[:m]).sum())
+    return s, l, r, t, dropped, hit
 
 
 class LocalEngine:
@@ -245,6 +318,7 @@ class LocalEngine:
         remaining = arr[:n, 1].copy()
         reset = arr[:n, 2].copy()
         status = (arr[:n, 3] & 1).astype(np.int32)
+        hit = (arr[:n, 3] & 2) != 0
         dropped = (arr[:n, 3] & 4) != 0
         retries = 0
         while dropped.any() and retries < self.max_claim_retries:
@@ -259,13 +333,14 @@ class LocalEngine:
             remaining[rows] = arr[:m, 1]
             reset[rows] = arr[:m, 2]
             status[rows] = (arr[:m, 3] & 1).astype(np.int32)
+            hit[rows] = (arr[:m, 3] & 2) != 0
             nd = np.zeros(n, dtype=bool)
             nd[rows] = (arr[:m, 3] & 4) != 0
             dropped = nd
             retries += 1
         # only rows still unpersisted after retries count as dropped
         self.stats.dropped += int(dropped.sum())
-        return status, limit, remaining, reset, dropped
+        return status, limit, remaining, reset, dropped, hit
 
     # ------------------------------------------------------------ peer plane
 
@@ -279,16 +354,25 @@ class LocalEngine:
         reset_time: np.ndarray,
         duration: np.ndarray,
         now_ms: Optional[int] = None,
+        burst: Optional[np.ndarray] = None,
+        stamp: Optional[np.ndarray] = None,
     ) -> int:
         """Install owner-authoritative GLOBAL statuses as fresh items — the
         UpdatePeerGlobals receive path (reference gubernator.go:434-474).
-        Returns the number installed."""
+        Returns the number installed. `burst`/`stamp` default to the wire
+        path's lossy rebuild (Burst=Limit, stamp=now — exactly the
+        reference's, gubernator.go:434-474); the Store rehydrate path passes
+        the stored values for full fidelity."""
         if self._decide_fn is not None:
             raise RuntimeError("install_columns unsupported on the v1 oracle engine")
         now = now_ms if now_ms is not None else ms_now()
         n = fp.shape[0]
         if n == 0:
             return 0
+        if burst is None:
+            burst = np.asarray(limit, dtype=np.int64)
+        if stamp is None:
+            stamp = np.full(n, now, dtype=np.int64)
         size = _pad_size(n)
 
         def pad(a, dtype):
@@ -308,6 +392,8 @@ class LocalEngine:
             duration=jnp.asarray(pad(duration, np.int64)),
             now=jnp.asarray(pad(np.full(n, now, dtype=np.int64), np.int64)),
             active=jnp.asarray(pad(np.ones(n, dtype=bool), bool)),
+            burst=jnp.asarray(pad(burst, np.int64)),
+            stamp=jnp.asarray(pad(stamp, np.int64)),
         )
         self.table, installed = install2(self.table, inst, write=self.write_mode)
         self.stats.dispatches += 1

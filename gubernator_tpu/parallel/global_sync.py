@@ -141,6 +141,8 @@ def _mk_sync_step(mesh, n_shards: int, out_size: int):
             duration=agg.duration,
             now=agg.created_at,
             active=valid,
+            burst=agg.burst,  # real config burst — richer than the wire
+            stamp=agg.created_at,  # path's Burst=Limit rebuild
         )
         bc_all = jax.lax.all_gather(bc, SHARD_AXIS)
         bc_flat = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), bc_all)
@@ -156,14 +158,17 @@ def _mk_sync_step(mesh, n_shards: int, out_size: int):
             ]
         )
         expand = lambda t: jax.tree.map(lambda x: x[None], t)
-        return expand(primary), expand(replica), counters[None]
+        # bc (this device's owner-applied rows) returns to the host so a
+        # configured Store can write the reconciled state through — the
+        # reference's OnChange fires on owner-side GLOBAL applies too
+        return expand(primary), expand(replica), counters[None], expand(bc)
 
     spec = P(SHARD_AXIS)
     fn = jax.shard_map(
         per_device,
         mesh=mesh,
         in_specs=(spec, spec, spec),
-        out_specs=(spec, spec, spec),
+        out_specs=(spec, spec, spec, spec),
     )
     return jax.jit(fn, donate_argnums=(0, 1))
 
@@ -276,7 +281,7 @@ class GlobalShardedEngine(ShardedEngine):
         for i, err in enumerate(errors):
             if err is not None:
                 out[i] = RateLimitResponse(error=err)
-        status, limit, remaining, reset, dropped = self._global_hb(hb, home)
+        status, limit, remaining, reset, dropped = self._global_hb(hb, home, now)
         for i in range(len(requests)):
             if out[i] is None:
                 out[i] = RateLimitResponse(
@@ -297,7 +302,11 @@ class GlobalShardedEngine(ShardedEngine):
         GLOBAL rows are answered from a rotating home device's replica table
         (non-owner semantics, reference gubernator.go:401-429) with their hits
         accumulated for the collective sync tick; everything else takes the
-        ownership-routed authoritative path."""
+        ownership-routed authoritative path. Store write-through/rehydrate
+        fires on the authoritative paths (non-GLOBAL, GLOBAL owner rows, and
+        the collective sync's reconciled state) — never on replica answers,
+        which are transient by contract and would write stale state over the
+        owner's."""
         gmask = (np.asarray(cols.behavior) & np.int32(Behavior.GLOBAL)) != 0
         if not gmask.any():
             return super().check_columns(cols, now_ms=now_ms)
@@ -329,7 +338,7 @@ class GlobalShardedEngine(ShardedEngine):
         self.stats.created_at_clamped += int(
             ((g_created != 0) & (hb.created_at != g_created)).sum()
         )
-        s, l, r, t, dropped = self._global_hb(hb, self._next_home())
+        s, l, r, t, dropped = self._global_hb(hb, self._next_home(), now)
         status[g] = s
         limit[g] = l
         remaining[g] = r
@@ -341,7 +350,7 @@ class GlobalShardedEngine(ShardedEngine):
             reset_time=reset, err=err,
         )
 
-    def _global_hb(self, hb: HostBatch, home: int):
+    def _global_hb(self, hb: HostBatch, home: int, now: Optional[int] = None):
         """The GLOBAL core over a packed batch: requests whose owner shard IS
         the home device run the owner path against the authoritative table and
         queue a broadcast (reference getLocalRateLimit + QueueUpdate,
@@ -379,19 +388,47 @@ class GlobalShardedEngine(ShardedEngine):
         )
         self._global_passes(hb2, status, limit, remaining, reset, dropped,
                             table_attr="replica", home=home)
-        # owner rows run the authoritative path on the primary shard
+        # owner rows run the authoritative path on the primary shard — with
+        # the Store contract honored there (write-through + miss rehydrate,
+        # like the reference's owner-side getLocalRateLimit)
         hb3 = hb._replace(active=is_owner_here)
         self._global_passes(hb3, status, limit, remaining, reset, dropped,
-                            table_attr="table", home=None)
+                            table_attr="table", home=None, now=now)
+        if self.store is not None and now is not None:
+            own = np.nonzero(is_owner_here & ~dropped)[0]
+            if own.size:
+                from gubernator_tpu.store import ChangeSet
+
+                rev = own[::-1]
+                _, pos = np.unique(hb.fp[rev], return_index=True)
+                keep = rev[pos]
+                self.store.on_change(
+                    ChangeSet(
+                        fps=hb.fp[keep],
+                        created_at=now,
+                        algo=hb.algo[keep],
+                        status=status[keep].astype(np.int32),
+                        limit=limit[keep],
+                        remaining=remaining[keep],
+                        reset_time=reset[keep],
+                        duration=hb.duration[keep],
+                        burst=hb.burst[keep],
+                        stamp=hb.created_at[keep],
+                    )
+                )
         return status, limit, remaining, reset, dropped
 
     def _global_passes(
         self, hb: HostBatch, status, limit, remaining, reset, dropped,
-        table_attr: str, home,
+        table_attr: str, home, now: Optional[int] = None,
     ) -> None:
         if not hb.active.any():
             return
-        for p in plan_passes(hb, max_exact=self.max_exact_passes):
+        use_store = (
+            table_attr == "table" and home is None
+            and self.store is not None and now is not None
+        )
+        for pi, p in enumerate(plan_passes(hb, max_exact=self.max_exact_passes)):
             nrows = len(p.rows)
             batch = pad_batch(p.batch, _pad_size(nrows))
             shard = (
@@ -399,9 +436,21 @@ class GlobalShardedEngine(ShardedEngine):
                 if home is not None
                 else None
             )
-            _, (s, l, r, t, d) = self._dispatch(
+            _, (s, l, r, t, d, _h) = self._dispatch(
                 batch, shard=shard, table_attr=table_attr
             )
+            if pi == 0 and use_store:
+                from gubernator_tpu.ops.engine import _rehydrate_misses
+
+                def disp(b, nb):
+                    _, vals = self._dispatch(
+                        pad_batch(b, _pad_size(nb)), table_attr="table"
+                    )
+                    return vals
+
+                s, l, r, t, d, _h = _rehydrate_misses(
+                    self, p.batch, nrows, (s, l, r, t, d, _h), now, disp
+                )
             if p.member_rows:
                 members = np.concatenate(p.member_rows)
                 src = np.repeat(
@@ -455,7 +504,7 @@ class GlobalShardedEngine(ShardedEngine):
         dev_box = jax.tree.map(
             lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding), stacked
         )
-        self.table, self.replica, counters = self._sync_step(
+        self.table, self.replica, counters, bc = self._sync_step(
             self.table, self.replica, dev_box
         )
         c = np.asarray(counters)
@@ -463,3 +512,27 @@ class GlobalShardedEngine(ShardedEngine):
         self.global_stats.broadcasts_applied += int(c[:, 0].sum())
         self.global_stats.updates_installed += int(c[:, 1].sum())
         self.global_stats.send_queue_length = sum(len(p) for p in self.pending)
+        if self.store is not None:
+            # owner-reconciled GLOBAL state writes through (reference fires
+            # OnChange inside the owner's getLocalRateLimit on the GLOBAL
+            # apply path too); bc is lazy — only materialized here
+            from gubernator_tpu.store import ChangeSet
+
+            flat = lambda x: np.asarray(x).reshape(-1)
+            active = flat(bc.active)
+            rows = np.nonzero(active)[0]
+            if rows.size:
+                self.store.on_change(
+                    ChangeSet(
+                        fps=flat(bc.fp)[rows],
+                        created_at=now,
+                        algo=flat(bc.algo)[rows],
+                        status=flat(bc.status)[rows].astype(np.int32),
+                        limit=flat(bc.limit)[rows],
+                        remaining=flat(bc.remaining)[rows],
+                        reset_time=flat(bc.reset_time)[rows],
+                        duration=flat(bc.duration)[rows],
+                        burst=flat(bc.burst)[rows],
+                        stamp=flat(bc.stamp)[rows],
+                    )
+                )

@@ -130,32 +130,25 @@ class ShardedEngine:
         requests: Sequence[RateLimitRequest],
         now_ms: Optional[int] = None,
     ) -> List[RateLimitResponse]:
+        """Object-API wrapper over the columns fast path (same shape as
+        LocalEngine.check) so the Store write-through/rehydrate contract
+        holds on BOTH serving surfaces."""
         if not requests:
             return []
-        now = now_ms if now_ms is not None else ms_now()
-        hb, errors = pack_requests(requests, now, tolerance_ms=self.created_at_tolerance_ms)
-        out: List[Optional[RateLimitResponse]] = [None] * len(requests)
-        for i, err in enumerate(errors):
-            if err is not None:
-                out[i] = RateLimitResponse(error=err)
-        for p in plan_passes(hb, max_exact=self.max_exact_passes):
-            resp_rows, resp_vals = self._dispatch(p.batch)
-            status, limit, remaining, reset, dropped = resp_vals
-            for bi, orig in enumerate(p.rows):
-                r = RateLimitResponse(
-                    status=int(status[bi]),
-                    limit=int(limit[bi]),
-                    remaining=int(remaining[bi]),
-                    reset_time=int(reset[bi]),
-                    error=ERR_NOT_PERSISTED if dropped[bi] else "",
-                )
-                if p.member_rows:
-                    for row in p.member_rows[bi]:
-                        out[int(row)] = r
-                else:
-                    out[int(orig)] = r
-        self.stats.checks += len(requests)
-        return out  # type: ignore[return-value]
+        from gubernator_tpu.ops.batch import columns_from_requests
+
+        cols = columns_from_requests(requests)
+        rc = self.check_columns(cols, now_ms=now_ms)
+        return [
+            RateLimitResponse(
+                status=int(rc.status[i]),
+                limit=int(rc.limit[i]),
+                remaining=int(rc.remaining[i]),
+                reset_time=int(rc.reset_time[i]),
+                error=ERROR_STRINGS[int(rc.err[i])],
+            )
+            for i in range(len(requests))
+        ]
 
     # ----------------------------------------------- daemon serving surface
     # The same columns-in/columns-out API as LocalEngine, so the daemon's
@@ -183,13 +176,21 @@ class ShardedEngine:
         reset_time: np.ndarray,
         duration: np.ndarray,
         now_ms: Optional[int] = None,
+        burst: Optional[np.ndarray] = None,
+        stamp: Optional[np.ndarray] = None,
     ) -> int:
         """Install owner-authoritative GLOBAL statuses, routed to each
-        fingerprint's owning shard (UpdatePeerGlobals receive path)."""
+        fingerprint's owning shard (UpdatePeerGlobals receive path).
+        `burst`/`stamp` default to the wire path's lossy rebuild (cf.
+        LocalEngine.install_columns)."""
         now = now_ms if now_ms is not None else ms_now()
         n = fp.shape[0]
         if n == 0:
             return 0
+        if burst is None:
+            burst = np.asarray(limit, dtype=np.int64)
+        if stamp is None:
+            stamp = np.full(n, now, dtype=np.int64)
         D = self.n_shards
         routed = shard_of(fp, D)
         order, rs, offset, b_local = _route_plan(routed, D)
@@ -209,6 +210,8 @@ class ShardedEngine:
             duration=grid(duration, np.int64),
             now=grid(np.full(n, now, dtype=np.int64), np.int64),
             active=grid(np.ones(n, dtype=bool), bool),
+            burst=grid(burst, np.int64),
+            stamp=grid(stamp, np.int64),
         )
         inst = jax.tree.map(
             lambda x: jax.device_put(x, self._batch_sharding), inst
@@ -292,14 +295,16 @@ class ShardedEngine:
         remaining = np.asarray(resp.remaining)[rs, offset_in_shard]
         reset = np.asarray(resp.reset_time)[rs, offset_in_shard]
         dropped = np.asarray(resp.dropped)[rs, offset_in_shard]
+        hit = np.asarray(resp.cache_hit)[rs, offset_in_shard]
         inv = np.empty(n, dtype=np.int64)
         inv[order] = np.arange(n)
-        status, limit, remaining, reset, dropped = (
-            status[inv], limit[inv], remaining[inv], reset[inv], dropped[inv]
+        status, limit, remaining, reset, dropped, hit = (
+            status[inv], limit[inv], remaining[inv], reset[inv], dropped[inv],
+            hit[inv],
         )
         if dropped.any() and depth < 3:
             rows = np.nonzero(dropped)[0]
-            _, (s2, l2, r2, t2, d2) = self._dispatch(
+            _, (s2, l2, r2, t2, d2, h2) = self._dispatch(
                 _subset(batch, rows),
                 depth=depth + 1,
                 shard=routed[rows] if shard is not None else None,
@@ -307,14 +312,15 @@ class ShardedEngine:
             )
             status = status.copy(); limit = limit.copy()
             remaining = remaining.copy(); reset = reset.copy()
-            dropped = dropped.copy()
+            dropped = dropped.copy(); hit = hit.copy()
             status[rows], limit[rows], remaining[rows], reset[rows] = s2, l2, r2, t2
             dropped[rows] = d2
+            hit[rows] = h2
         elif dropped.any():
             # exhausted retries: decision was never persisted — callers
             # surface ERR_NOT_PERSISTED per item instead of failing open
             self.stats.dropped += int(dropped.sum())
-        return np.arange(n), (status, limit, remaining, reset, dropped)
+        return np.arange(n), (status, limit, remaining, reset, dropped, hit)
 
 
 def _route_plan(routed: np.ndarray, D: int):

@@ -8,10 +8,16 @@ when asked (reference store.go:49-78, workers.go:335-540). The TPU analogs:
   written to disk; restore = one host→device put. The reference streams
   CacheItems one by one through channels; here the state array IS the cache,
   so checkpointing is a bulk array copy — structurally simpler and faster.
-* Store = a host-side hook invoked with batch-level change sets after each
-  dispatch (fingerprints only — the device holds state; embedders needing the
-  full mapping keep their own key→fp index, since raw keys never reach the
-  device by design, hashing.py).
+* Store = a host-side write-through hook with the reference's full contract
+  (store.go:63-78, algorithms.go:45-51): after every dispatch `on_change`
+  receives the per-key stored state (algo/status/limit/remaining/reset/
+  duration — the same schema UpdatePeerGlobals installs from), and on a
+  device-reported cache miss the engine consults `get_many` and re-hydrates
+  found entries into the table before the decision stands — so evicted or
+  restart-lost items warm back from a durable store exactly like the
+  reference's `Store.Get` path. Keys are fingerprints (raw keys never reach
+  the device, hashing.py); embedders mapping back to names keep a key→fp
+  index.
 """
 
 from __future__ import annotations
@@ -56,20 +62,42 @@ def load_snapshot(path: str) -> np.ndarray:
 
 @dataclass
 class ChangeSet:
-    """One dispatch's worth of state changes, host-visible form."""
+    """One dispatch's worth of state changes: parallel per-key arrays (one
+    row per unique fingerprint, the LAST occurrence's state when a batch hits
+    a key several times). The schema matches UpdatePeerGlobals installs —
+    sufficient to reconstruct the item (reference store.go:29-43)."""
 
     fps: np.ndarray  # int64 fingerprints touched
     created_at: int  # dispatch timestamp (ms)
+    algo: Optional[np.ndarray] = None  # int32 Algorithm per row
+    status: Optional[np.ndarray] = None  # int32 UNDER/OVER_LIMIT
+    limit: Optional[np.ndarray] = None  # int64
+    remaining: Optional[np.ndarray] = None  # int64
+    reset_time: Optional[np.ndarray] = None  # int64 ms
+    duration: Optional[np.ndarray] = None  # int64 ms
+    burst: Optional[np.ndarray] = None  # int64 (leaky burst; limit default)
+    stamp: Optional[np.ndarray] = None  # int64 ms item UpdatedAt/CreatedAt
 
 
 class Store:
     """Write-through hook interface (reference store.go:63-78). Subclass and
-    pass to LocalEngine/daemon wiring; `on_change` fires after every dispatch
-    with the touched fingerprints. `get`/`remove` have no device analog —
-    misses are resolved by the table itself — but exist for interface parity
-    with embedders porting reference Store implementations."""
+    pass to LocalEngine/daemon wiring. `on_change` fires after every dispatch
+    with per-key stored state; `get_many` is consulted for fingerprints the
+    device reported as cache misses (evicted/expired/restart-lost) — found
+    rows are re-hydrated into the table and the decision re-applied against
+    them (reference algorithms.go:45-51). `remove` exists for interface
+    parity; the engine never calls it (expiry is lazy on-device)."""
 
     def on_change(self, change: ChangeSet) -> None:  # pragma: no cover
+        pass
+
+    def get_many(self, fps: np.ndarray, now_ms: int):  # pragma: no cover
+        """Return None (no hydration) or a dict of parallel arrays over
+        `fps`: {found: bool, algo, status, limit, remaining, reset_time,
+        duration} — rows with found=False are ignored."""
+        return None
+
+    def remove(self, fp: int) -> None:  # pragma: no cover
         pass
 
 
@@ -131,3 +159,57 @@ class RecordingStore(Store):
     @property
     def touched_fps(self) -> set:
         return {int(fp) for c in self.changes for fp in c.fps}
+
+
+class DictStore(Store):
+    """Durable-store mock with the FULL reference contract (store.go:80-150):
+    `on_change` writes per-key state through to a host dict, `get_many`
+    serves it back for evicted/lost keys. Tests and embedders use this to
+    exercise evict-then-rehydrate (reference store_test.go:127)."""
+
+    def __init__(self):
+        # fp → (algo, status, limit, remaining, reset, duration, burst, stamp)
+        self.rows: dict = {}
+        self.get_calls = 0
+        self.hydrated = 0
+
+    def on_change(self, change: ChangeSet) -> None:
+        for i in range(change.fps.shape[0]):
+            self.rows[int(change.fps[i])] = (
+                int(change.algo[i]),
+                int(change.status[i]),
+                int(change.limit[i]),
+                int(change.remaining[i]),
+                int(change.reset_time[i]),
+                int(change.duration[i]),
+                int(change.burst[i]),
+                int(change.stamp[i]),
+            )
+
+    def get_many(self, fps: np.ndarray, now_ms: int):
+        self.get_calls += 1
+        n = fps.shape[0]
+        found = np.zeros(n, dtype=bool)
+        cols = np.zeros((8, n), dtype=np.int64)
+        for i in range(n):
+            row = self.rows.get(int(fps[i]))
+            if row is not None:
+                found[i] = True
+                cols[:, i] = row
+        if not found.any():
+            return None
+        self.hydrated += int(found.sum())
+        return dict(
+            found=found,
+            algo=cols[0].astype(np.int32),
+            status=cols[1].astype(np.int32),
+            limit=cols[2],
+            remaining=cols[3],
+            reset_time=cols[4],
+            duration=cols[5],
+            burst=cols[6],
+            stamp=cols[7],
+        )
+
+    def remove(self, fp: int) -> None:
+        self.rows.pop(int(fp), None)

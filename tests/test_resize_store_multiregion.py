@@ -105,6 +105,138 @@ def test_store_on_change_receives_persisted_fingerprints(frozen_now):
     assert sorted(changes[0].fps.tolist()) == want  # invalid row excluded
 
 
+def test_store_change_set_carries_per_key_state(frozen_now):
+    """on_change delivers reconstructible stored state, last occurrence per
+    key (reference OnChange carries the CacheItem, store.go:66-70)."""
+    from gubernator_tpu.hashing import fingerprint
+    from gubernator_tpu.store import RecordingStore
+
+    store = RecordingStore()
+    eng = LocalEngine(capacity=256, store=store)
+    eng.check(
+        [req("a", hits=4, limit=10), req("b", hits=2, limit=20),
+         req("a", hits=1, limit=10)],
+        now_ms=frozen_now,
+    )
+    assert len(store.changes) == 1
+    c = store.changes[0]
+    by_fp = {int(c.fps[i]): i for i in range(c.fps.shape[0])}
+    ia = by_fp[fingerprint("t", "a")]
+    ib = by_fp[fingerprint("t", "b")]
+    assert c.remaining[ia] == 5  # last occurrence: 10 - 4 - 1
+    assert c.remaining[ib] == 18
+    assert c.limit[ia] == 10 and c.duration[ia] == MINUTE
+    assert c.algo[ia] == int(Algorithm.TOKEN_BUCKET)
+
+
+def test_store_rehydrates_state_lost_to_restart(frozen_now):
+    """A fresh engine (no snapshot) consults the Store on its device miss and
+    re-applies the request against the hydrated item (reference
+    algorithms.go:45-51: cache miss → Store.Get → warm from DB)."""
+    from gubernator_tpu.store import DictStore
+
+    store = DictStore()
+    eng = LocalEngine(capacity=256, store=store)
+    eng.check(
+        [RateLimitRequest(name="t", unique_key="a", hits=4, limit=10,
+                          duration=MINUTE)],
+        now_ms=frozen_now,
+    )
+    eng2 = LocalEngine(capacity=256, store=store)  # restart, empty table
+    out = eng2.check(
+        [RateLimitRequest(name="t", unique_key="a", hits=1, limit=10,
+                          duration=MINUTE)],
+        now_ms=frozen_now + 1_000,
+    )
+    assert out[0].error == ""
+    assert out[0].remaining == 5  # 10 - 4 (hydrated) - 1, NOT a fresh 9
+    assert store.hydrated == 1
+
+
+def test_store_rehydrate_preserves_custom_leaky_burst(frozen_now):
+    """The ChangeSet carries the real burst: rehydrating a custom-burst leaky
+    bucket must NOT trip the burst-changed upgrade path (math.py burst
+    refresh) and fail open to full burst."""
+    from gubernator_tpu.store import DictStore
+
+    def lreq(hits):
+        return RateLimitRequest(
+            name="t", unique_key="lb", hits=hits, limit=10, burst=20,
+            duration=MINUTE, algorithm=Algorithm.LEAKY_BUCKET,
+        )
+
+    store = DictStore()
+    eng = LocalEngine(capacity=256, store=store)
+    (r,) = eng.check([lreq(15)], now_ms=frozen_now)
+    assert r.remaining == 5  # burst 20 - 15
+    eng2 = LocalEngine(capacity=256, store=store)  # restart
+    (r,) = eng2.check([lreq(1)], now_ms=frozen_now)
+    assert r.remaining == 4  # hydrated 5 - 1, NOT burst-refreshed to 19
+
+
+def test_store_rehydrate_accrues_leak_since_write(frozen_now):
+    """The ChangeSet carries the item's UpdatedAt stamp: refill accrued
+    between the store write and the rehydrate is honored, matching a live
+    engine (the reference CacheItem round-trips UpdatedAt through Store.Get)."""
+    from gubernator_tpu.store import DictStore
+
+    def lreq(hits, created_at):
+        return RateLimitRequest(
+            name="t", unique_key="lk", hits=hits, limit=10, duration=MINUTE,
+            algorithm=Algorithm.LEAKY_BUCKET, created_at=created_at,
+        )
+
+    store = DictStore()
+    eng = LocalEngine(capacity=256, store=store)
+    (r,) = eng.check([lreq(10, frozen_now)], now_ms=frozen_now)
+    assert r.remaining == 0  # drained
+    t2 = frozen_now + 30_000  # half a duration later: 5 tokens leaked back
+    eng2 = LocalEngine(capacity=256, store=store)  # restart
+    (r,) = eng2.check([lreq(0, t2)], now_ms=t2)
+    assert r.remaining == 5  # NOT 0: refill since the stored stamp counts
+
+
+def test_store_evict_then_rehydrate(frozen_now):
+    """The reference's durable-store headline (store_test.go:127): an
+    unexpired item evicted under bucket pressure re-hydrates from the Store
+    on its next request instead of restarting from a fresh bucket."""
+    from gubernator_tpu.ops.batch import RequestColumns
+    from gubernator_tpu.ops.table2 import K
+    from gubernator_tpu.store import DictStore
+
+    store = DictStore()
+    eng = LocalEngine(capacity=256, store=store)
+    NB = eng.table.rows.shape[0]
+
+    def cols(fps, hits, limit, duration):
+        n = len(fps)
+        return RequestColumns(
+            fp=np.asarray(fps, dtype=np.int64),
+            algo=np.zeros(n, dtype=np.int32),
+            behavior=np.zeros(n, dtype=np.int32),
+            hits=np.full(n, hits, dtype=np.int64),
+            limit=np.full(n, limit, dtype=np.int64),
+            burst=np.zeros(n, dtype=np.int64),
+            duration=np.full(n, duration, dtype=np.int64),
+            created_at=np.full(n, frozen_now, dtype=np.int64),
+            err=np.zeros(n, dtype=np.int8),
+        )
+
+    victim = 7 + NB  # bucket 7
+    fillers = [7 + i * NB for i in range(2, K + 3)]  # K+1 more, same bucket
+    rc = eng.check_columns(cols([victim], hits=4, limit=10, duration=MINUTE))
+    assert rc.remaining[0] == 6
+    # fillers expire LATER than the victim → the full bucket evicts the
+    # soonest-expiring slot: the victim, while still live
+    rc = eng.check_columns(cols(fillers, hits=1, limit=10, duration=2 * MINUTE))
+    assert (rc.err == 0).all()
+    assert eng.stats.evicted_unexpired >= 1
+    rc = eng.check_columns(cols([victim], hits=1, limit=10, duration=MINUTE))
+    assert rc.err[0] == 0
+    assert rc.remaining[0] == 5  # hydrated 6, minus this hit
+    assert store.hydrated >= 1
+
+
 # ------------------------------------------------------------ multi-region
 
 
