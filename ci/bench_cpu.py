@@ -1,0 +1,65 @@
+"""Small deterministic CPU benchmark for the CI regression gate.
+
+The reference gates PRs on a relative benchmark regression (±200% vs master,
+reference .github/workflows/on-pull-request.yml:47-80). CI runners have no
+TPU, so the gate measures the XLA-CPU lowering of the same serving path
+(LocalEngine.check_columns → decision kernel, scatter write): base and PR
+trees run in the SAME job and only their ratio matters — machine speed
+cancels out.
+
+Prints one JSON line: {"decisions_per_sec": N}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import gubernator_tpu  # noqa: F401,E402  (x64 on)
+from gubernator_tpu.ops.batch import RequestColumns
+from gubernator_tpu.ops.engine import LocalEngine
+
+NOW = 1_700_000_000_000
+B = 4096
+
+
+def cols(fp: np.ndarray) -> RequestColumns:
+    n = fp.shape[0]
+    return RequestColumns(
+        fp=fp,
+        algo=(np.arange(n) % 2).astype(np.int32),
+        behavior=np.zeros(n, dtype=np.int32),
+        hits=np.ones(n, dtype=np.int64),
+        limit=np.full(n, 1 << 20, dtype=np.int64),
+        burst=np.zeros(n, dtype=np.int64),
+        duration=np.full(n, 3_600_000, dtype=np.int64),
+        created_at=np.full(n, NOW, dtype=np.int64),
+        err=np.zeros(n, dtype=np.int8),
+    )
+
+
+def main() -> None:
+    eng = LocalEngine(capacity=1 << 15, write_mode="xla")
+    rng = np.random.default_rng(0)
+    fps = [
+        rng.integers(1, (1 << 63) - 1, size=B, dtype=np.int64) for _ in range(4)
+    ]
+    for f in fps:  # compile + seed
+        eng.check_columns(cols(f), now_ms=NOW)
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        n_disp = 64
+        for i in range(n_disp):
+            eng.check_columns(cols(fps[i % 4]), now_ms=NOW)
+        dt = time.perf_counter() - t0
+        best = max(best, n_disp * B / dt)
+    print(json.dumps({"decisions_per_sec": round(best, 1)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+#!/usr/bin/env python3
+"""Generate a shared CA + server keypair for the TLS compose cluster / local
+mTLS experiments (the reference ships a pre-generated corpus in
+contrib/certs/; generating on demand keeps private keys out of git).
+
+    python contrib/certs/gen_certs.py [outdir] [san ...]
+
+Writes ca.pem, server.pem, server.key. Default SANs cover the compose node
+hostnames and localhost.
+"""
+
+import sys
+
+sys.path.insert(0, ".")  # repo root invocation
+
+from gubernator_tpu.service.tls import generate_self_signed  # noqa: E402
+
+
+def main() -> None:
+    import os
+
+    outdir = sys.argv[1] if len(sys.argv) > 1 else "contrib/certs"
+    sans = sys.argv[2:] or [
+        "node-1", "node-2", "node-3", "node-4", "localhost", "127.0.0.1",
+    ]
+    bundle = generate_self_signed(tuple(sans))
+    os.makedirs(outdir, exist_ok=True)
+    for name, data in (
+        ("ca.pem", bundle.ca_pem),
+        ("server.pem", bundle.cert_pem),
+        ("server.key", bundle.key_pem),
+    ):
+        path = os.path.join(outdir, name)
+        with open(path, "wb") as f:
+            f.write(data)
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -117,6 +117,11 @@ class DaemonConfig:
 
     grpc_address: str = "localhost:1051"
     http_address: str = "localhost:1050"
+    # optional extra HTTP listener serving /metrics + health ONLY, and (when
+    # TLS is on) WITHOUT requiring client certificates — so probes and
+    # scrapers work in mTLS clusters (reference HTTPStatusListenAddress,
+    # daemon.go:324-352)
+    status_http_address: str = ""
     advertise_address: str = ""  # defaults to grpc_address
     data_center: str = ""
     instance_id: str = ""
@@ -252,6 +257,7 @@ def setup_daemon_config(
     conf = DaemonConfig(
         grpc_address=_get(env, "GUBER_GRPC_ADDRESS", "localhost:1051"),
         http_address=_get(env, "GUBER_HTTP_ADDRESS", "localhost:1050"),
+        status_http_address=_get(env, "GUBER_STATUS_HTTP_ADDRESS", ""),
         advertise_address=_get(env, "GUBER_ADVERTISE_ADDRESS", ""),
         data_center=_get(env, "GUBER_DATA_CENTER", ""),
         instance_id=_get(env, "GUBER_INSTANCE_ID", ""),

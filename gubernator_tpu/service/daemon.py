@@ -140,8 +140,10 @@ class Daemon:
         self._pool = None  # discovery pool
         self.grpc_port: Optional[int] = None
         self.http_port: Optional[int] = None
+        self.status_http_port: Optional[int] = None
         self._client_creds = None  # set by TLS setup
         self._cert_watch_task = None
+        self._http_ssl_contexts = []  # live HTTPS listener contexts
 
     # ---------------------------------------------------------------- spawn
     @classmethod
@@ -272,6 +274,19 @@ class Daemon:
                 await asyncio.gather(
                     *(c.shutdown() for c in old.values()), return_exceptions=True
                 )
+                # HTTPS listeners share long-lived SSLContexts: reload the
+                # chain in place so new handshakes serve the rotated pair
+                # (gRPC reloads per-handshake; these must not lag behind)
+                for ctx in self._http_ssl_contexts:
+                    try:
+                        ctx.load_cert_chain(
+                            self.conf.tls_cert_file, self.conf.tls_key_file
+                        )
+                    except Exception:
+                        log.warning(
+                            "HTTP listener certificate reload failed; "
+                            "keeping the current pair"
+                        )
                 log.info("TLS certificates rotated; peer channels re-dialed")
             except asyncio.CancelledError:
                 raise

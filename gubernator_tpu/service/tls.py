@@ -196,8 +196,13 @@ def client_credentials(conf) -> grpc.ChannelCredentials:
     return grpc.ssl_channel_credentials(root_certificates=b.ca_pem or None)
 
 
-def http_ssl_context(conf) -> Optional[ssl.SSLContext]:
-    """Server-side ssl context for the HTTP gateway listener."""
+def http_ssl_context(
+    conf, require_client_auth: Optional[bool] = None
+) -> Optional[ssl.SSLContext]:
+    """Server-side ssl context for an HTTP listener. `require_client_auth`
+    defaults to the daemon's client-auth mode; the status listener passes
+    False so probes/scrapers work without certs (reference
+    daemon.go:324-352)."""
     b = bundle_from_config(conf)
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
     with tempfile.NamedTemporaryFile(suffix=".pem") as cf, tempfile.NamedTemporaryFile(
@@ -208,4 +213,18 @@ def http_ssl_context(conf) -> Optional[ssl.SSLContext]:
         kf.write(b.key_pem)
         kf.flush()
         ctx.load_cert_chain(cf.name, kf.name)
+    require = (
+        conf.tls_client_auth in ("require", "verify")
+        if require_client_auth is None
+        else require_client_auth
+    )
+    if require:
+        if not b.ca_pem:
+            # never silently downgrade: the operator asked for client auth
+            raise ValueError(
+                "tls_client_auth is set but no CA is available to verify "
+                "client certificates (set GUBER_TLS_CA or use GUBER_TLS_AUTO)"
+            )
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        ctx.load_verify_locations(cadata=b.ca_pem.decode())
     return ctx

@@ -241,6 +241,76 @@ async def test_auto_tls_daemon():
 
 
 @async_test
+async def test_tls_http_gateway_and_status_listener(tmp_path):
+    """With TLS on, the HTTP gateway serves HTTPS under the daemon's
+    client-auth mode, and the separate status listener serves health +
+    /metrics over TLS WITHOUT client certs (reference
+    HTTPStatusListenAddress, daemon.go:150-155, 324-352) — previously /v1
+    JSON and /metrics left the host in the clear while gRPC was mTLS."""
+    import ssl
+
+    import aiohttp
+
+    from gubernator_tpu.service.daemon import Daemon
+    from gubernator_tpu.service.tls import generate_self_signed
+
+    bundle = generate_self_signed(("127.0.0.1",))
+    ca = tmp_path / "ca.pem"; ca.write_bytes(bundle.ca_pem)
+    crt = tmp_path / "crt.pem"; crt.write_bytes(bundle.cert_pem)
+    key = tmp_path / "key.pem"; key.write_bytes(bundle.key_pem)
+
+    conf = daemon_config(
+        tls_ca_file=str(ca), tls_cert_file=str(crt), tls_key_file=str(key),
+        tls_client_auth="verify", status_http_address="127.0.0.1:0",
+    )
+    d = await Daemon.spawn(conf)
+    try:
+        gw = f"https://{d.conf.http_address}"
+        status = f"https://{d.conf.status_http_address}"
+        trust = ssl.create_default_context(cadata=bundle.ca_pem.decode())
+        trust.check_hostname = False
+        mtls = ssl.create_default_context(cadata=bundle.ca_pem.decode())
+        mtls.check_hostname = False
+        mtls.load_cert_chain(str(crt), str(key))
+
+        async with aiohttp.ClientSession() as s:
+            # status listener: CA-trust only, no client cert → works
+            async with s.get(f"{status}/metrics", ssl=trust) as r:
+                assert r.status == 200
+                assert b"gubernator_" in await r.read()
+            async with s.get(f"{status}/v1/HealthCheck", ssl=trust) as r:
+                assert r.status == 200
+            # the status listener has NO rate-limit surface
+            async with s.post(
+                f"{status}/v1/GetRateLimits", json={"requests": []}, ssl=trust
+            ) as r:
+                assert r.status == 404
+            # main gateway: requires a client certificate
+            with pytest.raises(aiohttp.ClientError):
+                async with s.get(f"{gw}/metrics", ssl=trust) as r:
+                    await r.read()
+            # with the client cert, the full JSON surface works over TLS
+            async with s.post(
+                f"{gw}/v1/GetRateLimits",
+                json={"requests": [{"name": "t", "unique_key": "h",
+                                    "hits": 1, "limit": 5,
+                                    "duration": 60000}]},
+                ssl=mtls,
+            ) as r:
+                assert r.status == 200
+                body = await r.json()
+                assert body["responses"][0]["remaining"] == "4"
+            # plaintext against the TLS gateway fails
+            with pytest.raises(aiohttp.ClientError):
+                async with s.get(
+                    f"http://{d.conf.http_address}/metrics"
+                ) as r:
+                    await r.read()
+    finally:
+        await d.close()
+
+
+@async_test
 async def test_mtls_cluster_forwards_between_peers(tmp_path):
     """mTLS (client_auth=verify): two daemons share a CA-signed cert from
     files; forwarding works peer-to-peer over mutual TLS, and a client
