@@ -95,11 +95,12 @@ class Case:
     a long pipelined run (the tunneled axon platform has no true
     block_until_ready, so completion is forced by fetching a scalar)."""
 
-    def __init__(self, name, capacity, batches, seed_batches=None):
+    def __init__(self, name, capacity, batches, seed_batches=None, seed_iter=None):
         self.name = name
         self.table = new_table2(capacity)
         self.batches = batches
         self.seed_batches = seed_batches if seed_batches is not None else batches
+        self.seed_iter = seed_iter  # lazy seeding for huge keyspaces
         self.last_stats = None
 
     def dispatch(self, b):
@@ -108,7 +109,7 @@ class Case:
 
     def run(self, dispatches=48, latency_probes=24):
         t0 = time.perf_counter()
-        for b in self.seed_batches:
+        for b in self.seed_iter() if self.seed_iter else self.seed_batches:
             stats = self.dispatch(b)
         _ = int(stats.cache_hits)
         log(f"[{self.name}] compile+seed: {time.perf_counter() - t0:.1f}s")
@@ -253,6 +254,53 @@ def config4_case(rng, now) -> Case:
     return Case("config4-mixed-flags-1M", 1 << 21, batches)
 
 
+def config5_case(rng, now) -> Case:
+    """BASELINE config #5 scale, single chip: 100M live keys in an 8 GiB
+    packed-row table (134M slots, 16.7M bucket rows). The Pallas sweep
+    streams the WHOLE table per dispatch (~26 ms at 8 GiB), so throughput at
+    this scale comes from amortization: a 2^20-row batch measured 8.5M
+    decisions/s on v5e vs 3.8M at the 2^17 sweet spot of the 1 GiB table
+    (exp/exp_bigtable.py). Seeding streams 100M keys in 96 dispatches
+    without staging them on host/device."""
+    CAPACITY = 1 << 27
+    LIVE = 100_000_000
+    BATCH = 1 << 20
+    keyspace = rng.integers(1, (1 << 63) - 1, size=LIVE, dtype=np.int64)
+    # 8 distinct staged batches without materializing a 100M permutation:
+    # oversample indices, unique, trim (distinct fps per batch is the
+    # kernel's unique-fingerprint contract)
+    idx = np.unique(rng.integers(0, LIVE, size=BATCH * 10, dtype=np.int64))
+    idx = rng.permutation(idx)[: BATCH * 8]
+    assert idx.shape[0] == BATCH * 8
+    batches = [
+        jax.device_put(
+            make_req_batch(keyspace[idx[i * BATCH : (i + 1) * BATCH]], now,
+                           limit=1 << 20, duration=3_600_000)
+        )
+        for i in range(8)
+    ]
+
+    def seed_iter():
+        t0 = time.perf_counter()
+        for i in range(0, LIVE, BATCH):
+            chunk = keyspace[i : i + BATCH]
+            if chunk.shape[0] < BATCH:
+                chunk = np.pad(chunk, (0, BATCH - chunk.shape[0]))
+            if i and i % (BATCH * 32) == 0:
+                log(
+                    f"[config5-100M] seeded {i:,}/{LIVE:,} "
+                    f"({time.perf_counter() - t0:.0f}s)"
+                )
+            b = make_req_batch(chunk, now, limit=1 << 20, duration=3_600_000)
+            if (chunk == 0).any():
+                # padded tail rows must be inactive (fp=0 is the empty-slot
+                # sentinel, cf. config1/config2 masking)
+                b = b._replace(active=jnp.asarray(chunk != 0))
+            yield jax.device_put(b)
+
+    return Case("config5-100M", CAPACITY, batches, seed_iter=seed_iter)
+
+
 def sweep_parity_smoke(rng, now):
     """Real-TPU check that the Pallas sweep write produces the same table and
     responses as the XLA scatter write. Returns True/False, or "skipped" on
@@ -392,6 +440,18 @@ def main() -> None:
                 res["decisions_per_sec"] * scale, 1
             )
         matrix[case.name] = res
+
+    if jax.default_backend() == "tpu":
+        # BASELINE #5 scale needs the real chip's HBM (8 GiB table); runs
+        # last so every other case's memory is already released, and must
+        # never sink the headline
+        try:
+            case = config5_case(rng, now)
+            matrix[case.name] = case.run(dispatches=24, latency_probes=6)
+            del case
+        except Exception as exc:
+            log(f"[config5-100M] FAILED: {type(exc).__name__}: {exc}")
+            matrix["config5-100M"] = {"error": str(exc)[:200]}
 
     dps = headline["decisions_per_sec"]
     matrix["headline-10M"] = headline

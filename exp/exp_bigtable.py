@@ -1,0 +1,112 @@
+"""Measure dispatch cost at BASELINE #5 scale (100M keys, 8 GiB table) on a
+real chip, across batch sizes — is the full-table sweep amortizable, or does
+the big table need a banked write? (VERDICT r3 weak #6)
+
+Run: python exp/exp_bigtable.py [capacity_log2=27] [live=100e6]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+import gubernator_tpu  # noqa: F401
+import jax
+
+from gubernator_tpu.ops.batch import ReqBatch
+from gubernator_tpu.ops.kernel2 import decide2
+from gubernator_tpu.ops.table2 import new_table2
+
+import jax.numpy as jnp
+
+CAP_LOG2 = int(sys.argv[1]) if len(sys.argv) > 1 else 27
+LIVE = int(float(sys.argv[2])) if len(sys.argv) > 2 else 100_000_000
+NOW = 1_700_000_000_000
+
+
+def make_batch(fps: np.ndarray) -> ReqBatch:
+    b = fps.shape[0]
+    return ReqBatch(
+        fp=jnp.asarray(fps),
+        algo=jnp.zeros(b, dtype=jnp.int32),
+        behavior=jnp.zeros(b, dtype=jnp.int32),
+        hits=jnp.ones(b, dtype=jnp.int64),
+        limit=jnp.full(b, 1 << 30, dtype=jnp.int64),
+        burst=jnp.full(b, 1 << 30, dtype=jnp.int64),
+        duration=jnp.full(b, 3_600_000, dtype=jnp.int64),
+        created_at=jnp.full(b, NOW, dtype=jnp.int64),
+        expire_new=jnp.full(b, NOW + 3_600_000, dtype=jnp.int64),
+        greg_interval=jnp.zeros(b, dtype=jnp.int64),
+        duration_eff=jnp.full(b, 3_600_000, dtype=jnp.int64),
+        active=jnp.ones(b, dtype=bool),
+    )
+
+
+def main():
+    cap = 1 << CAP_LOG2
+    table = new_table2(cap)
+    nb = table.rows.shape[0]
+    print(f"table: {cap} slots, {nb} buckets, {nb * 512 / 2**30:.1f} GiB")
+    rng = np.random.default_rng(0)
+    keyspace = rng.integers(1, (1 << 63) - 1, size=LIVE, dtype=np.int64)
+
+    # seed all live keys, streaming (no staging of 100M rows on device)
+    SEED_B = 1 << 19
+    t0 = time.perf_counter()
+    stats = None
+    for i in range(0, LIVE, SEED_B):
+        chunk = keyspace[i : i + SEED_B]
+        if chunk.shape[0] < SEED_B:
+            chunk = np.pad(chunk, (0, SEED_B - chunk.shape[0]))
+        b = jax.device_put(make_batch(chunk))
+        table, resp, stats = decide2(table, b, write="sweep")
+        if i % (SEED_B * 32) == 0 and stats is not None:
+            _ = int(stats.cache_hits)  # periodic sync to bound queueing
+            print(
+                f"  seeded {i + SEED_B:>11,} / {LIVE:,} "
+                f"({time.perf_counter() - t0:.0f}s)", flush=True,
+            )
+    evic = int(stats.evicted_unexpired)
+    print(f"seeding done in {time.perf_counter() - t0:.0f}s")
+
+    import os
+
+    blogs = [int(x) for x in os.environ.get("BLOGS", "17,18,19").split(",")]
+    table2 = table  # donated through every dispatch below — never reuse `table`
+    for BLOG in blogs:
+        B = 1 << BLOG
+        perm = rng.permutation(LIVE)[: B * 8]
+        batches = [
+            jax.device_put(make_batch(keyspace[perm[j * B : (j + 1) * B]]))
+            for j in range(8)
+        ]
+        # warm compile
+        for b in batches[:2]:
+            table2, resp, stats = decide2(table2, b, write="sweep")
+        _ = int(stats.cache_hits)
+
+        def run(k):
+            nonlocal table2
+            t0 = time.perf_counter()
+            for i in range(k):
+                table2, resp, stats = decide2(
+                    table2, batches[i % 8], write="sweep"
+                )
+            _ = int(stats.cache_hits)
+            return time.perf_counter() - t0, stats
+
+        run(2)
+        t_short = min(run(4)[0] for _ in range(3))
+        k_long = 4 + 64
+        t_long, stats = min(run(k_long) for _ in range(3))
+        dt = t_long - t_short
+        dps = 64 * B / dt
+        print(
+            f"batch 2^{BLOG} ({B}): {dt/64*1e3:.2f} ms/dispatch, "
+            f"{dps/1e6:.2f}M decisions/s; hits={int(stats.cache_hits)} "
+            f"misses={int(stats.cache_misses)} evict={evic}", flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()
