@@ -1,14 +1,15 @@
 """Etcd peer discovery — register self under a key prefix with a kept-alive
-lease; poll the prefix for the peer set.
+lease; watch the prefix for the peer set.
 
 Mirrors reference etcd.go:221-315: each node PUTs its PeerInfo JSON at
 `<prefix><advertise-address>` bound to a TTL lease (30 s default), keeps the
 lease alive at TTL/2 cadence, re-grants + re-registers if the lease is lost,
 and on close deletes its key and revokes the lease so peers see it disappear
-immediately. Peer changes surface by polling a prefix range read (the
-reference uses a gRPC watch stream; a poll at sub-TTL cadence observes the
-same transitions — registration and lease-expiry — without holding a stream
-open).
+immediately. Peer changes surface through a **watch stream** on the prefix
+(reference etcd.go:173-219) — each event triggers a fresh range read, so
+membership changes propagate at event latency, not poll cadence; the range
+poll stays on as a low-cadence fallback that also observes lease expiry
+through an outage of the stream.
 
 Speaks etcd's v3 HTTP/JSON gateway (`/v3/kv/*`, `/v3/lease/*`; keys/values
 are base64 in JSON), so no etcd client library is required; the endpoint is
@@ -75,10 +76,18 @@ class EtcdPool:
         self._tasks: List[asyncio.Task] = []
         self._closed = False
         self._last: Optional[List[str]] = None
+        # serializes _poll_once between the watch and poll loops: without it
+        # a slow stale range read can land after a fresher watch-triggered
+        # one and re-publish an outdated peer list
+        self._poll_lock = asyncio.Lock()
 
     @property
     def _key(self) -> str:
         return self.key_prefix + self.peer_info.grpc_address
+
+    def _prefix_range_end(self) -> str:
+        """etcd successor key covering everything under the prefix."""
+        return self.key_prefix[:-1] + chr(ord(self.key_prefix[-1]) + 1)
 
     async def _post(self, path: str, body: dict) -> dict:
         async with self._session.post(
@@ -135,7 +144,7 @@ class EtcdPool:
                 "/v3/kv/range",
                 {
                     "key": _b64(self.key_prefix),
-                    "range_end": _b64(self.key_prefix[:-1] + chr(ord(self.key_prefix[-1]) + 1)),
+                    "range_end": _b64(self._prefix_range_end()),
                 },
             )
         except Exception:
@@ -158,6 +167,10 @@ class EtcdPool:
             await asyncio.sleep(self.poll_s)
 
     async def _poll_once(self) -> None:
+        async with self._poll_lock:
+            await self._poll_once_locked()
+
+    async def _poll_once_locked(self) -> None:
         peers = await self._collect_peers()
         if peers is None:
             return
@@ -169,6 +182,48 @@ class EtcdPool:
             info.is_owner = info.grpc_address == self.peer_info.grpc_address
         self.on_update(list(peers.values()))
 
+    async def _watch_loop(self) -> None:
+        """Hold a watch stream on the key prefix (reference etcd.go:173-219,
+        via the v3 gateway's server-streaming /v3/watch). Events are change
+        NOTIFIERS: each one triggers a range re-read, so watch-vs-state
+        consistency never depends on replaying incremental events. Reconnects
+        with backoff; the poll loop covers any stream outage."""
+        body = {
+            "create_request": {
+                "key": _b64(self.key_prefix),
+                "range_end": _b64(self._prefix_range_end()),
+            }
+        }
+        backoff = 0.05
+        while not self._closed:
+            try:
+                async with self._session.post(
+                    f"{self.endpoint}/v3/watch",
+                    json=body,
+                    timeout=aiohttp.ClientTimeout(total=None),
+                ) as resp:
+                    resp.raise_for_status()
+                    backoff = 0.05
+                    async for line in resp.content:
+                        if self._closed:
+                            return
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            msg = json.loads(line)
+                        except ValueError:
+                            continue
+                        if msg.get("result", {}).get("events"):
+                            await self._poll_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                if self._closed:
+                    return
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> None:
         self._session = aiohttp.ClientSession()
@@ -177,6 +232,7 @@ class EtcdPool:
         self._tasks = [
             asyncio.create_task(self._keepalive_loop(), name="etcd-keepalive"),
             asyncio.create_task(self._poll_loop(), name="etcd-poll"),
+            asyncio.create_task(self._watch_loop(), name="etcd-watch"),
         ]
 
     async def close(self) -> None:

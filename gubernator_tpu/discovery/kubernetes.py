@@ -7,12 +7,14 @@ pod IP, and rebuild the peer list on every change. Not-ready endpoints are
 skipped UNLESS they are self — a booting pod must still see itself
 (kubernetes.go:281-289).
 
-Speaks the Kubernetes REST API directly over aiohttp (list + resourceVersion
-poll; the reference's SharedIndexInformer is a cached watch, and a poll at
-informer-resync-like cadence observes the same membership transitions), so no
-kubernetes client library is required. In-cluster config comes from the
-standard service-account mount; the API URL/token are injectable and tests
-run an in-process fake API server.
+Speaks the Kubernetes REST API directly over aiohttp with **list + watch**
+(the reference's SharedIndexInformer pattern, kubernetes.go:79-114): the list
+records a resourceVersion, a watch stream from that version turns every
+ADDED/MODIFIED/DELETED event into a fresh list+extract, and a low-cadence
+poll remains as the informer-resync fallback. No kubernetes client library
+is required. In-cluster config comes from the standard service-account
+mount; the API URL/token are injectable and tests run an in-process fake
+API server.
 """
 
 from __future__ import annotations
@@ -118,8 +120,13 @@ class K8sPool:
         self._ca_file = ca_file
         self._session: Optional[aiohttp.ClientSession] = None
         self._task: Optional[asyncio.Task] = None
+        self._watch_task: Optional[asyncio.Task] = None
         self._closed = False
         self._last: Optional[List[str]] = None
+        self._rv: str = ""  # list resourceVersion the watch resumes from
+        # serializes _poll_once between the watch and resync loops (a stale
+        # in-flight list must not clobber a fresher watch-triggered update)
+        self._poll_lock = asyncio.Lock()
 
     def _in_cluster(self) -> None:
         """Default to the standard in-cluster config (env + SA mount)."""
@@ -160,11 +167,18 @@ class K8sPool:
             ) as resp:
                 resp.raise_for_status()
                 body = await resp.json()
+                rv = (body.get("metadata") or {}).get("resourceVersion")
+                if rv:
+                    self._rv = rv
                 return body.get("items", [])
         except Exception:
             return None  # keep the stale peer list over a transient API error
 
     async def _poll_once(self) -> None:
+        async with self._poll_lock:
+            await self._poll_once_locked()
+
+    async def _poll_once_locked(self) -> None:
         items = await self._list()
         if items is None:
             return
@@ -190,6 +204,67 @@ class K8sPool:
             except Exception:
                 log.exception("k8s poll failed")
 
+    async def _watch_loop(self) -> None:
+        """list+watch (reference kubernetes.go:79-114 informer pattern): a
+        watch stream from the last list's resourceVersion; every membership
+        event triggers a fresh list+extract, so propagation is event-latency
+        while correctness never depends on replaying incremental events.
+        Reconnects with backoff; the resync poll covers stream outages."""
+        import json
+
+        backoff = 0.05
+        while not self._closed:
+            try:
+                params = {"watch": "1"}
+                if self.selector:
+                    params["labelSelector"] = self.selector
+                if self._rv:
+                    params["resourceVersion"] = self._rv
+                headers = (
+                    {"Authorization": f"Bearer {self._token}"}
+                    if self._token
+                    else {}
+                )
+                async with self._session.get(
+                    f"{self._api_url}{self._path}",
+                    params=params,
+                    headers=headers,
+                    timeout=aiohttp.ClientTimeout(total=None),
+                ) as resp:
+                    resp.raise_for_status()
+                    backoff = 0.05
+                    async for line in resp.content:
+                        if self._closed:
+                            return
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            ev = json.loads(line)
+                        except ValueError:
+                            continue
+                        obj = ev.get("object") or {}
+                        rv = (obj.get("metadata") or {}).get("resourceVersion")
+                        if rv:
+                            self._rv = rv
+                        if ev.get("type") in ("ADDED", "MODIFIED", "DELETED"):
+                            await self._poll_once()
+                        elif ev.get("type") == "ERROR":
+                            self._rv = ""  # expired RV: next watch relists
+                            break
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                if self._closed:
+                    return
+                # the resourceVersion may be the reason the watch was
+                # rejected (HTTP 410 on an expired RV); drop it so the next
+                # attempt starts from current state instead of retrying a
+                # dead version forever
+                self._rv = ""
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+
     async def start(self) -> None:
         self._in_cluster()
         ssl_ctx = None
@@ -200,14 +275,18 @@ class K8sPool:
         )
         await self._poll_once()
         self._task = asyncio.create_task(self._loop(), name="k8s-pool")
+        self._watch_task = asyncio.create_task(
+            self._watch_loop(), name="k8s-watch"
+        )
 
     async def close(self) -> None:
         self._closed = True
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+        for t in (self._task, self._watch_task):
+            if t is not None:
+                t.cancel()
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
         if self._session is not None:
             await self._session.close()

@@ -136,6 +136,7 @@ class FakeEtcd:
         self.kv = {}  # key(str) -> (value str, lease id)
         self.leases = {}  # id -> expires_at (loop time)
         self.next_lease = 7000
+        self.watchers = []  # asyncio.Queue per open watch stream
         self.app = web.Application()
         self.app.router.add_post("/v3/kv/put", self.put)
         self.app.router.add_post("/v3/kv/range", self.range)
@@ -143,8 +144,31 @@ class FakeEtcd:
         self.app.router.add_post("/v3/lease/grant", self.grant)
         self.app.router.add_post("/v3/lease/keepalive", self.keepalive)
         self.app.router.add_post("/v3/lease/revoke", self.revoke)
+        self.app.router.add_post("/v3/watch", self.watch)
         self.runner = None
         self.url = ""
+
+    def _notify(self):
+        for q in list(self.watchers):
+            q.put_nowait({"type": "PUT"})
+
+    async def watch(self, req):
+        resp = web.StreamResponse()
+        await resp.prepare(req)
+        q = asyncio.Queue()
+        self.watchers.append(q)
+        await resp.write(b'{"result":{"created":true}}\n')
+        try:
+            while True:
+                ev = await q.get()
+                await resp.write(
+                    json.dumps({"result": {"events": [ev]}}).encode() + b"\n"
+                )
+        except (asyncio.CancelledError, ConnectionResetError):
+            pass
+        finally:
+            self.watchers.remove(q)
+        return resp
 
     def _gc(self):
         now = asyncio.get_running_loop().time()
@@ -162,6 +186,7 @@ class FakeEtcd:
         key = base64.b64decode(b["key"]).decode()
         val = base64.b64decode(b["value"]).decode()
         self.kv[key] = (val, b.get("lease"))
+        self._notify()
         return web.json_response({})
 
     async def range(self, req):
@@ -183,6 +208,7 @@ class FakeEtcd:
         b = await req.json()
         key = base64.b64decode(b["key"]).decode()
         self.kv.pop(key, None)
+        self._notify()
         return web.json_response({})
 
     async def grant(self, req):
@@ -204,6 +230,7 @@ class FakeEtcd:
         b = await req.json()
         self.leases.pop(int(b["ID"]), None)
         self._gc()
+        self._notify()
         return web.json_response({})
 
     async def start(self):
@@ -295,6 +322,58 @@ async def test_etcd_pool_lease_expiry_drops_dead_peer():
         await fake.stop()
 
 
+@async_test
+async def test_etcd_watch_propagates_membership_sub_poll():
+    """Membership changes ride the watch stream, not the poll cadence
+    (reference etcd.go:173-219): with polling effectively disabled, a
+    register and a deregister both propagate in well under the poll
+    interval."""
+    from gubernator_tpu.discovery.etcd import EtcdPool
+
+    fake = FakeEtcd()
+    await fake.start()
+    seen = {}
+
+    def cb(peers):
+        seen["p"] = sorted(p.grpc_address for p in peers)
+
+    pool = EtcdPool(
+        fake.url,
+        on_update=cb,
+        peer_info=PeerInfo(grpc_address="127.0.0.1:1"),
+        poll_ms=60_000.0,  # the poller cannot be the one propagating
+    )
+    pool2 = EtcdPool(
+        fake.url,
+        on_update=lambda ps: None,
+        peer_info=PeerInfo(grpc_address="127.0.0.1:2"),
+        poll_ms=60_000.0,
+    )
+    try:
+        await pool.start()
+        await wait_until(lambda: seen.get("p") == ["127.0.0.1:1"], timeout_s=5)
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        await pool2.start()  # registers → watch event → re-range
+        await wait_until(
+            lambda: seen.get("p") == ["127.0.0.1:1", "127.0.0.1:2"],
+            timeout_s=5,
+            interval_s=0.005,
+        )
+        assert loop.time() - t0 < 2.0  # event latency, not the 60 s poll
+        t0 = loop.time()
+        await pool2.close()  # deletes its key → watch event
+        await wait_until(
+            lambda: seen.get("p") == ["127.0.0.1:1"],
+            timeout_s=5,
+            interval_s=0.005,
+        )
+        assert loop.time() - t0 < 2.0
+    finally:
+        await pool.close()
+        await fake.stop()
+
+
 # ------------------------------------------------------------------------ k8s
 
 
@@ -365,21 +444,34 @@ def test_extract_peers_from_pods():
     ]
 
 
-@async_test
-async def test_k8s_pool_against_fake_api():
-    from gubernator_tpu.discovery.kubernetes import K8sPool
-
-    state = {
-        "items": [
-            _slice([{"addresses": ["10.0.0.1"], "conditions": {"ready": True}}])
-        ]
-    }
+async def _fake_k8s_api(state):
+    """In-process API server: list + watch on endpointslices. Returns
+    (url, runner, notify) — notify() pushes a watch event to open streams."""
+    state.setdefault("watchers", [])
+    state.setdefault("rv", 7)
     app = web.Application()
 
     async def endpointslices(req):
         assert req.headers.get("Authorization") == "Bearer test-token"
         assert req.query.get("labelSelector") == "app=gubernator"
-        return web.json_response({"items": state["items"]})
+        if req.query.get("watch"):
+            resp = web.StreamResponse()
+            await resp.prepare(req)
+            q = asyncio.Queue()
+            state["watchers"].append(q)
+            try:
+                while True:
+                    ev = await q.get()
+                    await resp.write(json.dumps(ev).encode() + b"\n")
+            except (asyncio.CancelledError, ConnectionResetError):
+                pass
+            finally:
+                state["watchers"].remove(q)
+            return resp
+        return web.json_response(
+            {"items": state["items"],
+             "metadata": {"resourceVersion": str(state["rv"])}}
+        )
 
     app.router.add_get(
         "/apis/discovery.k8s.io/v1/namespaces/default/endpointslices",
@@ -390,6 +482,28 @@ async def test_k8s_pool_against_fake_api():
     site = web.TCPSite(runner, "127.0.0.1", 0)
     await site.start()
     url = f"http://127.0.0.1:{runner.addresses[0][1]}"
+
+    def notify():
+        state["rv"] += 1
+        for q in list(state["watchers"]):
+            q.put_nowait(
+                {"type": "MODIFIED",
+                 "object": {"metadata": {"resourceVersion": str(state["rv"])}}}
+            )
+
+    return url, runner, notify
+
+
+@async_test
+async def test_k8s_pool_against_fake_api():
+    from gubernator_tpu.discovery.kubernetes import K8sPool
+
+    state = {
+        "items": [
+            _slice([{"addresses": ["10.0.0.1"], "conditions": {"ready": True}}])
+        ]
+    }
+    url, runner, _notify = await _fake_k8s_api(state)
 
     seen = {}
     pool = K8sPool(
@@ -413,6 +527,52 @@ async def test_k8s_pool_against_fake_api():
         await wait_until(
             lambda: seen.get("p") == ["10.0.0.1:1051", "10.0.0.2:1051"]
         )
+    finally:
+        await pool.close()
+        await runner.cleanup()
+
+
+@async_test
+async def test_k8s_watch_propagates_membership_sub_poll():
+    """Membership changes ride the list+watch stream, not the resync poll
+    (reference kubernetes.go:79-114 informer): with polling effectively
+    disabled, an endpoint change propagates at event latency."""
+    from gubernator_tpu.discovery.kubernetes import K8sPool
+
+    state = {
+        "items": [
+            _slice([{"addresses": ["10.0.0.1"], "conditions": {"ready": True}}])
+        ]
+    }
+    url, runner, notify = await _fake_k8s_api(state)
+    seen = {}
+    pool = K8sPool(
+        on_update=lambda ps: seen.__setitem__(
+            "p", sorted(p.grpc_address for p in ps)
+        ),
+        pod_ip="10.0.0.1",
+        pod_port="1051",
+        selector="app=gubernator",
+        api_url=url,
+        token="test-token",
+        poll_ms=60_000.0,  # the resync poll cannot be the one propagating
+    )
+    try:
+        await pool.start()
+        await wait_until(lambda: seen.get("p") == ["10.0.0.1:1051"])
+        await wait_until(lambda: state["watchers"], timeout_s=5)
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        state["items"][0]["endpoints"].append(
+            {"addresses": ["10.0.0.2"], "conditions": {"ready": True}}
+        )
+        notify()  # watch event → list+extract
+        await wait_until(
+            lambda: seen.get("p") == ["10.0.0.1:1051", "10.0.0.2:1051"],
+            timeout_s=5,
+            interval_s=0.005,
+        )
+        assert loop.time() - t0 < 2.0  # event latency, not the 60 s resync
     finally:
         await pool.close()
         await runner.cleanup()
