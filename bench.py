@@ -330,12 +330,24 @@ def sweep_parity_smoke(rng, now):
 
 
 def e2e_serving_case() -> dict:
-    """End-to-end serving: a real daemon (gRPC listener, batching front door,
-    engine on this device) driven by the async client over loopback —
-    the reference's headline is server-level req/s (README.md:131-154).
-    On the tunneled axon platform each dispatch pays a ~100 ms fetch RTT, so
-    this number is a LOWER bound for a co-located TPU host (where the fetch
-    is microseconds); the kernel-side ceiling is the headline metric."""
+    """End-to-end serving: a real daemon (gRPC listener, pipelined batching
+    front door, engine on this device) driven by the async client over
+    loopback — the reference's headline is server-level req/s
+    (README.md:131-154). The front door keeps ≤6 dispatches in flight
+    (prepare → issue → fetch overlapped); per-stage means are scraped from
+    the daemon's own gubernator_tpu_stage_duration summaries.
+
+    On the tunneled axon platform every device put/launch/fetch pays a
+    ~30-130 ms RTT, so this number is a LOWER bound for a co-located TPU
+    host. Co-located p99 < 2 ms budget (BASELINE north star), argued from
+    the measured stages with tunnel RTTs replaced by on-device costs:
+    parse 0.2 ms + window 0.5 ms + put ~0.2 ms (PCIe-class transfer of one
+    packed (12,B) array) + issue ~0.3 ms + device compute 0.3-1 ms at ≤16K
+    rows (config1 measured 0.31 ms/dispatch on-device) + fetch ~0.3 ms (one
+    packed output array) + encode 0.1 ms ≈ 1.6-2.1 ms request time — with
+    coalesce_limit tuned down (≤8K rows) the device term halves and p99
+    lands under 2 ms while one chip still serves ~5-10M checks/s through
+    the door."""
     import asyncio
 
     from gubernator_tpu.client import V1Client
@@ -343,7 +355,11 @@ def e2e_serving_case() -> dict:
     from gubernator_tpu.proto import gubernator_pb2 as pb
     from gubernator_tpu.service.daemon import Daemon
 
-    CLIENTS = 16
+    # closed-loop clients: offered load = CLIENTS × BATCH rows outstanding.
+    # The pipelined front door (issue/compute/fetch overlapped, ≤4 in-flight
+    # dispatches) absorbs 64 concurrent requests; r3's serial door saturated
+    # at 16.
+    CLIENTS = 64
     BATCH = 1000  # the wire cap (MAX_BATCH_SIZE)
     SECONDS = 12.0
 
@@ -352,7 +368,7 @@ def e2e_serving_case() -> dict:
             grpc_address="127.0.0.1:0",
             http_address="",
             cache_size=1 << 20,
-            behaviors=BehaviorConfig(batch_wait_ms=2.0),
+            behaviors=BehaviorConfig(batch_wait_ms=2.0, pipeline_inflight=6),
         )
         d = await Daemon.spawn(conf)
         client = V1Client(d.conf.grpc_address, timeout_s=120.0)
@@ -395,6 +411,18 @@ def e2e_serving_case() -> dict:
         deadline = t0 + SECONDS
         await asyncio.gather(*(worker(c) for c in range(CLIENTS)))
         elapsed = time.perf_counter() - t0
+        # per-stage pipeline breakdown (mean ms) from the daemon's own
+        # stage_duration summaries — where a request's time actually goes
+        from gubernator_tpu.service.metrics import parse_metrics
+
+        scraped = parse_metrics(d.metrics.render().decode())
+        stages = {}
+        for st in ("parse", "queue", "put", "issue", "fetch", "encode"):
+            key = (("stage", st),)
+            cnt = scraped.get("gubernator_tpu_stage_duration_count", {}).get(key)
+            tot = scraped.get("gubernator_tpu_stage_duration_sum", {}).get(key)
+            if cnt:
+                stages[st] = round(tot / cnt * 1e3, 3)
         await client.close()
         await d.close()
         arr = np.asarray(sorted(lat)) * 1e3
@@ -404,6 +432,7 @@ def e2e_serving_case() -> dict:
             "batch": BATCH,
             "request_p50_ms": round(float(np.percentile(arr, 50)), 2),
             "request_p99_ms": round(float(np.percentile(arr, 99)), 2),
+            "stage_mean_ms": stages,
         }
 
     out = asyncio.run(run())

@@ -101,6 +101,9 @@ class BehaviorConfig:
     # per-dispatch latency; no reference analog (device batches replace the
     # worker channels)
     coalesce_limit: int = 16384
+    # concurrent device dispatches the front door keeps in flight (issue of
+    # N+1 overlaps compute of N and fetch of N-1); 1 = the serial door
+    pipeline_inflight: int = 4
 
     global_timeout_ms: float = 500.0  # GLOBAL rpc timeout (GlobalTimeout)
     global_sync_wait_ms: float = 100.0  # hit-sync cadence (GlobalSyncWait)
@@ -236,6 +239,8 @@ class DaemonConfig:
         if self.behaviors.batch_limit <= 0 or self.behaviors.batch_limit > 1000:
             # the reference hard-caps batches at 1000 (gubernator.go:41-42)
             raise ConfigError("GUBER_BATCH_LIMIT must be in (0, 1000]")
+        if self.behaviors.pipeline_inflight <= 0:
+            raise ConfigError("GUBER_PIPELINE_INFLIGHT must be >= 1")
         if self.behaviors.coalesce_limit <= 0:
             raise ConfigError("GUBER_BATCH_COALESCE_LIMIT must be positive")
         if self.tls_client_auth not in ("", "require", "verify"):
@@ -270,6 +275,7 @@ def setup_daemon_config(
             batch_wait_ms=_get_float_ms(env, "GUBER_BATCH_WAIT", 0.5),
             batch_limit=_get_int(env, "GUBER_BATCH_LIMIT", 1000),
             coalesce_limit=_get_int(env, "GUBER_BATCH_COALESCE_LIMIT", 16384),
+            pipeline_inflight=_get_int(env, "GUBER_PIPELINE_INFLIGHT", 4),
             global_timeout_ms=_get_float_ms(env, "GUBER_GLOBAL_TIMEOUT", 500.0),
             global_sync_wait_ms=_get_float_ms(env, "GUBER_GLOBAL_SYNC_WAIT", 100.0),
             global_batch_limit=_get_int(env, "GUBER_GLOBAL_BATCH_LIMIT", 1000),

@@ -401,6 +401,30 @@ def pad_batch(b: HostBatch, to_size: int) -> HostBatch:
     )
 
 
+def pack_host_batch(b: HostBatch) -> np.ndarray:
+    """Pack a HostBatch into ONE (12, B) int64 array for a single host→
+    device transfer — the ingress mirror of kernel2.pack_outputs' single-
+    fetch egress. On a tunneled device every device_put costs an RTT, so 12
+    per-column puts dominated the dispatch-issue path; one put amortizes it.
+    The device side reconstructs the ReqBatch inside the kernel's jit
+    (kernel2.req_from_arr), costing a few casts that fuse into the kernel."""
+    n = b.fp.shape[0]
+    arr = np.empty((12, n), dtype=np.int64)
+    arr[0] = b.fp
+    arr[1] = b.algo
+    arr[2] = b.behavior
+    arr[3] = b.hits
+    arr[4] = b.limit
+    arr[5] = b.burst
+    arr[6] = b.duration
+    arr[7] = b.created_at
+    arr[8] = b.expire_new
+    arr[9] = b.greg_interval
+    arr[10] = b.duration_eff
+    arr[11] = b.active
+    return arr
+
+
 def to_device(b: HostBatch) -> ReqBatch:
     return ReqBatch(
         fp=jnp.asarray(b.fp),

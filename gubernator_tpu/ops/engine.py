@@ -28,10 +28,15 @@ from gubernator_tpu.ops.batch import (
     ResponseColumns,
     columns_from_requests,
     pack_columns,
+    pack_host_batch,
     pad_batch,
     to_device,
 )
-from gubernator_tpu.ops.kernel2 import decide2_packed, install2, pack_outputs
+from gubernator_tpu.ops.kernel2 import (
+    decide2_packed_cols,
+    install2,
+    pack_outputs,
+)
 from gubernator_tpu.ops.plan import plan_passes
 from gubernator_tpu.ops.table2 import Table2, new_table2
 from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
@@ -82,6 +87,18 @@ class EngineStats:
         self.evicted_unexpired += int(stats.evicted_unexpired)
         if count_dropped:
             self.dropped += int(stats.dropped)
+
+    def merge(self, d: "EngineStats") -> None:
+        """Fold a pipelined check's stats delta in (applied on the engine
+        thread so counter updates never race the dispatch path)."""
+        self.cache_hits += d.cache_hits
+        self.cache_misses += d.cache_misses
+        self.over_limit += d.over_limit
+        self.evicted_unexpired += d.evicted_unexpired
+        self.dropped += d.dropped
+        self.checks += d.checks
+        self.dispatches += d.dispatches
+        self.created_at_clamped += d.created_at_clamped
 
 
 def serve_columns(engine, cols, now_ms, dispatch) -> ResponseColumns:
@@ -207,6 +224,127 @@ def _rehydrate_misses(engine, batch, n: int, outs, now: int, dispatch):
     return s, l, r, t, dropped, hit
 
 
+class PendingCheck:
+    """In-flight pipelined check: every pass's kernel dispatch has been
+    ISSUED (device arrays pending) but nothing fetched yet. Produced on the
+    engine thread by `issue_check_columns` (after `prepare_check_columns`
+    staged the single-transfer ingress arrays off-thread), consumed on a
+    fetch thread by `finish_check_columns` — the split that lets host pack +
+    transfer of dispatch N+1 overlap device execution and fetch of N."""
+
+    __slots__ = ("hb", "err", "now", "passes", "clamped")
+
+    def __init__(self, hb, err, now, passes, clamped):
+        self.hb = hb
+        self.err = err
+        self.now = now
+        self.passes = passes  # [(Pass, n_rows, padded HostBatch, dev arr)]
+        self.clamped = clamped
+
+
+def prepare_check_columns(engine, cols, now_ms=None) -> PendingCheck:
+    """Preparation half of the pipelined serving path (any thread — touches
+    no engine state): pack, clamp, plan same-key passes, and stage each
+    pass's SINGLE packed ingress array on-device (one transfer per pass,
+    batch.pack_host_batch)."""
+    import jax
+
+    now = now_ms if now_ms is not None else ms_now()
+    hb, err = pack_columns(cols, now, tolerance_ms=engine.created_at_tolerance_ms)
+    clamped = int(
+        ((cols.created_at != 0) & (hb.created_at != cols.created_at)).sum()
+    )
+    passes = []
+    for p in plan_passes(hb, max_exact=engine.max_exact_passes):
+        n = len(p.rows)
+        batch = pad_batch(p.batch, _pad_size(n))
+        dev = jax.device_put(pack_host_batch(batch))
+        passes.append([p, n, batch, dev])
+    return PendingCheck(hb=hb, err=err, now=now, passes=passes, clamped=clamped)
+
+
+def issue_check_columns(engine, pending: PendingCheck) -> PendingCheck:
+    """Engine-thread half: launch every staged pass WITHOUT fetching.
+    Later passes depend only on device state, not fetched outputs, so the
+    whole chain enqueues back-to-back; each entry's staged ingress array is
+    replaced by its pending packed output."""
+    for entry in pending.passes:
+        _p, _n, batch, dev = entry
+        engine._seen_pad_sizes.add(int(batch.fp.shape[0]))
+        entry[3] = engine._issue_from_dev(dev, int(batch.fp.shape[0]))
+    return pending
+
+
+def finish_check_columns(
+    engine, pending: PendingCheck, fixup
+) -> "tuple[ResponseColumns, EngineStats]":
+    """Fetch-thread half: materialize each pass's packed output and assemble
+    the response. The rare feedback path — claim drops needing a re-dispatch
+    — runs through `fixup(fn)`, which executes fn ON THE ENGINE THREAD and
+    returns its result (table mutations stay single-writer). Returns the
+    response plus a stats delta for the caller to apply on the engine
+    thread. Store-configured engines never take this path (EngineRunner
+    routes them to the serial one): the Store contract needs rehydrates and
+    write-throughs ordered against every same-key dispatch, which a
+    pipeline with interleaved chunks cannot guarantee."""
+    hb, err, now = pending.hb, pending.err, pending.now
+    n = hb.fp.shape[0]
+    status = np.zeros(n, dtype=np.int32)
+    limit_o = np.zeros(n, dtype=np.int64)
+    remaining = np.zeros(n, dtype=np.int64)
+    reset = np.zeros(n, dtype=np.int64)
+    delta = EngineStats(created_at_clamped=pending.clamped, checks=n)
+    for pi, (p, np_, batch, dev) in enumerate(pending.passes):
+        arr = np.asarray(dev)
+        delta.cache_hits += int(arr[-2, 0])
+        delta.cache_misses += int(arr[-2, 1])
+        delta.over_limit += int(arr[-2, 2])
+        delta.evicted_unexpired += int(arr[-2, 3])
+        delta.dispatches += 1
+        l = arr[:np_, 0].copy()
+        r = arr[:np_, 1].copy()
+        t = arr[:np_, 2].copy()
+        s = (arr[:np_, 3] & 1).astype(np.int32)
+        hit = (arr[:np_, 3] & 2) != 0
+        dropped = (arr[:np_, 3] & 4) != 0
+        if dropped.any():
+            # contended-claim retries mutate the table → engine thread;
+            # _redispatch_rows counts dispatches/evictions only, exactly
+            # like the sync path's retry loop
+            rows = np.nonzero(dropped)[0]
+
+            def retry(rows=rows, batch=batch):
+                sub = HostBatch(*[f[rows] for f in batch])
+                return engine._redispatch_rows(
+                    pad_batch(sub, _pad_size(len(rows))), len(rows)
+                )
+
+            s2, l2, r2, t2, d2, h2 = fixup(retry)
+            s[rows], l[rows], r[rows], t[rows] = s2, l2, r2, t2
+            dropped[rows] = d2
+            hit[rows] = h2
+        if p.member_rows:
+            members = np.concatenate(p.member_rows)
+            src = np.repeat(np.arange(np_), [len(m) for m in p.member_rows])
+            status[members] = s[src]
+            limit_o[members] = l[src]
+            remaining[members] = r[src]
+            reset[members] = t[src]
+            err[members[dropped[src]]] = ERR_DROPPED
+        else:
+            rows = p.rows
+            status[rows] = s[:np_]
+            limit_o[rows] = l[:np_]
+            remaining[rows] = r[:np_]
+            reset[rows] = t[:np_]
+            err[rows[dropped[:np_]]] = ERR_DROPPED
+    rc = ResponseColumns(
+        status=status, limit=limit_o, remaining=remaining,
+        reset_time=reset, err=err,
+    )
+    return rc, delta
+
+
 class LocalEngine:
     """One device-resident rate-limit table + its dispatch loop.
 
@@ -216,6 +354,7 @@ class LocalEngine:
     """
 
     supports_grow = True  # resize()/maybe_grow() are real (cf. ShardedEngine)
+    supports_pipeline = True  # prepare/issue/finish split
 
     def __init__(
         self,
@@ -230,6 +369,9 @@ class LocalEngine:
         self.table = table if table is not None else new_table2(capacity)
         self.write_mode = write_mode or default_write_mode()
         self._decide_fn = decide_fn
+        # oracle engines return unpacked outputs; the begin/finish split
+        # assumes the packed single-fetch layout
+        self.supports_pipeline = decide_fn is None
         self.max_exact_passes = max_exact_passes
         self.max_claim_retries = 3
         # per-engine clock-skew bound; None = the ops.batch process default
@@ -241,17 +383,67 @@ class LocalEngine:
         self.stats = EngineStats()
         self._seen_pad_sizes: set = set()  # compiled batch shapes (for resize warm)
 
-    def _decide_packed(self, rb) -> np.ndarray:
-        """One dispatch → ONE host fetch: the packed (B+2, 4) i64 output
+    def _decide_packed(self, hb: HostBatch) -> np.ndarray:
+        """One dispatch → ONE host transfer each way: packed (12, B) ingress
+        array in (batch.pack_host_batch), packed (B+2, 4) i64 output fetched
         (kernel2.pack_outputs). Updates self.table; returns the host array."""
+        import jax
+
         if self._decide_fn is not None:
             # oracle engines return unpacked outputs; pack on device for the
             # same downstream shape
-            self.table, resp, stats = self._decide_fn(self.table, rb)
+            self.table, resp, stats = self._decide_fn(self.table, to_device(hb))
             return np.asarray(pack_outputs(resp, stats))
-        write = self._write_mode_for(rb.fp.shape[0])
-        self.table, packed = decide2_packed(self.table, rb, write=write)
+        dev = jax.device_put(pack_host_batch(hb))
+        write = self._write_mode_for(hb.fp.shape[0])
+        self.table, packed = decide2_packed_cols(self.table, dev, write=write)
         return np.asarray(packed)
+
+    def _issue_from_dev(self, dev_arr, batch_rows: int) -> "jax.Array":
+        """Issue one dispatch from a staged ingress array WITHOUT fetching:
+        the table advances immediately; the packed output is fetched later
+        on a fetch thread while this thread launches the next dispatch."""
+        write = self._write_mode_for(batch_rows)
+        self.table, packed = decide2_packed_cols(self.table, dev_arr, write=write)
+        return packed
+
+    def _redispatch_rows(self, batch, n: int):
+        """Re-dispatch rows whose phase-1 claim dropped (pipelined retry):
+        accounts dispatches/evictions/final drops only — hits/misses/over
+        were already counted by the dropped phase-1 pass, exactly like the
+        sync path's retry loop."""
+        arr = self._decide_packed(batch)
+        self.stats.dispatches += 1
+        self.stats.evicted_unexpired += int(arr[-2, 3])
+        limit = arr[:n, 0].copy()
+        remaining = arr[:n, 1].copy()
+        reset = arr[:n, 2].copy()
+        status = (arr[:n, 3] & 1).astype(np.int32)
+        hit = (arr[:n, 3] & 2) != 0
+        dropped = (arr[:n, 3] & 4) != 0
+        # this first dispatch already IS retry #1 of the dropped phase-1
+        # rows, so the loop allows max_claim_retries-1 more — same total
+        # attempt budget as the sync path
+        retries = 1
+        while dropped.any() and retries < self.max_claim_retries:
+            rows = np.nonzero(dropped)[0]
+            sub = HostBatch(*[f[:n][rows] for f in batch])
+            sub = pad_batch(sub, _pad_size(len(rows)))
+            arr = self._decide_packed(sub)
+            self.stats.dispatches += 1
+            self.stats.evicted_unexpired += int(arr[-2, 3])
+            m = len(rows)
+            limit[rows] = arr[:m, 0]
+            remaining[rows] = arr[:m, 1]
+            reset[rows] = arr[:m, 2]
+            status[rows] = (arr[:m, 3] & 1).astype(np.int32)
+            hit[rows] = (arr[:m, 3] & 2) != 0
+            nd = np.zeros(n, dtype=bool)
+            nd[rows] = (arr[:m, 3] & 4) != 0
+            dropped = nd
+            retries += 1
+        self.stats.dropped += int(dropped.sum())
+        return status, limit, remaining, reset, dropped, hit
 
     def _write_mode_for(self, batch: int) -> str:
         """Pick the write strategy per dispatch. The Pallas sweep streams the
@@ -308,7 +500,7 @@ class LocalEngine:
         only authoritative once persisted. Rows still unpersisted after
         `max_claim_retries` surface a per-item error (`ERR_NOT_PERSISTED`)."""
         self._seen_pad_sizes.add(int(batch.fp.shape[0]))
-        arr = self._decide_packed(to_device(batch))
+        arr = self._decide_packed(batch)
         self.stats.cache_hits += int(arr[-2, 0])
         self.stats.cache_misses += int(arr[-2, 1])
         self.stats.over_limit += int(arr[-2, 2])
@@ -325,7 +517,7 @@ class LocalEngine:
             rows = np.nonzero(dropped)[0]
             sub = HostBatch(*[f[:n][rows] for f in batch])
             sub = pad_batch(sub, _pad_size(len(rows)))
-            arr = self._decide_packed(to_device(sub))
+            arr = self._decide_packed(sub)
             self.stats.dispatches += 1
             self.stats.evicted_unexpired += int(arr[-2, 3])
             m = len(rows)
@@ -463,7 +655,7 @@ class LocalEngine:
                 duration_eff=np.ones(size, dtype=np.int64),
                 active=np.zeros(size, dtype=bool),
             )
-            self._decide_packed(to_device(dummy))
+            self._decide_packed(dummy)
         return dropped
 
     def maybe_grow(

@@ -511,9 +511,38 @@ def decide2_packed_impl(
     return table, pack_outputs(resp, stats)
 
 
-decide2_packed = functools.partial(
+def req_from_arr(arr: jnp.ndarray) -> ReqBatch:
+    """Rebuild the ReqBatch from the single packed (12, B) int64 ingress
+    array (batch.pack_host_batch) — traced inside the kernel jit so the
+    casts fuse with the kernel instead of costing separate transfers."""
+    return ReqBatch(
+        fp=arr[0],
+        algo=arr[1].astype(i32),
+        behavior=arr[2].astype(i32),
+        hits=arr[3],
+        limit=arr[4],
+        burst=arr[5],
+        duration=arr[6],
+        created_at=arr[7],
+        expire_new=arr[8],
+        greg_interval=arr[9],
+        duration_eff=arr[10],
+        active=arr[11] != 0,
+    )
+
+
+def decide2_packed_cols_impl(
+    table: Table2, arr: jnp.ndarray, *, write: str = "sweep"
+) -> Tuple[Table2, jnp.ndarray]:
+    """Single-transfer serving entry: packed ingress array in, packed
+    output array out — one host→device put and one device→host fetch per
+    dispatch regardless of column count."""
+    return decide2_packed_impl(table, req_from_arr(arr), write=write)
+
+
+decide2_packed_cols = functools.partial(
     jax.jit, donate_argnums=(0,), static_argnames=("write",)
-)(decide2_packed_impl)
+)(decide2_packed_cols_impl)
 
 
 # -------------------------------------------------------------------- install

@@ -45,16 +45,22 @@ class Batcher:
         batch_wait_ms: float = 0.5,
         coalesce_limit: int = DEFAULT_COALESCE_LIMIT,
         metrics=None,
+        max_inflight: int = 4,
     ):
         self.runner = runner
         self.batch_wait_s = batch_wait_ms / 1e3
         self.coalesce_limit = coalesce_limit
         self.metrics = metrics
-        self._pending: List[Tuple[RequestColumns, asyncio.Future]] = []
+        self._pending: List[Tuple[RequestColumns, asyncio.Future, float]] = []
         self._pending_rows = 0
         self._wake: Optional[asyncio.Event] = None
         self._loop_task: Optional[asyncio.Task] = None
         self._closed = False
+        # pipelining: up to `max_inflight` dispatches run concurrently — the
+        # engine thread issues N+1 while N executes on-device and N-1's
+        # fetch streams back (host pack, device compute, fetch overlap)
+        self._inflight_sem = asyncio.Semaphore(max_inflight)
+        self._inflight: set = set()
 
     async def check(
         self, cols: RequestColumns, now_ms: Optional[int] = None
@@ -69,7 +75,7 @@ class Batcher:
         )
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self._pending.append((cols, fut))
+        self._pending.append((cols, fut, time.perf_counter()))
         self._pending_rows += cols.fp.shape[0]
         if self.metrics is not None:
             self.metrics.queue_length.set(self._pending_rows)
@@ -94,37 +100,62 @@ class Batcher:
             await self._flush()
 
     async def _flush(self) -> None:
-        pending = self._pending
-        self._pending = []
-        self._pending_rows = 0
-        if self.metrics is not None:
-            self.metrics.queue_length.set(0)
         # the coalesce limit is a real per-dispatch cap: flush in chunks of
         # whole enqueued batches (a single oversized enqueue dispatches
-        # alone), bounding dispatch latency and compile-shape spread
-        while pending:
-            chunk = [pending.pop(0)]
+        # alone), bounding dispatch latency and compile-shape spread. Chunks
+        # dispatch CONCURRENTLY up to the in-flight cap, and — crucially —
+        # each chunk forms AFTER its in-flight slot frees: requests arriving
+        # while every slot is busy keep coalescing into the next chunk, so
+        # backpressure produces FEWER, LARGER dispatches instead of a queue
+        # of tiny ones (the natural batching the serial design had).
+        while self._pending:
+            await self._inflight_sem.acquire()
+            if not self._pending:  # drained while waiting for the slot
+                self._inflight_sem.release()
+                return
+            chunk = [self._pending.pop(0)]
             rows = chunk[0][0].fp.shape[0]
-            while pending and rows + pending[0][0].fp.shape[0] <= self.coalesce_limit:
-                cols, fut = pending.pop(0)
-                chunk.append((cols, fut))
-                rows += cols.fp.shape[0]
+            while (
+                self._pending
+                and rows + self._pending[0][0].fp.shape[0] <= self.coalesce_limit
+            ):
+                entry = self._pending.pop(0)
+                chunk.append(entry)
+                rows += entry[0].fp.shape[0]
+            self._pending_rows -= rows
+            if self.metrics is not None:
+                self.metrics.queue_length.set(max(self._pending_rows, 0))
+            task = asyncio.get_running_loop().create_task(
+                self._dispatch_guarded(chunk)
+            )
+            self._inflight.add(task)
+            task.add_done_callback(self._inflight.discard)
+
+    async def _dispatch_guarded(self, chunk) -> None:
+        try:
             await self._dispatch(chunk)
+        finally:
+            self._inflight_sem.release()
 
     async def _dispatch(self, batch) -> None:
         t0 = time.perf_counter()
-        cat = concat_columns([c for c, _ in batch])
+        if self.metrics is not None:
+            oldest = min(ts for _, _, ts in batch)
+            self.metrics.stage_duration.labels(stage="queue").observe(
+                t0 - oldest
+            )
+        cat = concat_columns([c for c, _, _ in batch])
         try:
-            rc = await self.runner.check_columns(cat)
+            rc = await self.runner.check(cat)
         except Exception as exc:  # pragma: no cover - defensive
-            for _, fut in batch:
+            for _, fut, _ in batch:
                 if not fut.done():
                     fut.set_exception(exc)
             return
         if self.metrics is not None:
             self.metrics.batch_send_duration.observe(time.perf_counter() - t0)
         off = 0
-        for cols, fut in batch:
+        for cols, fut, _ in batch:
             n = cols.fp.shape[0]
             sl = slice(off, off + n)
             if not fut.done():
@@ -141,10 +172,12 @@ class Batcher:
 
     async def drain(self) -> None:
         """Stop the flush loop and flush anything pending (shutdown path).
-        Lets an in-flight flush finish rather than cancelling it — cancelled
-        flushes would strand their callers' futures."""
+        Lets in-flight dispatches finish rather than cancelling them —
+        cancelled dispatches would strand their callers' futures."""
         self._closed = True
         if self._loop_task is not None and not self._loop_task.done():
             self._wake.set()
             await self._loop_task
         await self._flush()
+        if self._inflight:
+            await asyncio.gather(*list(self._inflight), return_exceptions=True)

@@ -119,12 +119,17 @@ class Daemon:
                 created_at_tolerance_ms=int(conf.created_at_tolerance_ms),
                 store=store,
             )
-        self.runner = EngineRunner(self.engine, metrics=self.metrics)
+        self.runner = EngineRunner(
+            self.engine,
+            metrics=self.metrics,
+            fetch_workers=conf.behaviors.pipeline_inflight,
+        )
         self.batcher = Batcher(
             self.runner,
             batch_wait_ms=conf.behaviors.batch_wait_ms,
             coalesce_limit=conf.behaviors.coalesce_limit,
             metrics=self.metrics,
+            max_inflight=conf.behaviors.pipeline_inflight,
         )
         self.global_manager = GlobalManager(self)
         from gubernator_tpu.service.region_manager import RegionManager
@@ -586,7 +591,11 @@ class Daemon:
 
         parsed = None
         if self.event_channel is None:
+            t0 = time.perf_counter()
             parsed = columns_from_wire(data)
+            self.metrics.stage_duration.labels(stage="parse").observe(
+                time.perf_counter() - t0
+            )
         if parsed is None:
             req = pb.GetRateLimitsReq.FromString(data)
             resps = await self.get_rate_limits(list(req.requests))
@@ -724,7 +733,12 @@ class Daemon:
         over = int((status == int(pb.OVER_LIMIT)).sum())
         if over:
             self.metrics.over_limit_counter.inc(over)
-        return encode_response_columns(status, limit, remaining, reset, errors)
+        t0 = time.perf_counter()
+        out_bytes = encode_response_columns(status, limit, remaining, reset, errors)
+        self.metrics.stage_duration.labels(stage="encode").observe(
+            time.perf_counter() - t0
+        )
+        return out_bytes
 
     def _emit_event(self, item, resp) -> None:
         if resp is None:  # pragma: no cover - defensive

@@ -85,6 +85,12 @@ class DaemonMetrics:
             registry=r,
             buckets=(0.0005, 0.001, 0.002, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5, 2.5),
         )
+        self.stage_duration = Summary(
+            "gubernator_tpu_stage_duration",
+            "Seconds per serving-pipeline stage",
+            ["stage"],  # parse | queue | put | issue | fetch | encode
+            registry=r,
+        )
         self.dropped_rows = Counter(
             "gubernator_tpu_dropped_rows_count",
             "Rows whose decision could not be persisted after retries",

@@ -22,12 +22,100 @@ from gubernator_tpu.ops.engine import LocalEngine
 
 
 class EngineRunner:
-    """Serializes engine access onto one thread; async façade."""
+    """Serializes engine table access onto one thread; async façade.
 
-    def __init__(self, engine: LocalEngine, metrics=None):
+    The pipelined path (`check`) splits each request batch into an ISSUE
+    half on the engine thread (pack + enqueue kernel dispatches, no fetch)
+    and a FINISH half on a small fetch pool (materialize outputs) — so the
+    engine thread packs dispatch N+1 while N executes on-device and N-1's
+    results stream back. Rare feedback (claim drops, Store rehydrates) runs
+    back on the engine thread via the `fixup` hook; stats deltas are folded
+    in on the engine thread too, keeping every engine mutation single-
+    writer."""
+
+    def __init__(self, engine: LocalEngine, metrics=None, fetch_workers: int = 4):
         self.engine = engine
         self.metrics = metrics
         self._exec = ThreadPoolExecutor(max_workers=1, thread_name_prefix="engine")
+        # sized to the configured pipeline depth: fewer fetch workers than
+        # in-flight dispatches would silently cap the pipeline
+        self._fetch = ThreadPoolExecutor(
+            max_workers=max(1, fetch_workers), thread_name_prefix="fetch"
+        )
+        # preparation pool separate from the fetch pool: finish() blocks a
+        # worker for a device round trip, and a prepare stuck behind blocked
+        # fetchers would stall the whole pipeline's intake
+        self._prep = ThreadPoolExecutor(
+            max_workers=max(2, fetch_workers // 2), thread_name_prefix="prep"
+        )
+
+    async def check(
+        self, cols: RequestColumns, now_ms: Optional[int] = None
+    ) -> ResponseColumns:
+        """Pipelined check when the engine supports the prepare/issue/finish
+        split, else the serial path. Store-configured engines stay serial:
+        write-through ordering and miss-rehydrates must serialize against
+        every same-key dispatch, which interleaved pipelined chunks cannot
+        guarantee — durability trades pipeline throughput."""
+        if (
+            not getattr(self.engine, "supports_pipeline", False)
+            or getattr(self.engine, "store", None) is not None
+        ):
+            return await self.check_columns(cols, now_ms=now_ms)
+        from gubernator_tpu.ops.engine import (
+            finish_check_columns,
+            issue_check_columns,
+            prepare_check_columns,
+        )
+
+        loop = asyncio.get_running_loop()
+
+        def prepare():
+            t0 = time.perf_counter()
+            prepared = prepare_check_columns(self.engine, cols, now_ms=now_ms)
+            if self.metrics is not None:
+                self.metrics.stage_duration.labels(stage="put").observe(
+                    time.perf_counter() - t0
+                )
+            return prepared
+
+        def issue(prepared):
+            t0 = time.perf_counter()
+            pending = issue_check_columns(self.engine, prepared)
+            if self.metrics is not None:
+                self.metrics.stage_duration.labels(stage="issue").observe(
+                    time.perf_counter() - t0
+                )
+            return pending
+
+        def fixup(fn):
+            # executes fn on the engine thread; called FROM a fetch thread
+            # (never from the engine thread — that would deadlock the
+            # single-worker executor)
+            return self._exec.submit(fn).result()
+
+        def finish(pending):
+            t0 = time.perf_counter()
+            rc, delta = finish_check_columns(self.engine, pending, fixup)
+            if self.metrics is not None:
+                self.metrics.stage_duration.labels(stage="fetch").observe(
+                    time.perf_counter() - t0
+                )
+
+            def apply():
+                self.engine.stats.merge(delta)
+                if self.metrics is not None:
+                    self.metrics.dispatch_duration.observe(
+                        time.perf_counter() - t0
+                    )
+                    self.metrics.observe_engine(self.engine.stats)
+
+            self._exec.submit(apply)  # fire-and-forget, engine thread
+            return rc
+
+        prepared = await loop.run_in_executor(self._prep, prepare)
+        pending = await loop.run_in_executor(self._exec, lambda: issue(prepared))
+        return await loop.run_in_executor(self._fetch, lambda: finish(pending))
 
     async def check_columns(
         self, cols: RequestColumns, now_ms: Optional[int] = None
@@ -97,4 +185,6 @@ class EngineRunner:
         return self._exec.submit(self.engine.snapshot).result()
 
     def close(self) -> None:
+        self._prep.shutdown(wait=True)
+        self._fetch.shutdown(wait=True)
         self._exec.shutdown(wait=True)

@@ -605,13 +605,13 @@ async def test_coalesce_limit_caps_dispatch_size():
     engine = LocalEngine(capacity=4096)
     runner = EngineRunner(engine)
     sizes = []
-    orig = runner.check_columns
+    orig = runner.check  # the batcher's (pipelined) entry point
 
     async def spy(cols, now_ms=None):
         sizes.append(cols.fp.shape[0])
         return await orig(cols, now_ms=now_ms)
 
-    runner.check_columns = spy
+    runner.check = spy
     b = Batcher(runner, batch_wait_ms=5.0, coalesce_limit=32)
     reqs = lambda tag, n: columns_from_requests(
         [
