@@ -155,6 +155,10 @@ class DaemonConfig:
     memberlist_advertise_address: str = ""
     memberlist_known_nodes: str = ""  # comma-separated seed gossip addresses
     memberlist_gossip_interval_ms: float = 500.0
+    # comma-separated base64 AES keys (16/24/32 bytes each); first encrypts
+    # outbound gossip, all decrypt inbound (rotation). Empty = plaintext
+    # (reference SecretKey/keyring, memberlist.go:149-167)
+    memberlist_secret_keys: str = ""
 
     # kubernetes discovery (reference kubernetes.go; GUBER_K8S_*)
     k8s_namespace: str = "default"
@@ -186,6 +190,32 @@ class DaemonConfig:
     log_level: str = "info"
     metric_flags: str = ""
 
+    def memberlist_keyring(self):
+        """Decoded AES keyring from GUBER_MEMBERLIST_SECRET_KEYS — the ONE
+        strict parser (validate() calls this, so embedders skipping
+        validate() get the same rejection of malformed keys)."""
+        import base64
+        import binascii
+
+        out = []
+        for part in self.memberlist_secret_keys.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                key = base64.b64decode(part, validate=True)
+            except (ValueError, binascii.Error):
+                raise ConfigError(
+                    "GUBER_MEMBERLIST_SECRET_KEYS: entries must be base64"
+                )
+            if len(key) not in (16, 24, 32):
+                raise ConfigError(
+                    "GUBER_MEMBERLIST_SECRET_KEYS: keys must decode to "
+                    f"16, 24 or 32 bytes (got {len(key)})"
+                )
+            out.append(key)
+        return out
+
     def __post_init__(self):
         if not self.advertise_address:
             self.advertise_address = self.grpc_address
@@ -212,6 +242,17 @@ class DaemonConfig:
                 "GUBER_MEMBERLIST_ADDRESS is required when "
                 "GUBER_PEER_DISCOVERY_TYPE=member-list"
             )
+        if self.memberlist_secret_keys:
+            self.memberlist_keyring()  # the strict parser raises ConfigError
+            try:
+                from cryptography.hazmat.primitives.ciphers.aead import (  # noqa: F401
+                    AESGCM,
+                )
+            except ImportError:
+                raise ConfigError(
+                    "GUBER_MEMBERLIST_SECRET_KEYS requires the "
+                    "'cryptography' package"
+                )
         if self.k8s_mechanism not in ("endpointslices", "pods"):
             raise ConfigError(
                 "GUBER_K8S_WATCH_MECHANISM must be endpointslices or pods"
@@ -299,6 +340,7 @@ def setup_daemon_config(
         memberlist_gossip_interval_ms=_get_float_ms(
             env, "GUBER_MEMBERLIST_GOSSIP_INTERVAL", 500.0
         ),
+        memberlist_secret_keys=_get(env, "GUBER_MEMBERLIST_SECRET_KEYS", ""),
         k8s_namespace=_get(env, "GUBER_K8S_NAMESPACE", "default"),
         k8s_pod_ip=_get(env, "GUBER_K8S_POD_IP", ""),
         k8s_pod_port=_get(env, "GUBER_K8S_POD_PORT", ""),

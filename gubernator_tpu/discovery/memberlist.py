@@ -18,10 +18,14 @@ observable behavior with a deliberately simple protocol:
 * **graceful leave**: close() pushes a tombstone (incarnation bump + dead
   flag) to known peers, the NotifyLeave analog.
 
-No encryption: the reference's AES keyring (memberlist.go:149-167) guards
-gossip on untrusted networks; run this pool on a trusted network or tunnel
-it. The message format is one JSON object per connection, newline-free,
-length-prefixed by socket EOF.
+Encryption: an optional AES-256/192/128-GCM keyring (the reference's
+SecretKey/keyring, memberlist.go:149-167) seals every state blob —
+`GUBER_MEMBERLIST_SECRET_KEYS` takes comma-separated base64 keys, the FIRST
+encrypts outbound gossip and ALL decrypt inbound (key rotation: add the new
+key everywhere, promote it to first, drop the old). With a keyring set,
+plaintext blobs are rejected (GossipVerifyIncoming semantics); without one,
+sealed blobs are undecodable noise. The message format is one (optionally
+sealed) JSON object per connection, length-prefixed by socket EOF.
 """
 
 from __future__ import annotations
@@ -38,6 +42,8 @@ from gubernator_tpu.types import PeerInfo
 log = logging.getLogger("gubernator_tpu.memberlist")
 
 MAX_STATE_BYTES = 1 << 20
+ENC_MAGIC = b"GUBENC1\x00"  # sealed-blob marker + format version
+_ENC_AAD = b"gubernator-memberlist-v1"
 
 
 @dataclass
@@ -69,7 +75,15 @@ class MemberlistPool:
         advertise_address: str = "",
         gossip_interval_ms: float = 500.0,
         suspect_ticks: int = 6,
+        secret_keys: Optional[List[bytes]] = None,
     ):
+        for k in secret_keys or []:
+            if len(k) not in (16, 24, 32):
+                raise ValueError(
+                    "memberlist secret keys must be 16, 24 or 32 bytes "
+                    f"(got {len(k)})"
+                )
+        self.secret_keys = list(secret_keys or [])
         self.bind_address = bind_address
         self.advertise_address = advertise_address or bind_address
         self.known_nodes = [n for n in known_nodes if n]
@@ -105,7 +119,43 @@ class MemberlistPool:
             )
             for m in self._members.values()
         ]
-        return json.dumps({"from": self.name, "members": rows}).encode()
+        blob = json.dumps({"from": self.name, "members": rows}).encode()
+        return self._seal(blob)
+
+    # ------------------------------------------------------------ encryption
+    def _seal(self, blob: bytes) -> bytes:
+        """AES-GCM-seal with the primary key (reference memberlist.go:149-167
+        keyring); identity when no keyring is configured."""
+        if not self.secret_keys:
+            return blob
+        import os as _os
+
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+        nonce = _os.urandom(12)
+        ct = AESGCM(self.secret_keys[0]).encrypt(nonce, blob, _ENC_AAD)
+        return ENC_MAGIC + nonce + ct
+
+    def _unseal(self, raw: bytes) -> Optional[bytes]:
+        """Inverse of _seal; None = reject (plaintext under a keyring,
+        sealed without one, or no key authenticates — the
+        GossipVerifyIncoming/Outgoing contract)."""
+        sealed = raw.startswith(ENC_MAGIC)
+        if not self.secret_keys:
+            return None if sealed else raw
+        if not sealed:
+            return None  # keyring on → plaintext gossip is rejected
+        from cryptography.exceptions import InvalidTag
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+        nonce = raw[len(ENC_MAGIC) : len(ENC_MAGIC) + 12]
+        ct = raw[len(ENC_MAGIC) + 12 :]
+        for key in self.secret_keys:  # any keyring member may authenticate
+            try:
+                return AESGCM(key).decrypt(nonce, ct, _ENC_AAD)
+            except InvalidTag:
+                continue
+        return None
 
     def _merge(self, blob: dict) -> None:
         changed = False
@@ -174,7 +224,10 @@ class MemberlistPool:
         """Push-pull: read the remote table, merge, answer with ours."""
         try:
             raw = await asyncio.wait_for(self._read_blob(reader), 5.0)
-            remote = json.loads(raw.decode())
+            blob = self._unseal(raw)
+            if blob is None:
+                return  # unauthenticated gossip is dropped silently
+            remote = json.loads(blob.decode())
             writer.write(self._state_blob())
             await writer.drain()
             writer.write_eof()
@@ -197,7 +250,10 @@ class MemberlistPool:
             await writer.drain()
             writer.write_eof()
             raw = await asyncio.wait_for(self._read_blob(reader), 5.0)
-            self._merge(json.loads(raw.decode()))
+            blob = self._unseal(raw)
+            if blob is None:
+                return False
+            self._merge(json.loads(blob.decode()))
             return True
         except (OSError, asyncio.TimeoutError, ValueError):
             return False

@@ -1,0 +1,168 @@
+"""OTLP/HTTP JSON trace export — spans actually land in a collector.
+
+The reference instruments everything with the OpenTelemetry SDK and exports
+wherever the standard OTEL_* envs point (reference daemon.go:136,
+cmd/gubernator/main.go:90-97, docs/tracing.md:43-54). This build's tracing
+core (gubernator_tpu.tracing) is SDK-free, so the exporter speaks the OTLP
+1.x HTTP+JSON encoding directly (https://opentelemetry.io/docs/specs/otlp/)
+— any OTLP-capable collector (otel-collector, Jaeger, Tempo, ...) accepts
+it with zero extra dependencies. Enabled by OTEL_EXPORTER_OTLP_ENDPOINT /
+OTEL_EXPORTER_OTLP_TRACES_ENDPOINT; service name from OTEL_SERVICE_NAME.
+
+Spans batch on a daemon thread (never the serving path): `record` appends
+to a bounded buffer, the worker flushes every couple of seconds or at the
+batch cap, and export failures are counted and dropped — tracing must never
+take the service down with it.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.request
+from typing import List
+
+log = logging.getLogger("gubernator_tpu.otel")
+
+MAX_BUFFER = 8192  # spans held before the oldest drop (backpressure-free)
+
+
+class OTLPJsonExporter:
+    def __init__(
+        self,
+        endpoint: str,
+        service_name: str = "gubernator-tpu",
+        flush_interval_s: float = 2.0,
+        max_batch: int = 512,
+        append_path: bool = True,
+    ):
+        # OTLP spec: the generic endpoint gets the per-signal path appended;
+        # a signal-specific endpoint is used VERBATIM (append_path=False)
+        ep = endpoint.rstrip("/")
+        if append_path and not ep.endswith("/v1/traces"):
+            ep = ep + "/v1/traces"
+        self.endpoint = ep
+        self.service_name = service_name
+        self.flush_interval_s = flush_interval_s
+        self.max_batch = max_batch
+        self.exported = 0
+        self.dropped = 0
+        self.export_errors = 0
+        self._buf: List[dict] = []
+        self._lock = threading.Lock()
+        self._kick = threading.Event()
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._run, name="otel-export", daemon=True
+        )
+        self._worker.start()
+
+    # ------------------------------------------------------------- recording
+    def record(
+        self, name: str, span, parent_span_id: str, start_ns: int, end_ns: int
+    ) -> None:
+        """tracing.end_scope feeds finished spans here (serving thread —
+        must stay O(1) and never block)."""
+        entry = {
+            "traceId": span.trace_id,
+            "spanId": span.span_id,
+            "name": name,
+            "kind": 2,  # SPAN_KIND_SERVER: these scopes wrap RPC handling
+            "startTimeUnixNano": str(start_ns),
+            "endTimeUnixNano": str(end_ns),
+        }
+        if parent_span_id:
+            entry["parentSpanId"] = parent_span_id
+        with self._lock:
+            if len(self._buf) >= MAX_BUFFER:
+                self._buf.pop(0)  # oldest drops first, as documented
+                self.dropped += 1
+            self._buf.append(entry)
+            if len(self._buf) >= self.max_batch:
+                self._kick.set()
+
+    # -------------------------------------------------------------- flushing
+    def _drain(self) -> List[dict]:
+        with self._lock:
+            out, self._buf = self._buf, []
+        return out
+
+    def _payload(self, spans: List[dict]) -> bytes:
+        return json.dumps(
+            {
+                "resourceSpans": [
+                    {
+                        "resource": {
+                            "attributes": [
+                                {
+                                    "key": "service.name",
+                                    "value": {"stringValue": self.service_name},
+                                }
+                            ]
+                        },
+                        "scopeSpans": [
+                            {
+                                "scope": {"name": "gubernator_tpu"},
+                                "spans": spans,
+                            }
+                        ],
+                    }
+                ]
+            }
+        ).encode()
+
+    def _post(self, spans: List[dict]) -> None:
+        if not spans:
+            return
+        req = urllib.request.Request(
+            self.endpoint,
+            data=self._payload(spans),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5.0):
+                pass
+            self.exported += len(spans)
+        except Exception:
+            # counted + dropped, never retried and never raised into the
+            # serving path (the reference's exporter failures log and move on)
+            self.export_errors += 1
+            log.debug("OTLP export to %s failed", self.endpoint, exc_info=True)
+
+    def _run(self) -> None:
+        while not self._closed:
+            self._kick.wait(timeout=self.flush_interval_s)
+            self._kick.clear()
+            self._post(self._drain())
+
+    def flush(self) -> None:
+        """Synchronous flush of everything recorded so far (tests, shutdown)."""
+        self._post(self._drain())
+
+    def close(self) -> None:
+        self._closed = True
+        self._kick.set()
+        self._worker.join(timeout=5.0)
+        self.flush()
+
+
+def exporter_from_env(env=None):
+    """Build an exporter when the standard OTEL_* envs ask for one, else
+    None (reference semantics: exporters configured by OTEL_* envs,
+    docs/tracing.md:43-54)."""
+    import os
+
+    env = os.environ if env is None else env
+    traces_ep = env.get("OTEL_EXPORTER_OTLP_TRACES_ENDPOINT", "")
+    generic_ep = env.get("OTEL_EXPORTER_OTLP_ENDPOINT", "")
+    if not traces_ep and not generic_ep:
+        return None
+    return OTLPJsonExporter(
+        traces_ep or generic_ep,
+        service_name=env.get("OTEL_SERVICE_NAME", "gubernator-tpu"),
+        # per OTLP spec the signal-specific endpoint is used verbatim; only
+        # the generic endpoint gets /v1/traces appended
+        append_path=not traces_ep,
+    )

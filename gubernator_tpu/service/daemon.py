@@ -166,6 +166,16 @@ class Daemon:
             conf, engine=engine, event_channel=event_channel, store=store,
             loader=loader,
         )
+        if tracing.exporter is None:
+            # standard OTEL_* envs wire a real span exporter (reference
+            # cmd/gubernator/main.go:90-97 InitTracing); process-global —
+            # in-process clusters share one pipeline like one binary would
+            from gubernator_tpu.otel import exporter_from_env
+
+            exp = exporter_from_env()
+            if exp is not None:
+                tracing.set_exporter(exp)
+                log.info("OTLP trace export enabled → %s", exp.endpoint)
         d.maybe_restore()
         await d.warm_up()
         from gubernator_tpu.service.server import start_servers
@@ -370,6 +380,7 @@ class Daemon:
                 on_update=self.set_peers,
                 peer_info=self.peer_info(),
                 gossip_interval_ms=self.conf.memberlist_gossip_interval_ms,
+                secret_keys=self.conf.memberlist_keyring(),
             )
         elif kind == "k8s":
             from gubernator_tpu.discovery.kubernetes import K8sPool
@@ -980,3 +991,13 @@ class Daemon:
             await self.runner.sync_global()
         self.maybe_checkpoint()
         self.runner.close()
+        if tracing.exporter is not None:
+            # flush (not close): the exporter is process-global and other
+            # daemons in this process may still be serving. Off-loop — the
+            # flush POST blocks up to its timeout
+            try:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, tracing.exporter.flush
+                )
+            except Exception:  # pragma: no cover - defensive
+                log.exception("trace export flush failed")

@@ -38,6 +38,28 @@ _current: contextvars.ContextVar[Optional[SpanContext]] = contextvars.ContextVar
 # wire this to a real tracer (OTEL etc.) if you have one
 span_hook: Optional[Callable[[str, SpanContext], None]] = None
 
+# optional exporter: an object with record(name, span, parent_span_id,
+# start_ns, end_ns); end_scope feeds it finished spans. Wired by the daemon
+# from the standard OTEL_* envs (gubernator_tpu.otel.OTLPJsonExporter).
+exporter = None
+
+
+def set_exporter(exp) -> None:
+    global exporter
+    exporter = exp
+
+
+@dataclass
+class Scope:
+    """One open scope (returned by start_scope, consumed by end_scope):
+    carries what the exporter needs to emit a finished span."""
+
+    token: object
+    name: str
+    span: SpanContext
+    parent_span_id: str
+    start_ns: int
+
 
 def current_span() -> Optional[SpanContext]:
     return _current.get()
@@ -54,16 +76,36 @@ def new_span(parent: Optional[SpanContext] = None) -> SpanContext:
 
 def start_scope(name: str, parent: Optional[SpanContext] = None):
     """Begin a scope: set the current span (child of parent or of the ambient
-    span) and return a contextvars token to pass to end_scope. The
+    span) and return a Scope to pass to end_scope. The
     tracing.StartNamedScope analog."""
-    span = new_span(parent if parent is not None else _current.get())
+    import time
+
+    eff_parent = parent if parent is not None else _current.get()
+    span = new_span(eff_parent)
     if span_hook is not None:
         span_hook(name, span)
-    return _current.set(span)
+    token = _current.set(span)
+    return Scope(
+        token=token,
+        name=name,
+        span=span,
+        parent_span_id=eff_parent.span_id if eff_parent else "",
+        start_ns=time.time_ns(),
+    )
 
 
-def end_scope(token) -> None:
-    _current.reset(token)
+def end_scope(scope) -> None:
+    if isinstance(scope, Scope):
+        _current.reset(scope.token)
+        if exporter is not None:
+            import time
+
+            exporter.record(
+                scope.name, scope.span, scope.parent_span_id,
+                scope.start_ns, time.time_ns(),
+            )
+    else:  # raw contextvars token (embedders on the old surface)
+        _current.reset(scope)
 
 
 def parse_traceparent(value: str) -> Optional[SpanContext]:

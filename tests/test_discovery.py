@@ -645,3 +645,103 @@ async def test_daemons_discover_each_other_via_memberlist():
     finally:
         await d1.close()
         await d0.close()
+
+
+@async_test
+async def test_memberlist_aes_gcm_keyring():
+    """Gossip encryption (reference SecretKey/keyring, memberlist.go:149-167):
+    nodes sharing a key converge; a keyless or wrong-key node can neither
+    read nor inject state; an old-keyring node still interops during
+    rotation (new key first, old key still accepted)."""
+    import os
+
+    from gubernator_tpu.discovery.memberlist import MemberlistPool
+
+    key_a = os.urandom(32)
+    key_b = os.urandom(32)
+    seen = {}
+
+    def updater(name):
+        return lambda ps: seen.__setitem__(
+            name, sorted(p.grpc_address for p in ps)
+        )
+
+    p0 = MemberlistPool(
+        bind_address="127.0.0.1:0", known_nodes=[],
+        on_update=updater("n0"),
+        peer_info=PeerInfo(grpc_address="10.1.0.1:1051"),
+        gossip_interval_ms=50.0, secret_keys=[key_a],
+    )
+    await p0.start()
+    seed = p0.advertise_address
+    p1 = MemberlistPool(
+        bind_address="127.0.0.1:0", known_nodes=[seed],
+        on_update=updater("n1"),
+        peer_info=PeerInfo(grpc_address="10.1.0.2:1051"),
+        gossip_interval_ms=50.0, secret_keys=[key_a],
+    )
+    await p1.start()
+    # rotation: node 2 sends with key_b but still accepts key_a
+    p2 = MemberlistPool(
+        bind_address="127.0.0.1:0", known_nodes=[seed],
+        on_update=updater("n2"),
+        peer_info=PeerInfo(grpc_address="10.1.0.3:1051"),
+        gossip_interval_ms=50.0, secret_keys=[key_b, key_a],
+    )
+    # ... so the cluster must also accept key_b for p2's sends to land
+    p0.secret_keys.append(key_b)
+    p1.secret_keys.append(key_b)
+    await p2.start()
+    # intruders: plaintext and wrong-key nodes must stay invisible
+    evil_plain = MemberlistPool(
+        bind_address="127.0.0.1:0", known_nodes=[seed],
+        on_update=updater("evil_plain"),
+        peer_info=PeerInfo(grpc_address="10.66.0.1:1051"),
+        gossip_interval_ms=50.0,
+    )
+    await evil_plain.start()
+    evil_key = MemberlistPool(
+        bind_address="127.0.0.1:0", known_nodes=[seed],
+        on_update=updater("evil_key"),
+        peer_info=PeerInfo(grpc_address="10.66.0.2:1051"),
+        gossip_interval_ms=50.0, secret_keys=[os.urandom(32)],
+    )
+    await evil_key.start()
+    want = ["10.1.0.1:1051", "10.1.0.2:1051", "10.1.0.3:1051"]
+    try:
+        await wait_until(
+            lambda: all(seen.get(n) == want for n in ("n0", "n1", "n2"))
+        )
+        # the intruders never learned the cluster, the cluster never saw them
+        assert seen.get("evil_plain", ["10.66.0.1:1051"]) == ["10.66.0.1:1051"]
+        assert seen.get("evil_key", ["10.66.0.2:1051"]) == ["10.66.0.2:1051"]
+        assert seen["n0"] == want
+    finally:
+        for p in (p0, p1, p2, evil_plain, evil_key):
+            await p.close()
+
+
+def test_memberlist_secret_key_validation():
+    import base64
+    import os
+
+    import pytest as _pytest
+
+    from gubernator_tpu.config import ConfigError, DaemonConfig
+    from gubernator_tpu.discovery.memberlist import MemberlistPool
+
+    with _pytest.raises(ValueError, match="16, 24 or 32"):
+        MemberlistPool(
+            bind_address="127.0.0.1:0", known_nodes=[],
+            on_update=lambda ps: None,
+            peer_info=PeerInfo(grpc_address="x:1"),
+            secret_keys=[b"short"],
+        )
+    good = base64.b64encode(os.urandom(32)).decode()
+    DaemonConfig(memberlist_secret_keys=good).validate()
+    with _pytest.raises(ConfigError, match="base64"):
+        DaemonConfig(memberlist_secret_keys="!!notb64!!").validate()
+    with _pytest.raises(ConfigError, match="16, 24 or 32"):
+        DaemonConfig(
+            memberlist_secret_keys=base64.b64encode(b"tooshort").decode()
+        ).validate()

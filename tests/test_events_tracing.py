@@ -150,3 +150,71 @@ async def test_trace_propagates_to_owner_across_forwarding():
     finally:
         tracing.span_hook = old_hook
         await c.stop()
+
+
+@async_test
+async def test_otlp_exporter_lands_spans_in_collector():
+    """With OTEL_* envs set, finished scopes export as OTLP/HTTP JSON spans
+    a real collector accepts (reference docs/tracing.md:43-54: exporters are
+    configured by standard OTEL envs). Driven through real daemons: a
+    forwarded request produces spans from BOTH daemons under ONE trace."""
+    from aiohttp import web
+
+    from gubernator_tpu.otel import OTLPJsonExporter
+
+    received = []
+
+    async def v1_traces(request):
+        received.append(await request.json())
+        return web.json_response({})
+
+    app = web.Application()
+    app.router.add_post("/v1/traces", v1_traces)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    url = f"http://127.0.0.1:{runner.addresses[0][1]}"
+
+    exporter = OTLPJsonExporter(url, service_name="guber-test")
+    old = tracing.exporter
+    tracing.set_exporter(exporter)
+    c = await Cluster.start(2)
+    try:
+        non_owner = c.non_owning_daemons("otel", "okey")[0]
+        client = V1Client(non_owner.conf.grpc_address)
+        trace_id = "12" * 16
+        try:
+            resp = await client.get_rate_limits(
+                [req("okey", name="otel",
+                     metadata={"traceparent": f"00-{trace_id}-{'34' * 8}-01"})]
+            )
+            assert resp.responses[0].error == ""
+        finally:
+            await client.close()
+        # run_in_executor scopes may close a beat later; flush OFF the
+        # event loop (the fake collector serves on this loop)
+        await asyncio.sleep(0.05)
+        await asyncio.get_running_loop().run_in_executor(None, exporter.flush)
+        spans = [
+            sp
+            for body in received
+            for rs in body["resourceSpans"]
+            for ss in rs["scopeSpans"]
+            for sp in ss["spans"]
+        ]
+        assert spans, "collector received no spans"
+        svc = received[0]["resourceSpans"][0]["resource"]["attributes"][0]
+        assert svc["value"]["stringValue"] == "guber-test"
+        ours = [sp for sp in spans if sp["traceId"] == trace_id]
+        names = {sp["name"] for sp in ours}
+        # the non-owner's ingress scope AND the owner's peer-RPC scope share
+        # the client's trace — one distributed trace across daemons
+        assert "GetRateLimits" in names and "GetPeerRateLimits" in names
+        for sp in ours:
+            assert int(sp["endTimeUnixNano"]) > int(sp["startTimeUnixNano"])
+    finally:
+        tracing.set_exporter(old)
+        exporter.close()
+        await c.stop()
+        await runner.cleanup()
