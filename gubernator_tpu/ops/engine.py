@@ -245,10 +245,9 @@ class PendingCheck:
 def prepare_check_columns(engine, cols, now_ms=None) -> PendingCheck:
     """Preparation half of the pipelined serving path (any thread — touches
     no engine state): pack, clamp, plan same-key passes, and stage each
-    pass's SINGLE packed ingress array on-device (one transfer per pass,
-    batch.pack_host_batch)."""
-    import jax
-
+    pass's SINGLE packed ingress transfer on-device via the engine's
+    `stage_pass` (LocalEngine: (12, B) array; ShardedEngine: routed
+    (D, 12, b_local) grid)."""
     now = now_ms if now_ms is not None else ms_now()
     hb, err = pack_columns(cols, now, tolerance_ms=engine.created_at_tolerance_ms)
     clamped = int(
@@ -257,21 +256,19 @@ def prepare_check_columns(engine, cols, now_ms=None) -> PendingCheck:
     passes = []
     for p in plan_passes(hb, max_exact=engine.max_exact_passes):
         n = len(p.rows)
-        batch = pad_batch(p.batch, _pad_size(n))
-        dev = jax.device_put(pack_host_batch(batch))
-        passes.append([p, n, batch, dev])
+        batch, staged = engine.stage_pass(p.batch, n)
+        passes.append([p, n, batch, staged])
     return PendingCheck(hb=hb, err=err, now=now, passes=passes, clamped=clamped)
 
 
 def issue_check_columns(engine, pending: PendingCheck) -> PendingCheck:
     """Engine-thread half: launch every staged pass WITHOUT fetching.
     Later passes depend only on device state, not fetched outputs, so the
-    whole chain enqueues back-to-back; each entry's staged ingress array is
-    replaced by its pending packed output."""
+    whole chain enqueues back-to-back; each entry's staged ingress is
+    replaced by its pending (un-fetched) output handle."""
     for entry in pending.passes:
-        _p, _n, batch, dev = entry
-        engine._seen_pad_sizes.add(int(batch.fp.shape[0]))
-        entry[3] = engine._issue_from_dev(dev, int(batch.fp.shape[0]))
+        _p, _n, batch, staged = entry
+        entry[3] = engine.issue_staged(staged, int(batch.fp.shape[0]))
     return pending
 
 
@@ -294,19 +291,13 @@ def finish_check_columns(
     remaining = np.zeros(n, dtype=np.int64)
     reset = np.zeros(n, dtype=np.int64)
     delta = EngineStats(created_at_clamped=pending.clamped, checks=n)
-    for pi, (p, np_, batch, dev) in enumerate(pending.passes):
-        arr = np.asarray(dev)
-        delta.cache_hits += int(arr[-2, 0])
-        delta.cache_misses += int(arr[-2, 1])
-        delta.over_limit += int(arr[-2, 2])
-        delta.evicted_unexpired += int(arr[-2, 3])
+    for pi, (p, np_, batch, pend) in enumerate(pending.passes):
+        (s, l, r, t, dropped, hit), st = engine.finish_staged(pend, np_)
+        delta.cache_hits += st[0]
+        delta.cache_misses += st[1]
+        delta.over_limit += st[2]
+        delta.evicted_unexpired += st[3]
         delta.dispatches += 1
-        l = arr[:np_, 0].copy()
-        r = arr[:np_, 1].copy()
-        t = arr[:np_, 2].copy()
-        s = (arr[:np_, 3] & 1).astype(np.int32)
-        hit = (arr[:np_, 3] & 2) != 0
-        dropped = (arr[:np_, 3] & 4) != 0
         if dropped.any():
             # contended-claim retries mutate the table → engine thread;
             # _redispatch_rows counts dispatches/evictions only, exactly
@@ -314,10 +305,10 @@ def finish_check_columns(
             rows = np.nonzero(dropped)[0]
 
             def retry(rows=rows, batch=batch):
+                # padding conventions are the engine's own (LocalEngine pads
+                # to _pad_size; ShardedEngine needs no row padding)
                 sub = HostBatch(*[f[rows] for f in batch])
-                return engine._redispatch_rows(
-                    pad_batch(sub, _pad_size(len(rows))), len(rows)
-                )
+                return engine._redispatch_rows(sub, len(rows))
 
             s2, l2, r2, t2, d2, h2 = fixup(retry)
             s[rows], l[rows], r[rows], t[rows] = s2, l2, r2, t2
@@ -407,11 +398,42 @@ class LocalEngine:
         self.table, packed = decide2_packed_cols(self.table, dev_arr, write=write)
         return packed
 
+    # ------------------------------------------------- pipelined protocol
+    # stage_pass (any thread) → issue_staged (engine thread) → finish_staged
+    # (fetch thread); the packed single-transfer layout stays private to the
+    # engine so mesh engines can substitute routed grids (parallel/sharded.py).
+
+    def stage_pass(self, pass_batch: HostBatch, n: int):
+        """(padded batch, staged ingress array) for one unique-fp pass."""
+        import jax
+
+        batch = pad_batch(pass_batch, _pad_size(n))
+        return batch, jax.device_put(pack_host_batch(batch))
+
+    def issue_staged(self, staged, batch_rows: int):
+        self._seen_pad_sizes.add(batch_rows)
+        return self._issue_from_dev(staged, batch_rows)
+
+    def finish_staged(self, pending, n: int):
+        """Materialize one pass's packed output → ((s, l, r, t, dropped,
+        hit), (hits, misses, over, evicted)). Response arrays are writable
+        (retry fix-ups mutate them in place)."""
+        arr = np.asarray(pending)
+        st = (int(arr[-2, 0]), int(arr[-2, 1]), int(arr[-2, 2]), int(arr[-2, 3]))
+        l = arr[:n, 0].copy()
+        r = arr[:n, 1].copy()
+        t = arr[:n, 2].copy()
+        s = (arr[:n, 3] & 1).astype(np.int32)
+        hit = (arr[:n, 3] & 2) != 0
+        dropped = (arr[:n, 3] & 4) != 0
+        return (s, l, r, t, dropped, hit), st
+
     def _redispatch_rows(self, batch, n: int):
         """Re-dispatch rows whose phase-1 claim dropped (pipelined retry):
         accounts dispatches/evictions/final drops only — hits/misses/over
         were already counted by the dropped phase-1 pass, exactly like the
         sync path's retry loop."""
+        batch = pad_batch(batch, _pad_size(n))
         arr = self._decide_packed(batch)
         self.stats.dispatches += 1
         self.stats.evicted_unexpired += int(arr[-2, 3])

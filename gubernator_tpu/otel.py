@@ -21,6 +21,7 @@ import json
 import logging
 import threading
 import urllib.request
+from collections import deque
 from typing import List
 
 log = logging.getLogger("gubernator_tpu.otel")
@@ -49,7 +50,9 @@ class OTLPJsonExporter:
         self.exported = 0
         self.dropped = 0
         self.export_errors = 0
-        self._buf: List[dict] = []
+        # drop-oldest in O(1): record() runs on the serving thread and must
+        # not memmove thousands of entries when the collector is down
+        self._buf: "deque[dict]" = deque(maxlen=MAX_BUFFER)
         self._lock = threading.Lock()
         self._kick = threading.Event()
         self._closed = False
@@ -75,9 +78,8 @@ class OTLPJsonExporter:
         if parent_span_id:
             entry["parentSpanId"] = parent_span_id
         with self._lock:
-            if len(self._buf) >= MAX_BUFFER:
-                self._buf.pop(0)  # oldest drops first, as documented
-                self.dropped += 1
+            if len(self._buf) == MAX_BUFFER:
+                self.dropped += 1  # deque(maxlen) evicts the oldest
             self._buf.append(entry)
             if len(self._buf) >= self.max_batch:
                 self._kick.set()
@@ -85,7 +87,8 @@ class OTLPJsonExporter:
     # -------------------------------------------------------------- flushing
     def _drain(self) -> List[dict]:
         with self._lock:
-            out, self._buf = self._buf, []
+            out = list(self._buf)
+            self._buf.clear()
         return out
 
     def _payload(self, spans: List[dict]) -> bytes:
@@ -131,15 +134,22 @@ class OTLPJsonExporter:
             self.export_errors += 1
             log.debug("OTLP export to %s failed", self.endpoint, exc_info=True)
 
+    def _post_batched(self, spans: List[dict]) -> None:
+        # max_batch caps the spans per POST too, not just the kick
+        # threshold — a collector's request-size limit must not reject a
+        # whole backlog at once
+        for i in range(0, len(spans), self.max_batch):
+            self._post(spans[i : i + self.max_batch])
+
     def _run(self) -> None:
         while not self._closed:
             self._kick.wait(timeout=self.flush_interval_s)
             self._kick.clear()
-            self._post(self._drain())
+            self._post_batched(self._drain())
 
     def flush(self) -> None:
         """Synchronous flush of everything recorded so far (tests, shutdown)."""
-        self._post(self._drain())
+        self._post_batched(self._drain())
 
     def close(self) -> None:
         self._closed = True

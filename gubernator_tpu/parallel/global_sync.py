@@ -190,6 +190,16 @@ class GlobalShardedEngine(ShardedEngine):
     mesh_global = True  # daemon marker: this engine serves the GLOBAL
     # behavior through replica tables + collective sync
 
+    def can_pipeline(self, cols) -> bool:
+        """Per-batch pipeline gate (EngineRunner.check): batches with GLOBAL
+        rows need this class's check_columns — replica-table answers + hit
+        queueing for the sync tick — which the generic prepare/issue/finish
+        split would bypass. Pure non-GLOBAL batches pipeline as plain
+        sharded dispatches."""
+        return not bool(
+            ((np.asarray(cols.behavior) & int(Behavior.GLOBAL)) != 0).any()
+        )
+
     def __init__(
         self,
         mesh,

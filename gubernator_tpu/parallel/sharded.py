@@ -8,17 +8,22 @@ owning shard, and one shard_map dispatch executes the decision kernel on all
 shards simultaneously — no forwarding hop, no N×N connection mesh; ICI does
 what gRPC did.
 
-Layout: every Table2/ReqBatch/RespBatch leaf gains a leading (D,) device axis,
-sharded with PartitionSpec("shard"). Inside shard_map each device sees its
-(1, …) block and runs decide2_impl on its local slice independently —
-embarrassingly parallel, exactly like the reference's share-nothing workers
-(workers.go:19-37) but across chips.
+Layout: the Table2 leaves gain a leading (D,) device axis sharded with
+PartitionSpec("shard"); request batches travel as ONE (D, 12, b_local) packed
+i64 ingress grid and come back as ONE (D, b_local+2, 4) packed output grid
+(single put + single fetch per mesh dispatch, cf. batch.pack_host_batch /
+kernel2.pack_outputs). Inside shard_map each device sees its (1, …) block and
+runs the decision kernel on its local slice independently — embarrassingly
+parallel, exactly like the reference's share-nothing workers (workers.go:19-37)
+but across chips. Because dispatches route UNIQUE fingerprints (the pass
+planner aggregates same-key duplicates first, ops/plan.py), the hash spread
+over shards stays near-multinomial even under Zipf-skewed traffic — per-shard
+padding is counts.max() over a balanced draw, not the hot key's count.
 """
 
 from __future__ import annotations
 
-from functools import partial
-from typing import List, Optional, Sequence, Tuple
+from typing import List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -26,20 +31,16 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gubernator_tpu.ops.batch import (
-    ERR_DROPPED,
     ERROR_STRINGS,
     HostBatch,
     InstallBatch,
-    ReqBatch,
     RequestColumns,
     ResponseColumns,
-    pack_columns,
-    pack_requests,
-    pad_batch,
+    pack_host_batch,
 )
-from gubernator_tpu.ops.kernel2 import decide2_impl, install2_impl
-from gubernator_tpu.ops.engine import ERR_NOT_PERSISTED, EngineStats, default_write_mode, ms_now, _pad_size
-from gubernator_tpu.ops.plan import plan_passes, _subset
+from gubernator_tpu.ops.kernel2 import decide2_packed_cols_impl, install2_impl
+from gubernator_tpu.ops.engine import EngineStats, default_write_mode, ms_now, _pad_size
+from gubernator_tpu.ops.plan import _subset
 from gubernator_tpu.ops.table2 import Table2, new_table2
 from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_of
 from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
@@ -50,21 +51,25 @@ def _stack_tree(trees):
 
 
 def make_sharded_decide(mesh: Mesh):
-    """Build the jitted all-shards decision step: (Table2[D,·], ReqBatch[D,·])
-    → (Table2', RespBatch[D,·], BatchStats[D]). Write mode is resolved once at
-    build time (Pallas sweep on TPU, XLA scatter on CPU test meshes)."""
+    """Build the jitted all-shards decision step over the SINGLE-TRANSFER
+    packed layout: (Table2[D,·], (D, 12, b) i64 ingress grid) → (Table2',
+    (D, b+2, 4) i64 packed outputs). Each device unpacks its ingress block
+    in-kernel (kernel2.req_from_arr) and packs responses+stats on-device
+    (kernel2.pack_outputs) — one host→device put and ONE device→host fetch
+    per mesh dispatch, however many shards (the per-column transfer layout
+    cost 12 puts + 6 grid fetches per dispatch). Write mode is resolved once
+    at build time (Pallas sweep on TPU, XLA scatter on CPU test meshes)."""
     write = default_write_mode()
 
-    def per_device(table: Table2, req: ReqBatch):
+    def per_device(table: Table2, arr: jnp.ndarray):
         table = jax.tree.map(lambda x: x[0], table)
-        req = jax.tree.map(lambda x: x[0], req)
-        table, resp, stats = decide2_impl(table, req, write=write)
+        table, packed = decide2_packed_cols_impl(table, arr[0], write=write)
         expand = lambda t: jax.tree.map(lambda x: x[None], t)
-        return expand(table), expand(resp), expand(stats)
+        return expand(table), packed[None]
 
     spec = P(SHARD_AXIS)
     fn = jax.shard_map(
-        per_device, mesh=mesh, in_specs=(spec, spec), out_specs=(spec, spec, spec)
+        per_device, mesh=mesh, in_specs=(spec, spec), out_specs=(spec, spec)
     )
     return jax.jit(fn, donate_argnums=(0,))
 
@@ -250,6 +255,71 @@ class ShardedEngine:
         node). Not auto-grown."""
         return False
 
+    # ------------------------------------------------------ pipelined surface
+    # The same prepare/issue/finish protocol as LocalEngine (ops/engine.py):
+    # stage_pass routes + packs + stages the ingress grid on ANY thread,
+    # issue_staged advances the sharded table on the engine thread without
+    # fetching, finish_staged materializes the ONE packed output grid on a
+    # fetch thread — so a sharded daemon's front door overlaps host routing
+    # of dispatch N+1 with mesh execution of N exactly like the local one.
+
+    supports_pipeline = True
+
+    def stage_pass(self, pass_batch: HostBatch, n: int):
+        """(padded batch, staged route) for one unique-fp pass. No row
+        padding is needed: the compiled shape depends only on the pow2
+        per-shard width b_local, not on n."""
+        staged = self._stage(pass_batch, None)
+        return pass_batch, staged
+
+    def issue_staged(self, staged: "_Staged", batch_rows: int):
+        # dispatch count is folded in via the finish delta (engine thread)
+        table, out = self._decide(self.table, staged.dev)
+        self.table = table
+        return staged, out
+
+    def finish_staged(self, pending, n: int):
+        staged, out = pending
+        s, l, r, t, dropped, hit, st = self._unroute(staged, np.asarray(out), n)
+        return (s, l, r, t, dropped, hit), st
+
+    def _redispatch_rows(self, batch: HostBatch, n: int):
+        """Pipelined-retry hook (engine thread): depth=1 counts evictions and
+        dispatches only — hits/misses/over were counted by the dropped
+        phase-1 pass (cf. LocalEngine._redispatch_rows)."""
+        _, (s, l, r, t, d, h) = self._dispatch(batch, depth=1)
+        return s[:n], l[:n], r[:n], t[:n], d[:n], h[:n]
+
+    # ------------------------------------------------------- dispatch core
+
+    def _stage(self, batch: HostBatch, shard: Optional[np.ndarray]) -> "_Staged":
+        """Host half of one mesh dispatch: route rows to shards, scatter the
+        packed (12, n) ingress columns into ONE (D, 12, b_local) grid, and
+        stage it shard-per-device. One device_put total (the per-column
+        layout cost 12)."""
+        D = self.n_shards
+        routed = shard if shard is not None else shard_of(batch.fp, D)
+        order, rs, offset, b_local = _route_plan(routed, D)
+        packed = pack_host_batch(batch)  # (12, n)
+        grid = np.zeros((D, 12, b_local), dtype=np.int64)
+        grid[rs, :, offset] = packed[:, order].T
+        dev = jax.device_put(grid, self._batch_sharding)
+        return _Staged(order=order, rs=rs, offset=offset, b_local=b_local, dev=dev)
+
+    def _unroute(self, staged: "_Staged", outh: np.ndarray, n: int):
+        """Decode the fetched (D, b_local+2, 4) packed output grid back to
+        pass-row order + summed per-device stats."""
+        st = outh[:, staged.b_local, :].sum(axis=0)  # hits/misses/over/evicted
+        per = np.empty((n, 4), dtype=np.int64)
+        per[staged.order] = outh[staged.rs, staged.offset]
+        status = (per[:, 3] & 1).astype(np.int32)
+        hit = (per[:, 3] & 2) != 0
+        dropped = (per[:, 3] & 4) != 0
+        return (
+            status, per[:, 0], per[:, 1], per[:, 2], dropped, hit,
+            (int(st[0]), int(st[1]), int(st[2]), int(st[3])),
+        )
+
     def _dispatch(
         self,
         batch: HostBatch,
@@ -265,43 +335,25 @@ class ShardedEngine:
         requests to their home device's replica table); `table_attr` picks the
         state table ("table" = authoritative shards, "replica" = GLOBAL
         read-replicas)."""
-        D = self.n_shards
         n = batch.fp.shape[0]
-        routed = shard if shard is not None else shard_of(batch.fp, D)
-        order, rs, offset_in_shard, b_local = _route_plan(routed, D)
-        # scatter rows into (D, b_local) position grid
-        grouped = _subset(batch, order)
-        stacked = HostBatch(
-            *[_to_grid(f, rs, offset_in_shard, D, b_local) for f in grouped]
-        )
-        dev_batch = jax.tree.map(
-            lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding), stacked
-        )
-        table, resp, stats = self._decide(getattr(self, table_attr), dev_batch)
+        routed = shard if shard is not None else shard_of(batch.fp, self.n_shards)
+        staged = self._stage(batch, routed)
+        table, out = self._decide(getattr(self, table_attr), staged.dev)
         setattr(self, table_attr, table)
         self.stats.dispatches += 1
+        status, limit, remaining, reset, dropped, hit, st = self._unroute(
+            staged, np.asarray(out), n
+        )
         if depth == 0:
             # retries re-run rows the claim auction dropped; accumulating their
             # hit/miss/over_limit again would double-count (cf. LocalEngine
             # _dispatch_with_retry's retry accounting)
-            self.stats.accumulate(
-                jax.tree.map(lambda x: x.sum(), stats), count_dropped=False
-            )
+            self.stats.cache_hits += st[0]
+            self.stats.cache_misses += st[1]
+            self.stats.over_limit += st[2]
+            self.stats.evicted_unexpired += st[3]
         else:
-            self.stats.evicted_unexpired += int(stats.evicted_unexpired.sum())
-        # gather responses back: row i lives at (rs[i], offset[i])
-        status = np.asarray(resp.status)[rs, offset_in_shard]
-        limit = np.asarray(resp.limit)[rs, offset_in_shard]
-        remaining = np.asarray(resp.remaining)[rs, offset_in_shard]
-        reset = np.asarray(resp.reset_time)[rs, offset_in_shard]
-        dropped = np.asarray(resp.dropped)[rs, offset_in_shard]
-        hit = np.asarray(resp.cache_hit)[rs, offset_in_shard]
-        inv = np.empty(n, dtype=np.int64)
-        inv[order] = np.arange(n)
-        status, limit, remaining, reset, dropped, hit = (
-            status[inv], limit[inv], remaining[inv], reset[inv], dropped[inv],
-            hit[inv],
-        )
+            self.stats.evicted_unexpired += st[3]
         if dropped.any() and depth < 3:
             rows = np.nonzero(dropped)[0]
             _, (s2, l2, r2, t2, d2, h2) = self._dispatch(
@@ -321,6 +373,18 @@ class ShardedEngine:
             # surface ERR_NOT_PERSISTED per item instead of failing open
             self.stats.dropped += int(dropped.sum())
         return np.arange(n), (status, limit, remaining, reset, dropped, hit)
+
+
+class _Staged(NamedTuple):
+    """One staged mesh dispatch: the routing plan + the on-device ingress
+    grid. Carried from stage (any thread) to issue (engine thread) to finish
+    (fetch thread) on the pipelined path."""
+
+    order: np.ndarray  # (n,) original row index at each sorted position
+    rs: np.ndarray  # (n,) owning shard, sorted
+    offset: np.ndarray  # (n,) position within the shard's grid row
+    b_local: int  # padded per-shard width
+    dev: object  # (D, 12, b_local) i64 device grid, shard-per-device
 
 
 def _route_plan(routed: np.ndarray, D: int):

@@ -56,10 +56,15 @@ class EngineRunner:
         split, else the serial path. Store-configured engines stay serial:
         write-through ordering and miss-rehydrates must serialize against
         every same-key dispatch, which interleaved pipelined chunks cannot
-        guarantee — durability trades pipeline throughput."""
+        guarantee — durability trades pipeline throughput. Engines may also
+        veto per batch via `can_pipeline(cols)` (the mesh-global engine
+        serializes batches containing GLOBAL rows, whose replica answers and
+        hit queueing live outside the prepare/issue/finish split)."""
+        can = getattr(self.engine, "can_pipeline", None)
         if (
             not getattr(self.engine, "supports_pipeline", False)
             or getattr(self.engine, "store", None) is not None
+            or (can is not None and not can(cols))
         ):
             return await self.check_columns(cols, now_ms=now_ms)
         from gubernator_tpu.ops.engine import (

@@ -97,7 +97,9 @@ def start_scope(name: str, parent: Optional[SpanContext] = None):
 def end_scope(scope) -> None:
     if isinstance(scope, Scope):
         _current.reset(scope.token)
-        if exporter is not None:
+        # honor the W3C sampled flag: traces sampled out upstream
+        # (traceparent ...-00) must not produce orphan partial traces here
+        if exporter is not None and scope.span.flags & 0x01:
             import time
 
             exporter.record(

