@@ -93,24 +93,37 @@ class Case:
     """One benchmark case: pre-staged device batches cycled through a
     donated-table dispatch loop; throughput from the slope between a short and
     a long pipelined run (the tunneled axon platform has no true
-    block_until_ready, so completion is forced by fetching a scalar)."""
+    block_until_ready, so completion is forced by fetching a scalar).
 
-    def __init__(self, name, capacity, batches, seed_batches=None, seed_iter=None):
+    `math` mirrors the engine's per-dispatch static specialization
+    (ops/engine._math_mode): all-token cases compile the decision graph
+    without the emulated-f64 leaky lanes."""
+
+    def __init__(self, name, capacity, batches, seed_batches=None, seed_iter=None,
+                 math="mixed"):
         self.name = name
         self.table = new_table2(capacity)
         self.batches = batches
         self.seed_batches = seed_batches if seed_batches is not None else batches
         self.seed_iter = seed_iter  # lazy seeding for huge keyspaces
+        self.math = math
         self.last_stats = None
 
     def dispatch(self, b):
-        self.table, resp, stats = decide2(self.table, b, write=WRITE)
+        self.table, resp, stats = decide2(self.table, b, write=WRITE, math=self.math)
         return stats
 
     def run(self, dispatches=48, latency_probes=24):
         t0 = time.perf_counter()
-        for b in self.seed_iter() if self.seed_iter else self.seed_batches:
+        for j, b in enumerate(
+            self.seed_iter() if self.seed_iter else self.seed_batches
+        ):
             stats = self.dispatch(b)
+            if j % 8 == 7:
+                # bound the async enqueue depth: a long un-synchronized seed
+                # chain (config5 queues 96 dispatches x ~100 MB of staged
+                # batches) can wedge the tunneled device transport
+                _ = int(stats.cache_hits)
         _ = int(stats.cache_hits)
         log(f"[{self.name}] compile+seed: {time.perf_counter() - t0:.1f}s")
         n = len(self.batches)
@@ -176,7 +189,7 @@ def headline_case(rng, now) -> Case:
     ]
     # seed = one full pass over all staged batches → timed phase is pure
     # cache-hit steady state over 10M live keys (subset cycled)
-    return Case("headline-10M", CAPACITY, batches)
+    return Case("headline-10M", CAPACITY, batches, math="token")
 
 
 def config1_case(rng, now) -> Case:
@@ -195,7 +208,7 @@ def config1_case(rng, now) -> Case:
         b = make_req_batch(ufp, now, hits=hits, limit=1 << 30)
         b = b._replace(active=jnp.asarray(ufp != 0))
         batches.append(jax.device_put(b))
-    c = Case("config1-token-1K", 1 << 14, batches)
+    c = Case("config1-token-1K", 1 << 14, batches, math="token")
     c.logical_batch = BATCH  # decisions represented per dispatch
     return c
 
@@ -230,7 +243,8 @@ def config2_case(rng, now) -> Case:
         )
         for i in range(LIVE // BATCH)
     ] + batches
-    return Case("config2-leaky-1M-zipf", 1 << 21, batches, seed_batches=seed)
+    return Case("config2-leaky-1M-zipf", 1 << 21, batches, seed_batches=seed,
+                math="mixed")
 
 
 def config4_case(rng, now) -> Case:
@@ -251,7 +265,7 @@ def config4_case(rng, now) -> Case:
         hits = rng.integers(0, 4, size=BATCH).astype(np.int64)
         b = make_req_batch(fps, now, hits=hits, algo=algo, behavior=behavior, limit=100)
         batches.append(jax.device_put(b))
-    return Case("config4-mixed-flags-1M", 1 << 21, batches)
+    return Case("config4-mixed-flags-1M", 1 << 21, batches, math="mixed")
 
 
 def config5_case(rng, now) -> Case:
@@ -298,7 +312,8 @@ def config5_case(rng, now) -> Case:
                 b = b._replace(active=jnp.asarray(chunk != 0))
             yield jax.device_put(b)
 
-    return Case("config5-100M", CAPACITY, batches, seed_iter=seed_iter)
+    return Case("config5-100M", CAPACITY, batches, seed_iter=seed_iter,
+                math="token")
 
 
 def sweep_parity_smoke(rng, now):
@@ -371,6 +386,30 @@ def e2e_serving_case() -> dict:
             behaviors=BehaviorConfig(batch_wait_ms=2.0, pipeline_inflight=6),
         )
         d = await Daemon.spawn(conf)
+        # Pre-warm every pow2 batch shape the front door can coalesce
+        # (chunks of whole 1000-row enqueues up to the 16384 coalesce cap →
+        # pad sizes 1024..16384). XLA compiles are seconds each on this
+        # platform; without this they land inside the measured window
+        # whenever arrival timing produces a shape the warm phase missed.
+        from gubernator_tpu.ops.batch import RequestColumns
+
+        size = 1024
+        t0 = time.perf_counter()
+        while size <= conf.behaviors.coalesce_limit:
+            warm = RequestColumns(
+                fp=np.arange(1, size + 1, dtype=np.int64),
+                algo=np.zeros(size, dtype=np.int32),
+                behavior=np.zeros(size, dtype=np.int32),
+                hits=np.zeros(size, dtype=np.int64),
+                limit=np.full(size, 1 << 30, dtype=np.int64),
+                burst=np.zeros(size, dtype=np.int64),
+                duration=np.ones(size, dtype=np.int64),
+                created_at=np.zeros(size, dtype=np.int64),
+                err=np.zeros(size, dtype=np.int8),
+            )
+            await d.runner.check(warm)
+            size *= 2
+        log(f"[e2e-serving] shape pre-warm: {time.perf_counter() - t0:.1f}s")
         client = V1Client(d.conf.grpc_address, timeout_s=120.0)
         rng = np.random.default_rng(9)
         reqs = [

@@ -84,7 +84,7 @@ def main():
     def full():
         b = batches[state["i"] % 4]
         state["i"] += 1
-        state["t"], resp, stats = k2.decide2(state["t"], b, write="sweep")
+        state["t"], resp, stats = k2.decide2(state["t"], b, write="sweep", math="token")
         return stats.cache_hits
 
     log(f"full decide2(sweep): {slope(full, lambda x: int(x)) * 1e3:.2f} ms")

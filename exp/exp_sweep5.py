@@ -194,23 +194,27 @@ def main():
     rng = np.random.default_rng(3)
     NB, B = 1 << 21, 1 << 17  # headline: 2M bucket rows (1 GiB), 131K updates
     log(f"device: {jax.devices()[0]}")
-    case("base", NB, B, 2048, 256, False, False, rng)
-    case("geom-1024-128", NB, B, 1024, 128, False, False, rng)
-    case("geom-512-128", NB, B, 512, 128, False, False, rng)
-    case("geom-2048-128", NB, B, 2048, 128, False, False, rng)
-    case("marker", NB, B, 2048, 256, True, False, rng)
-    case("skip2", NB, B, 2048, 256, False, True, rng)
-    case("all-1024-128", NB, B, 1024, 128, True, True, rng)
-    case("all-2048-128", NB, B, 2048, 128, True, True, rng)
-    case("all-512-64", NB, B, 512, 64, True, True, rng)
-    # config5 scale: 16.7M bucket rows (8 GiB), 1M updates — only if HBM fits
-    try:
-        NB5, B5 = 1 << 24, 1 << 20
-        case("c5-base", NB5, B5, 2048, 256, False, False, rng)
-        case("c5-all-1024-128", NB5, B5, 1024, 128, True, True, rng)
-        case("c5-all-2048-128", NB5, B5, 2048, 128, True, True, rng)
-    except Exception as e:
-        log(f"config5-scale cases failed: {type(e).__name__}: {e}")
+    import os
+
+    which = os.environ.get("SWEEP5_CASES", "skip2-2048-256,all-1024-128")
+    matrix = {
+        "base": (NB, B, 2048, 256, False, False),
+        "geom-1024-128": (NB, B, 1024, 128, False, False),
+        "geom-512-64": (NB, B, 512, 64, False, False),
+        "skip2-2048-256": (NB, B, 2048, 256, False, True),
+        "all-1024-128": (NB, B, 1024, 128, False, True),
+        "all-512-64": (NB, B, 512, 64, False, True),
+        # config5 scale: 16.7M bucket rows (8 GiB), 1M updates
+        "c5-base": (1 << 24, 1 << 20, 2048, 256, False, False),
+        "c5-all-1024-128": (1 << 24, 1 << 20, 1024, 128, False, True),
+        "c5-all-512-64": (1 << 24, 1 << 20, 512, 64, False, True),
+    }
+    for name in which.split(","):
+        try:
+            nb, b, blk, u, marker, skip2 = matrix[name.strip()]
+            case(name.strip(), nb, b, blk, u, marker, skip2, rng)
+        except Exception as e:
+            log(f"[{name}] FAILED: {type(e).__name__}: {e}")
 
 
 if __name__ == "__main__":

@@ -36,6 +36,7 @@ from gubernator_tpu.ops.kernel2 import (
     decide2_packed_cols,
     install2,
     pack_outputs,
+    unpack_outputs,
 )
 from gubernator_tpu.ops.plan import plan_passes
 from gubernator_tpu.ops.table2 import Table2, new_table2
@@ -65,6 +66,14 @@ def _pad_size(n: int, floor: int = 16) -> int:
     while size < n:
         size *= 2
     return size
+
+
+def _math_mode(hb: HostBatch) -> str:
+    """Static kernel specialization chosen host-side per dispatch: an
+    all-token batch (the common case — token is the reference's default
+    algorithm) compiles the decision graph without the emulated-f64 leaky
+    lanes (ops/math.bucket_math). Padding rows carry algo=0 (token)."""
+    return "mixed" if hb.algo.any() else "token"
 
 
 @dataclass
@@ -387,15 +396,19 @@ class LocalEngine:
             return np.asarray(pack_outputs(resp, stats))
         dev = jax.device_put(pack_host_batch(hb))
         write = self._write_mode_for(hb.fp.shape[0])
-        self.table, packed = decide2_packed_cols(self.table, dev, write=write)
+        self.table, packed = decide2_packed_cols(
+            self.table, dev, write=write, math=_math_mode(hb)
+        )
         return np.asarray(packed)
 
-    def _issue_from_dev(self, dev_arr, batch_rows: int) -> "jax.Array":
+    def _issue_from_dev(self, dev_arr, batch_rows: int, math: str) -> "jax.Array":
         """Issue one dispatch from a staged ingress array WITHOUT fetching:
         the table advances immediately; the packed output is fetched later
         on a fetch thread while this thread launches the next dispatch."""
         write = self._write_mode_for(batch_rows)
-        self.table, packed = decide2_packed_cols(self.table, dev_arr, write=write)
+        self.table, packed = decide2_packed_cols(
+            self.table, dev_arr, write=write, math=math
+        )
         return packed
 
     # ------------------------------------------------- pipelined protocol
@@ -404,29 +417,22 @@ class LocalEngine:
     # engine so mesh engines can substitute routed grids (parallel/sharded.py).
 
     def stage_pass(self, pass_batch: HostBatch, n: int):
-        """(padded batch, staged ingress array) for one unique-fp pass."""
+        """(padded batch, staged ingress array + static math mode) for one
+        unique-fp pass."""
         import jax
 
         batch = pad_batch(pass_batch, _pad_size(n))
-        return batch, jax.device_put(pack_host_batch(batch))
+        return batch, (jax.device_put(pack_host_batch(batch)), _math_mode(batch))
 
     def issue_staged(self, staged, batch_rows: int):
+        dev, math = staged
         self._seen_pad_sizes.add(batch_rows)
-        return self._issue_from_dev(staged, batch_rows)
+        return self._issue_from_dev(dev, batch_rows, math)
 
     def finish_staged(self, pending, n: int):
         """Materialize one pass's packed output → ((s, l, r, t, dropped,
-        hit), (hits, misses, over, evicted)). Response arrays are writable
-        (retry fix-ups mutate them in place)."""
-        arr = np.asarray(pending)
-        st = (int(arr[-2, 0]), int(arr[-2, 1]), int(arr[-2, 2]), int(arr[-2, 3]))
-        l = arr[:n, 0].copy()
-        r = arr[:n, 1].copy()
-        t = arr[:n, 2].copy()
-        s = (arr[:n, 3] & 1).astype(np.int32)
-        hit = (arr[:n, 3] & 2) != 0
-        dropped = (arr[:n, 3] & 4) != 0
-        return (s, l, r, t, dropped, hit), st
+        hit), (hits, misses, over, evicted))."""
+        return unpack_outputs(np.asarray(pending), n)
 
     def _redispatch_rows(self, batch, n: int):
         """Re-dispatch rows whose phase-1 claim dropped (pipelined retry):
@@ -434,38 +440,44 @@ class LocalEngine:
         were already counted by the dropped phase-1 pass, exactly like the
         sync path's retry loop."""
         batch = pad_batch(batch, _pad_size(n))
-        arr = self._decide_packed(batch)
+        (status, limit, remaining, reset, dropped, hit), st = unpack_outputs(
+            self._decide_packed(batch), n
+        )
         self.stats.dispatches += 1
-        self.stats.evicted_unexpired += int(arr[-2, 3])
-        limit = arr[:n, 0].copy()
-        remaining = arr[:n, 1].copy()
-        reset = arr[:n, 2].copy()
-        status = (arr[:n, 3] & 1).astype(np.int32)
-        hit = (arr[:n, 3] & 2) != 0
-        dropped = (arr[:n, 3] & 4) != 0
+        self.stats.evicted_unexpired += st[3]
         # this first dispatch already IS retry #1 of the dropped phase-1
         # rows, so the loop allows max_claim_retries-1 more — same total
         # attempt budget as the sync path
-        retries = 1
+        dropped = self._retry_dropped(
+            batch, n, status, limit, remaining, reset, dropped, hit, retries=1
+        )
+        self.stats.dropped += int(dropped.sum())
+        return status, limit, remaining, reset, dropped, hit
+
+    def _retry_dropped(
+        self, batch, n, status, limit, remaining, reset, dropped, hit, retries
+    ):
+        """Shared claim-drop retry loop: re-dispatch dropped rows (evictions +
+        dispatches counted only) until persisted or the attempt budget runs
+        out. Mutates the response arrays in place; returns the final dropped
+        mask."""
         while dropped.any() and retries < self.max_claim_retries:
             rows = np.nonzero(dropped)[0]
             sub = HostBatch(*[f[:n][rows] for f in batch])
             sub = pad_batch(sub, _pad_size(len(rows)))
-            arr = self._decide_packed(sub)
-            self.stats.dispatches += 1
-            self.stats.evicted_unexpired += int(arr[-2, 3])
             m = len(rows)
-            limit[rows] = arr[:m, 0]
-            remaining[rows] = arr[:m, 1]
-            reset[rows] = arr[:m, 2]
-            status[rows] = (arr[:m, 3] & 1).astype(np.int32)
-            hit[rows] = (arr[:m, 3] & 2) != 0
+            (s2, l2, r2, t2, d2, h2), st = unpack_outputs(
+                self._decide_packed(sub), m
+            )
+            self.stats.dispatches += 1
+            self.stats.evicted_unexpired += st[3]
+            status[rows], limit[rows], remaining[rows], reset[rows] = s2, l2, r2, t2
+            hit[rows] = h2
             nd = np.zeros(n, dtype=bool)
-            nd[rows] = (arr[:m, 3] & 4) != 0
+            nd[rows] = d2
             dropped = nd
             retries += 1
-        self.stats.dropped += int(dropped.sum())
-        return status, limit, remaining, reset, dropped, hit
+        return dropped
 
     def _write_mode_for(self, batch: int) -> str:
         """Pick the write strategy per dispatch. The Pallas sweep streams the
@@ -522,36 +534,17 @@ class LocalEngine:
         only authoritative once persisted. Rows still unpersisted after
         `max_claim_retries` surface a per-item error (`ERR_NOT_PERSISTED`)."""
         self._seen_pad_sizes.add(int(batch.fp.shape[0]))
-        arr = self._decide_packed(batch)
-        self.stats.cache_hits += int(arr[-2, 0])
-        self.stats.cache_misses += int(arr[-2, 1])
-        self.stats.over_limit += int(arr[-2, 2])
-        self.stats.evicted_unexpired += int(arr[-2, 3])
+        (status, limit, remaining, reset, dropped, hit), st = unpack_outputs(
+            self._decide_packed(batch), n
+        )
+        self.stats.cache_hits += st[0]
+        self.stats.cache_misses += st[1]
+        self.stats.over_limit += st[2]
+        self.stats.evicted_unexpired += st[3]
         self.stats.dispatches += 1
-        limit = arr[:n, 0].copy()
-        remaining = arr[:n, 1].copy()
-        reset = arr[:n, 2].copy()
-        status = (arr[:n, 3] & 1).astype(np.int32)
-        hit = (arr[:n, 3] & 2) != 0
-        dropped = (arr[:n, 3] & 4) != 0
-        retries = 0
-        while dropped.any() and retries < self.max_claim_retries:
-            rows = np.nonzero(dropped)[0]
-            sub = HostBatch(*[f[:n][rows] for f in batch])
-            sub = pad_batch(sub, _pad_size(len(rows)))
-            arr = self._decide_packed(sub)
-            self.stats.dispatches += 1
-            self.stats.evicted_unexpired += int(arr[-2, 3])
-            m = len(rows)
-            limit[rows] = arr[:m, 0]
-            remaining[rows] = arr[:m, 1]
-            reset[rows] = arr[:m, 2]
-            status[rows] = (arr[:m, 3] & 1).astype(np.int32)
-            hit[rows] = (arr[:m, 3] & 2) != 0
-            nd = np.zeros(n, dtype=bool)
-            nd[rows] = (arr[:m, 3] & 4) != 0
-            dropped = nd
-            retries += 1
+        dropped = self._retry_dropped(
+            batch, n, status, limit, remaining, reset, dropped, hit, retries=0
+        )
         # only rows still unpersisted after retries count as dropped
         self.stats.dropped += int(dropped.sum())
         return status, limit, remaining, reset, dropped, hit
@@ -665,19 +658,24 @@ class LocalEngine:
         self.stats.evicted_unexpired += dropped
         # warm compiles for the new geometry with all-inactive dummy batches
         # (no state mutation — _decide_packed counts nothing itself, and all
-        # rows are inactive)
+        # rows are inactive). Both static math variants warm: algo=0 rows
+        # compile the token graph, a leaky row the mixed one (_math_mode).
         for size in sorted(self._seen_pad_sizes):
             z64 = np.zeros(size, dtype=np.int64)
-            dummy = HostBatch(
-                fp=z64, algo=np.zeros(size, dtype=np.int32),
-                behavior=np.zeros(size, dtype=np.int32), hits=z64,
-                limit=np.ones(size, dtype=np.int64), burst=z64,
-                duration=np.ones(size, dtype=np.int64), created_at=z64,
-                expire_new=z64, greg_interval=z64,
-                duration_eff=np.ones(size, dtype=np.int64),
-                active=np.zeros(size, dtype=bool),
-            )
-            self._decide_packed(dummy)
+            for leaky in (False, True):
+                algo = np.zeros(size, dtype=np.int32)
+                if leaky:
+                    algo[0] = 1
+                dummy = HostBatch(
+                    fp=z64, algo=algo,
+                    behavior=np.zeros(size, dtype=np.int32), hits=z64,
+                    limit=np.ones(size, dtype=np.int64), burst=z64,
+                    duration=np.ones(size, dtype=np.int64), created_at=z64,
+                    expire_new=z64, greg_interval=z64,
+                    duration_eff=np.ones(size, dtype=np.int64),
+                    active=np.zeros(size, dtype=bool),
+                )
+                self._decide_packed(dummy)
         return dropped
 
     def maybe_grow(

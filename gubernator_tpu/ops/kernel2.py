@@ -19,9 +19,15 @@ per 131K-row dispatch on v5e) with the design measured fastest on real TPU
   4. **write** — the update set becomes (payload, lane-mask) rows composed into
      bucket rows by a **Pallas sweep**: the table streams through VMEM in
      (BLK, 128) blocks while int8 one-hot matmuls on the MXU scatter each
-     block's updates into place (~3.3 ms for a 1 GB table — the DMA fully hides
-     the matmuls). XLA scatter fallback (`write="xla"`) keeps identical
+     block's updates into place; blocks whose update run fits their first
+     u-window skip the second half's matmuls via a scalar-prefetched
+     predicate (~4.2 ms for a 1 GiB table at headline batch,
+     exp/exp_sweep5.py). XLA scatter fallback (`write="xla"`) keeps identical
      semantics for CPU meshes/tests.
+
+Dispatches are additionally specialized host-side by `math="token"|"mixed"`
+(engine._math_mode): all-token batches — the common case — compile a decision
+graph with no emulated-float64 leaky lanes (see ops/math.bucket_math).
 
 Same decision semantics as v1 (reference algorithms.go:37-492 via
 ops/math.py). Documented divergence from v1: slot-vacancy uses the exact
@@ -108,8 +114,12 @@ def sweep_geometry(n_buckets: int, batch: int) -> Tuple[int, int]:
 
     U covers the expected per-block update count plus a ~5-sigma Poisson tail
     (overflow rows are dropped → engine retry, so the tail bound is a perf
-    knob, not correctness). BLK shrinks until the (BLK, U) one-hot operand
-    fits VMEM comfortably."""
+    knob, not correctness). BLK stays as LARGE as VMEM allows: the sweep's
+    cost is dominated by per-block pipeline overhead, not the one-hot MXU
+    work — exp/exp_sweep5.py measured 4.20 ms at (2048, 256) vs 6.34 ms at
+    (1024, 128) for the same headline update set, even though the smaller
+    window runs half the matmul MACs. BLK shrinks only until the (BLK, U)
+    one-hot operand fits VMEM comfortably."""
     blk = min(2048, n_buckets)
     if n_buckets % blk:
         # tables built by new_table2 are always conforming (power-of-two below
@@ -285,10 +295,14 @@ def _make_sweep_kernel(nwin: int, blk: int, u: int):
     The previous design materialized (nblk·u) host-side window gathers —
     measured 8 ms of the 16 ms write at headline scale; in-kernel windowing
     plus one payload gather runs the same sweep in ~3.3 ms (≈600 GB/s through
-    a 1 GiB table)."""
+    a 1 GiB table). The second half's matmuls only run when this block's
+    update run actually crosses its first window boundary (`need2`, scalar-
+    prefetched per block) — runs are ~mean-length and windows u-aligned, so
+    most blocks take the single-half branch and the MXU work per sweep drops
+    by roughly the non-straddle fraction."""
     KBLK = K * blk
 
-    def kern(s_ref, p1, p2, t1, t2, tbl_in, tbl_out):
+    def kern(s_ref, n2_ref, p1, p2, t1, t2, tbl_in, tbl_out):
         i = pl.program_id(0)
         blk_base = i * KBLK
         dot = functools.partial(
@@ -297,11 +311,11 @@ def _make_sweep_kernel(nwin: int, blk: int, u: int):
             preferred_element_type=i32,
         )
 
-        def half(pay_ref, tgt_ref, valid):
+        def half(pay_ref, tgt_ref):
             pay = pay_ref[:]  # (u, F) i32 payload, sorted-by-target
             tgt = tgt_ref[:]  # (u, 1) i32 global slot target (sentinel NBK)
             rel = tgt - blk_base
-            live = (rel >= 0) & (rel < KBLK) & valid
+            live = (rel >= 0) & (rel < KBLK)
             slot = jnp.where(live, rel % K, -1)  # (u, 1)
             lb = jnp.where(live, rel // K, -1)  # (u, 1)
             # lane l of a bucket row belongs to slot l//16, field l%16
@@ -322,10 +336,18 @@ def _make_sweep_kernel(nwin: int, blk: int, u: int):
                 acc = p if acc is None else acc | p
             return acc, w
 
-        second_ok = s_ref[i] + 1 <= nwin - 1
-        acc1, w1 = half(p1, t1, True)
-        acc2, w2 = half(p2, t2, second_ok)
-        tbl_out[:] = jnp.where(w1 + w2 > 0, acc1 | acc2, tbl_in[:])
+        # need2 ⇒ s+1 ≤ nwin-1 (a run never extends past the batch end), so
+        # the second window's block index is always in range on this branch
+        @pl.when(n2_ref[i] != 0)
+        def _():
+            acc1, w1 = half(p1, t1)
+            acc2, w2 = half(p2, t2)
+            tbl_out[:] = jnp.where(w1 + w2 > 0, acc1 | acc2, tbl_in[:])
+
+        @pl.when(n2_ref[i] == 0)
+        def _():
+            acc1, w1 = half(p1, t1)
+            tbl_out[:] = jnp.where(w1 > 0, acc1, tbl_in[:])
 
     return kern
 
@@ -346,20 +368,25 @@ def _write_sweep(rows_tbl, new16, c: Claim2, blk: int, u: int):
     starts = jnp.searchsorted(
         c.tgt_sorted, (jnp.arange(nblk, dtype=i32) * (K * blk)).astype(i32)
     ).astype(i32)
+    ends = jnp.concatenate([starts[1:], jnp.full((1,), B, dtype=i32)])
     s_blk = jnp.clip(starts // u, 0, nwin - 1)
+    # does block i's update run cross its first window's end? (ends ≤ B, so
+    # need2 ⇒ s_blk+1 ≤ nwin-1; blocks whose run fits one window skip the
+    # second half's matmuls entirely)
+    need2 = (ends > (s_blk + 1) * u).astype(i32)
 
-    second = lambda i, s: (jnp.minimum(s[i] + 1, nwin - 1), 0)
+    second = lambda i, s, n2: (jnp.minimum(s[i] + 1, nwin - 1), 0)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=(nblk,),
         in_specs=[
-            pl.BlockSpec((u, F), lambda i, s: (s[i], 0)),
+            pl.BlockSpec((u, F), lambda i, s, n2: (s[i], 0)),
             pl.BlockSpec((u, F), second),
-            pl.BlockSpec((u, 1), lambda i, s: (s[i], 0)),
+            pl.BlockSpec((u, 1), lambda i, s, n2: (s[i], 0)),
             pl.BlockSpec((u, 1), second),
-            pl.BlockSpec((blk, ROW), lambda i, s: (i, 0)),
+            pl.BlockSpec((blk, ROW), lambda i, s, n2: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((blk, ROW), lambda i, s: (i, 0)),
+        out_specs=pl.BlockSpec((blk, ROW), lambda i, s, n2: (i, 0)),
     )
     with jax.enable_x64(False):
         out = pl.pallas_call(
@@ -367,8 +394,8 @@ def _write_sweep(rows_tbl, new16, c: Claim2, blk: int, u: int):
             interpret=jax.default_backend() == "cpu",
             out_shape=jax.ShapeDtypeStruct(rows_tbl.shape, rows_tbl.dtype),
             grid_spec=grid_spec,
-            input_output_aliases={5: 0},
-        )(s_blk, pay_s, pay_s, tgt_eff, tgt_eff, rows_tbl)
+            input_output_aliases={6: 0},
+        )(s_blk, need2, pay_s, pay_s, tgt_eff, tgt_eff, rows_tbl)
     return out
 
 
@@ -386,9 +413,13 @@ def _write_xla(rows_tbl, new16, c: Claim2):
 
 
 def decide2_impl(
-    table: Table2, req: ReqBatch, *, write: str = "sweep"
+    table: Table2, req: ReqBatch, *, write: str = "sweep", math: str = "mixed"
 ) -> Tuple[Table2, RespBatch, BatchStats]:
-    """Un-jitted v2 kernel body — call through `decide2` / `decide2_xla`."""
+    """Un-jitted v2 kernel body — call through `decide2` / `decide2_xla`.
+
+    `math="token"` compiles the token-only decision graph (no emulated-f64
+    leaky lanes — see ops/math.bucket_math); the engine selects it per
+    dispatch after a host-side check that the batch carries no leaky row."""
     B = req.fp.shape[0]
     NB = table.rows.shape[0]
     blk, u = sweep_geometry(NB, B)
@@ -417,12 +448,17 @@ def decide2_impl(
         rem_f=jax.lax.bitcast_convert_type(g(REMF_HI), f32).astype(f64)
         + jax.lax.bitcast_convert_type(g(REMF_LO), f32).astype(f64),
     )
-    d = bucket_math(stored, req, exists)
+    d = bucket_math(stored, req, exists, token_only=math == "token")
 
     # ---- build update payload rows
     sat32 = lambda x: jnp.clip(x, -(2**31), 2**31 - 1).astype(i32)
-    remf_hi = d.rem_f_out.astype(f32)
-    remf_lo = (d.rem_f_out - remf_hi.astype(f64)).astype(f32)
+    if math == "token":
+        # token items store no fractional remainder — skip the f64 split
+        remf_hi = jnp.zeros(B, dtype=f32)
+        remf_lo = jnp.zeros(B, dtype=f32)
+    else:
+        remf_hi = d.rem_f_out.astype(f32)
+        remf_lo = (d.rem_f_out - remf_hi.astype(f64)).astype(f32)
     my_lo = _lo32(req.fp)
     my_hi = _hi32(req.fp)
     zero = jnp.zeros_like(my_lo)
@@ -474,9 +510,9 @@ def decide2_impl(
     return Table2(rows=rows_out), resp, stats
 
 
-decide2 = functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("write",))(
-    decide2_impl
-)
+decide2 = functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("write", "math")
+)(decide2_impl)
 
 
 def pack_outputs(resp: RespBatch, stats: BatchStats) -> jnp.ndarray:
@@ -504,10 +540,34 @@ def pack_outputs(resp: RespBatch, stats: BatchStats) -> jnp.ndarray:
     return jnp.concatenate([rows, srow0, srow1], axis=0)
 
 
+# flag bits of pack_outputs' 4th column — the single source of truth for
+# every host-side decoder (engine unpack, sharded un-route)
+FLAG_STATUS = 1
+FLAG_HIT = 2
+FLAG_DROPPED = 4
+
+
+def unpack_outputs(arr, n: int):
+    """Decode a fetched pack_outputs array (host-side): (B+2, 4) i64 →
+    ((status, limit, remaining, reset_time, dropped, hit), (cache_hits,
+    cache_misses, over_limit, evicted_unexpired)). Response arrays are
+    writable copies (retry fix-ups mutate them in place)."""
+    import numpy as np
+
+    st = (int(arr[-2, 0]), int(arr[-2, 1]), int(arr[-2, 2]), int(arr[-2, 3]))
+    limit = arr[:n, 0].copy()
+    remaining = arr[:n, 1].copy()
+    reset = arr[:n, 2].copy()
+    status = (arr[:n, 3] & FLAG_STATUS).astype(np.int32)
+    hit = (arr[:n, 3] & FLAG_HIT) != 0
+    dropped = (arr[:n, 3] & FLAG_DROPPED) != 0
+    return (status, limit, remaining, reset, dropped, hit), st
+
+
 def decide2_packed_impl(
-    table: Table2, req: ReqBatch, *, write: str = "sweep"
+    table: Table2, req: ReqBatch, *, write: str = "sweep", math: str = "mixed"
 ) -> Tuple[Table2, jnp.ndarray]:
-    table, resp, stats = decide2_impl(table, req, write=write)
+    table, resp, stats = decide2_impl(table, req, write=write, math=math)
     return table, pack_outputs(resp, stats)
 
 
@@ -532,16 +592,16 @@ def req_from_arr(arr: jnp.ndarray) -> ReqBatch:
 
 
 def decide2_packed_cols_impl(
-    table: Table2, arr: jnp.ndarray, *, write: str = "sweep"
+    table: Table2, arr: jnp.ndarray, *, write: str = "sweep", math: str = "mixed"
 ) -> Tuple[Table2, jnp.ndarray]:
     """Single-transfer serving entry: packed ingress array in, packed
     output array out — one host→device put and one device→host fetch per
     dispatch regardless of column count."""
-    return decide2_packed_impl(table, req_from_arr(arr), write=write)
+    return decide2_packed_impl(table, req_from_arr(arr), write=write, math=math)
 
 
 decide2_packed_cols = functools.partial(
-    jax.jit, donate_argnums=(0,), static_argnames=("write",)
+    jax.jit, donate_argnums=(0,), static_argnames=("write", "math")
 )(decide2_packed_cols_impl)
 
 

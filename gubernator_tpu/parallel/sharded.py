@@ -39,7 +39,13 @@ from gubernator_tpu.ops.batch import (
     pack_host_batch,
 )
 from gubernator_tpu.ops.kernel2 import decide2_packed_cols_impl, install2_impl
-from gubernator_tpu.ops.engine import EngineStats, default_write_mode, ms_now, _pad_size
+from gubernator_tpu.ops.engine import (
+    EngineStats,
+    _math_mode,
+    _pad_size,
+    default_write_mode,
+    ms_now,
+)
 from gubernator_tpu.ops.plan import _subset
 from gubernator_tpu.ops.table2 import Table2, new_table2
 from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_of
@@ -50,7 +56,7 @@ def _stack_tree(trees):
     return jax.tree.map(lambda *xs: np.stack(xs), *trees)
 
 
-def make_sharded_decide(mesh: Mesh):
+def make_sharded_decide(mesh: Mesh, math: str = "mixed"):
     """Build the jitted all-shards decision step over the SINGLE-TRANSFER
     packed layout: (Table2[D,·], (D, 12, b) i64 ingress grid) → (Table2',
     (D, b+2, 4) i64 packed outputs). Each device unpacks its ingress block
@@ -58,12 +64,15 @@ def make_sharded_decide(mesh: Mesh):
     (kernel2.pack_outputs) — one host→device put and ONE device→host fetch
     per mesh dispatch, however many shards (the per-column transfer layout
     cost 12 puts + 6 grid fetches per dispatch). Write mode is resolved once
-    at build time (Pallas sweep on TPU, XLA scatter on CPU test meshes)."""
+    at build time (Pallas sweep on TPU, XLA scatter on CPU test meshes);
+    `math` picks the token-only or mixed decision graph (engine._math_mode)."""
     write = default_write_mode()
 
     def per_device(table: Table2, arr: jnp.ndarray):
         table = jax.tree.map(lambda x: x[0], table)
-        table, packed = decide2_packed_cols_impl(table, arr[0], write=write)
+        table, packed = decide2_packed_cols_impl(
+            table, arr[0], write=write, math=math
+        )
         expand = lambda t: jax.tree.map(lambda x: x[None], t)
         return expand(table), packed[None]
 
@@ -123,7 +132,7 @@ class ShardedEngine:
         self.created_at_tolerance_ms = created_at_tolerance_ms
         self.n_shards = int(mesh.devices.size)
         self.table = new_sharded_table(mesh, capacity_per_shard)
-        self._decide = make_sharded_decide(mesh)
+        self._decide_fns = {}  # math mode → jitted mesh step (built lazily)
         self._install = make_sharded_install(mesh)
         self._batch_sharding = NamedSharding(mesh, P(SHARD_AXIS))
         self.max_exact_passes = max_exact_passes
@@ -272,9 +281,17 @@ class ShardedEngine:
         staged = self._stage(pass_batch, None)
         return pass_batch, staged
 
+    def _decide(self, table: Table2, staged: "_Staged"):
+        fn = self._decide_fns.get(staged.math)
+        if fn is None:
+            fn = self._decide_fns[staged.math] = make_sharded_decide(
+                self.mesh, math=staged.math
+            )
+        return fn(table, staged.dev)
+
     def issue_staged(self, staged: "_Staged", batch_rows: int):
         # dispatch count is folded in via the finish delta (engine thread)
-        table, out = self._decide(self.table, staged.dev)
+        table, out = self._decide(self.table, staged)
         self.table = table
         return staged, out
 
@@ -304,17 +321,23 @@ class ShardedEngine:
         grid = np.zeros((D, 12, b_local), dtype=np.int64)
         grid[rs, :, offset] = packed[:, order].T
         dev = jax.device_put(grid, self._batch_sharding)
-        return _Staged(order=order, rs=rs, offset=offset, b_local=b_local, dev=dev)
+        return _Staged(
+            order=order, rs=rs, offset=offset, b_local=b_local, dev=dev,
+            math=_math_mode(batch),
+        )
 
     def _unroute(self, staged: "_Staged", outh: np.ndarray, n: int):
         """Decode the fetched (D, b_local+2, 4) packed output grid back to
-        pass-row order + summed per-device stats."""
+        pass-row order + summed per-device stats (flag bits shared with the
+        single-device decoder, kernel2.FLAG_*/unpack_outputs)."""
+        from gubernator_tpu.ops.kernel2 import FLAG_DROPPED, FLAG_HIT, FLAG_STATUS
+
         st = outh[:, staged.b_local, :].sum(axis=0)  # hits/misses/over/evicted
         per = np.empty((n, 4), dtype=np.int64)
         per[staged.order] = outh[staged.rs, staged.offset]
-        status = (per[:, 3] & 1).astype(np.int32)
-        hit = (per[:, 3] & 2) != 0
-        dropped = (per[:, 3] & 4) != 0
+        status = (per[:, 3] & FLAG_STATUS).astype(np.int32)
+        hit = (per[:, 3] & FLAG_HIT) != 0
+        dropped = (per[:, 3] & FLAG_DROPPED) != 0
         return (
             status, per[:, 0], per[:, 1], per[:, 2], dropped, hit,
             (int(st[0]), int(st[1]), int(st[2]), int(st[3])),
@@ -338,7 +361,7 @@ class ShardedEngine:
         n = batch.fp.shape[0]
         routed = shard if shard is not None else shard_of(batch.fp, self.n_shards)
         staged = self._stage(batch, routed)
-        table, out = self._decide(getattr(self, table_attr), staged.dev)
+        table, out = self._decide(getattr(self, table_attr), staged)
         setattr(self, table_attr, table)
         self.stats.dispatches += 1
         status, limit, remaining, reset, dropped, hit, st = self._unroute(
@@ -385,6 +408,7 @@ class _Staged(NamedTuple):
     offset: np.ndarray  # (n,) position within the shard's grid row
     b_local: int  # padded per-shard width
     dev: object  # (D, 12, b_local) i64 device grid, shard-per-device
+    math: str  # static decision-graph mode ("token" | "mixed")
 
 
 def _route_plan(routed: np.ndarray, D: int):

@@ -312,19 +312,26 @@ class Daemon:
         """Compile the decision + install kernels for the smallest batch shape
         BEFORE serving: the first XLA compile takes seconds, which would blow
         the 500 ms peer-RPC budgets (global_timeout, batch_timeout) and drop
-        the first GLOBAL sync round of a fresh daemon."""
-        warm = RequestColumns(
-            fp=np.asarray([1], dtype=np.int64),
-            algo=np.zeros(1, dtype=np.int32),
-            behavior=np.zeros(1, dtype=np.int32),
-            hits=np.zeros(1, dtype=np.int64),
-            limit=np.ones(1, dtype=np.int64),
-            burst=np.zeros(1, dtype=np.int64),
-            duration=np.ones(1, dtype=np.int64),  # expires ~immediately
-            created_at=np.zeros(1, dtype=np.int64),
-            err=np.zeros(1, dtype=np.int8),
-        )
-        await self.runner.check_columns(warm)
+        the first GLOBAL sync round of a fresh daemon. Both static math
+        variants compile (engine._math_mode picks per dispatch): an all-token
+        warm batch alone would leave the first leaky-carrying request to pay
+        the mixed graph's compile on the request path."""
+        for algo in (
+            np.zeros(1, dtype=np.int32),  # math="token" graph
+            np.ones(1, dtype=np.int32),  # math="mixed" graph
+        ):
+            warm = RequestColumns(
+                fp=np.asarray([1], dtype=np.int64),
+                algo=algo,
+                behavior=np.zeros(1, dtype=np.int32),
+                hits=np.zeros(1, dtype=np.int64),
+                limit=np.ones(1, dtype=np.int64),
+                burst=np.zeros(1, dtype=np.int64),
+                duration=np.ones(1, dtype=np.int64),  # expires ~immediately
+                created_at=np.zeros(1, dtype=np.int64),
+                err=np.zeros(1, dtype=np.int8),
+            )
+            await self.runner.check_columns(warm)
         await self.runner.install_columns(
             fp=np.asarray([1], dtype=np.int64),
             algo=np.zeros(1, dtype=np.int32),

@@ -98,6 +98,53 @@ def test_sweep_write_matches_xla_write():
     assert np.array_equal(np.asarray(ex.table.rows), np.asarray(es.table.rows))
 
 
+def test_token_math_matches_mixed_math():
+    """The static token-only decision graph (engine._math_mode picks it for
+    all-token batches) must be bit-identical to the mixed graph's token lanes
+    — responses AND stored table. Guards against the two branches of
+    ops/math._bucket_math_impl drifting apart."""
+    import jax
+
+    from gubernator_tpu.ops.batch import pack_requests, pad_batch, to_device
+    from gubernator_tpu.ops.kernel2 import decide2_impl
+    from gubernator_tpu.ops.table2 import new_table2
+
+    rng = np.random.default_rng(13)
+    now = NOW
+    tt = new_table2(4096)
+    tm = new_table2(4096)
+    for step in range(3):
+        import dataclasses
+
+        reqs = [
+            dataclasses.replace(r, algorithm=Algorithm.TOKEN_BUCKET)
+            for r in random_requests(rng, 64, keyspace=40, now=now)
+        ]
+        hb, _ = pack_requests(reqs, now)
+        # unique fps per dispatch (the kernel contract): keep first occurrence
+        _, first = np.unique(hb.fp, return_index=True)
+        sub = pad_batch(
+            type(hb)(*[f[np.sort(first)] for f in hb]), 64
+        )
+        req = to_device(sub)
+        tt, resp_t, stats_t = jax.jit(
+            lambda t, b: decide2_impl(t, b, write="xla", math="token")
+        )(tt, req)
+        tm, resp_m, stats_m = jax.jit(
+            lambda t, b: decide2_impl(t, b, write="xla", math="mixed")
+        )(tm, req)
+        for field in resp_t._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(resp_t, field)),
+                np.asarray(getattr(resp_m, field)),
+                err_msg=f"resp.{field} step {step}",
+            )
+        for field in stats_t._fields:
+            assert int(getattr(stats_t, field)) == int(getattr(stats_m, field))
+        now += 700
+    assert np.array_equal(np.asarray(tt.rows), np.asarray(tm.rows))
+
+
 def test_v2_bucket_overflow_evicts_soonest_expiring():
     """9 keys forced into one bucket of 8 lanes: the 9th insert evicts the
     soonest-expiring live slot (expiry-stamp eviction, reference
