@@ -134,6 +134,10 @@ class DaemonConfig:
     # (0 = fixed size like the reference's LRU; >0 = growth ceiling in slots)
     cache_max_size: int = 0
     engine: str = "local"  # "local" (one device) | "sharded" (mesh)
+    # sharded request routing: "host" (ownership grid built host-side) |
+    # "device" (arrival-order rows, on-mesh all_to_all exchange — the
+    # multi-host-scale path, parallel/a2a.py)
+    shard_route: str = "host"
     workers: int = 0  # 0 = auto; host-side executor width
 
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
@@ -275,6 +279,10 @@ class DaemonConfig:
             )
         if self.engine not in ("local", "sharded"):
             raise ConfigError(f"GUBER_ENGINE: must be local or sharded, got {self.engine!r}")
+        if self.shard_route not in ("host", "device"):
+            raise ConfigError(
+                f"GUBER_SHARD_ROUTE: must be host or device, got {self.shard_route!r}"
+            )
         if self.cache_size <= 0:
             raise ConfigError("GUBER_CACHE_SIZE must be positive")
         if self.behaviors.batch_limit <= 0 or self.behaviors.batch_limit > 1000:
@@ -310,6 +318,7 @@ def setup_daemon_config(
         cache_size=_get_int(env, "GUBER_CACHE_SIZE", 50_000),
         cache_max_size=_get_int(env, "GUBER_CACHE_MAX_SIZE", 0),
         engine=_get(env, "GUBER_ENGINE", "local"),
+        shard_route=_get(env, "GUBER_SHARD_ROUTE", "host"),
         workers=_get_int(env, "GUBER_WORKER_COUNT", 0),
         behaviors=BehaviorConfig(
             batch_timeout_ms=_get_float_ms(env, "GUBER_BATCH_TIMEOUT", 500.0),

@@ -301,7 +301,9 @@ def finish_check_columns(
     reset = np.zeros(n, dtype=np.int64)
     delta = EngineStats(created_at_clamped=pending.clamped, checks=n)
     for pi, (p, np_, batch, pend) in enumerate(pending.passes):
-        (s, l, r, t, dropped, hit), st = engine.finish_staged(pend, np_)
+        (s, l, r, t, dropped, hit), st, uncounted = engine.finish_staged(
+            pend, np_
+        )
         delta.cache_hits += st[0]
         delta.cache_misses += st[1]
         delta.over_limit += st[2]
@@ -313,11 +315,14 @@ def finish_check_columns(
             # like the sync path's retry loop
             rows = np.nonzero(dropped)[0]
 
-            def retry(rows=rows, batch=batch):
+            def retry(rows=rows, batch=batch, uncounted=uncounted):
                 # padding conventions are the engine's own (LocalEngine pads
-                # to _pad_size; ShardedEngine needs no row padding)
+                # to _pad_size; ShardedEngine needs no row padding). Rows the
+                # phase-1 pass never processed (a2a capacity drops) have
+                # their outcome counted by the retry.
                 sub = HostBatch(*[f[rows] for f in batch])
-                return engine._redispatch_rows(sub, len(rows))
+                unc = uncounted[rows] if uncounted is not None else None
+                return engine._redispatch_rows(sub, len(rows), uncounted=unc)
 
             s2, l2, r2, t2, d2, h2 = fixup(retry)
             s[rows], l[rows], r[rows], t[rows] = s2, l2, r2, t2
@@ -431,14 +436,18 @@ class LocalEngine:
 
     def finish_staged(self, pending, n: int):
         """Materialize one pass's packed output → ((s, l, r, t, dropped,
-        hit), (hits, misses, over, evicted))."""
-        return unpack_outputs(np.asarray(pending), n)
+        hit), (hits, misses, over, evicted), uncounted). The single-device
+        kernel probes every row, so `uncounted` is always None here (cf.
+        ShardedEngine's a2a capacity drops)."""
+        outs, st = unpack_outputs(np.asarray(pending), n)
+        return outs, st, None
 
-    def _redispatch_rows(self, batch, n: int):
+    def _redispatch_rows(self, batch, n: int, uncounted=None):
         """Re-dispatch rows whose phase-1 claim dropped (pipelined retry):
         accounts dispatches/evictions/final drops only — hits/misses/over
         were already counted by the dropped phase-1 pass, exactly like the
-        sync path's retry loop."""
+        sync path's retry loop. `uncounted` is a mesh-engine concern
+        (ShardedEngine): ignored here."""
         batch = pad_batch(batch, _pad_size(n))
         (status, limit, remaining, reset, dropped, hit), st = unpack_outputs(
             self._decide_packed(batch), n

@@ -545,6 +545,11 @@ def pack_outputs(resp: RespBatch, stats: BatchStats) -> jnp.ndarray:
 FLAG_STATUS = 1
 FLAG_HIT = 2
 FLAG_DROPPED = 4
+# set ALONGSIDE FLAG_DROPPED for rows that never reached the kernel at all
+# (a2a exchange-capacity overflow, parallel/a2a.py): such rows appear in no
+# kernel stats row, so the engine counts their hit/miss/over outcome at the
+# retry that finally processes them
+FLAG_UNPROCESSED = 8
 
 
 def unpack_outputs(arr, n: int):

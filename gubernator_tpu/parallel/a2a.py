@@ -1,0 +1,143 @@
+"""Device-side request routing: ownership exchange as an ICI all_to_all.
+
+The host-routed path (sharded.py `_stage`) sorts rows by owning shard on the
+host and scatters them into a (D, b_local) grid — O(n) host work (argsort +
+grid scatter) on every dispatch's critical path, run by a single Python
+process feeding the whole mesh. That is fine on one host, but on a real
+multi-host slice each host only feeds its local devices, and per-dispatch
+host routing becomes the scaling bottleneck the r3 review flagged.
+
+This module moves routing ONTO the mesh, MoE-dispatch style (the same
+capacity-factor pattern expert-parallel layers use — see PAPERS.md; the
+scaling-book recipe: annotate, exchange, let ICI do the work):
+
+ 1. the host ships arrival-order rows, zero routing work: the packed (12, n)
+    columns reshape into a (D, 12, c) grid (row i → device i//c);
+ 2. each device computes owners for its c rows (the same high-bits hash as
+    `mesh.shard_of`), sorts locally, and GATHERS rows into a (D, C, 12) send
+    buffer — C is the per-(src,dst) capacity, mean + 5σ of the multinomial
+    per-pair count; rows past a pair's capacity are marked dropped (claim
+    retry re-dispatches them, the MoE "token dropping" analog);
+ 3. ONE `lax.all_to_all` delivers every row to its owning device over ICI
+    (the reference's N×N gRPC forwarding mesh, peer_client.go, collapsed
+    into a collective);
+ 4. the owner runs the decision kernel on its received (D·C) rows;
+ 5. a second all_to_all returns responses to each row's arrival device,
+    which un-sorts them to arrival order.
+
+Output layout matches the host-routed path: (D, c+2, 4) per device — c
+response rows (kernel2.pack_outputs flags) then the 2 stats rows — so the
+engine decodes both paths with the same machinery and ONE fetch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gubernator_tpu.ops.kernel2 import (
+    FLAG_DROPPED,
+    FLAG_UNPROCESSED,
+    decide2_packed_cols_impl,
+)
+from gubernator_tpu.ops.engine import default_write_mode
+from gubernator_tpu.ops.table2 import Table2
+from gubernator_tpu.parallel.mesh import SHARD_AXIS
+
+i32 = jnp.int32
+i64 = jnp.int64
+
+
+def pair_capacity(c: int, D: int) -> int:
+    """Per-(src,dst) row capacity: mean + 5σ of the multinomial count of c
+    hash-routed rows over D destinations, pow2 for shape reuse. Overflow is
+    dropped → engine retry (a perf knob, not correctness), exactly like the
+    sweep's update-window bound (kernel2.sweep_geometry)."""
+    mean = c / D
+    cap = int(mean + 5.0 * mean**0.5) + 8
+    p = 8
+    while p < cap:
+        p *= 2
+    return p
+
+
+def make_a2a_decide(mesh: Mesh, c: int, math: str = "mixed"):
+    """Jitted all-shards decide with ON-DEVICE routing: (Table2[D,·],
+    (D, 12, c) arrival-order grid) → (Table2', (D, c+2, 4) packed outputs in
+    arrival order). `c` rows per device; the per-pair exchange capacity
+    derives from (c, mesh size) — pair_capacity is the single source of
+    truth for the exchange geometry."""
+    write = default_write_mode()
+    D = int(mesh.devices.size)
+    C = pair_capacity(c, D)
+
+    def per_device(table: Table2, arr: jnp.ndarray):
+        table = jax.tree.map(lambda x: x[0], table)
+        a = arr[0]  # (12, c) i64, arrival order
+        fp = a[0]
+        active = a[11] != 0
+        # same ownership hash as mesh.shard_of (high bits; slot uses low)
+        owner = jnp.where(active, ((fp >> 32) % D), D).astype(i32)
+        idx = jnp.arange(c, dtype=i32)
+        o_s, idx_s = jax.lax.sort((owner, idx), num_keys=1)
+        gstart = jnp.searchsorted(o_s, o_s).astype(i32)
+        rank = idx - gstart  # position within the destination's group
+        ok_s = (rank < C) & (o_s < D)
+
+        # send buffer by GATHER (scatters are slow on TPU): slot (d, j) takes
+        # sorted row searchsorted(o_s, d) + j when j < count(d)
+        d_iota = (jnp.arange(D * C, dtype=i32) // C).astype(i32)
+        j_iota = (jnp.arange(D * C, dtype=i32) % C).astype(i32)
+        g0 = jnp.searchsorted(o_s, d_iota).astype(i32)
+        g1 = jnp.searchsorted(o_s, d_iota, side="right").astype(i32)
+        src = g0 + j_iota
+        valid = src < g1
+        rows_sorted = a[:, idx_s]  # (12, c)
+        send = jnp.where(
+            valid[None, :], rows_sorted[:, jnp.clip(src, 0, c - 1)], i64(0)
+        )  # (12, D*C); zeroed slots are inactive (fp=0, active=0)
+        send3 = send.reshape(12, D, C).transpose(1, 0, 2)  # (D, 12, C)
+
+        # ---- ICI: deliver rows to owners; leading axis src↔dst swaps
+        recv = jax.lax.all_to_all(
+            send3, SHARD_AXIS, split_axis=0, concat_axis=0
+        )  # (D, 12, C), leading = source device
+        local = recv.transpose(1, 0, 2).reshape(12, D * C)
+
+        table, packed = decide2_packed_cols_impl(
+            table, local, write=write, math=math
+        )
+        resp = packed[: D * C].reshape(D, C, 4)
+        stats_rows = packed[D * C :]  # (2, 4)
+
+        # ---- ICI: responses ride back to each row's arrival device
+        back = jax.lax.all_to_all(
+            resp, SHARD_AXIS, split_axis=0, concat_axis=0
+        ).reshape(D * C, 4)
+
+        # un-sort to arrival order: arrival row idx_s[p] sat in slot
+        # o_s[p]*C + rank[p]
+        slot_s = jnp.where(ok_s, o_s * C + rank, 0)
+        _, slot_u, ok_u = jax.lax.sort(
+            (idx_s, slot_s, ok_s.astype(i32)), num_keys=1
+        )
+        out = back[slot_u]  # (c, 4)
+        sent = ok_u == 1
+        # capacity-overflow rows: dropped + unprocessed flags — the engine's
+        # claim-retry path re-dispatches them AND counts their hit/miss
+        # outcome there (they appear in no kernel stats row)
+        drop_flags = jnp.where(
+            active, i64(FLAG_DROPPED | FLAG_UNPROCESSED), i64(0)
+        )
+        out = jnp.where(sent[:, None], out, i64(0))
+        out = out.at[:, 3].set(jnp.where(sent, out[:, 3], drop_flags))
+
+        expand = lambda t: jax.tree.map(lambda x: x[None], t)
+        return expand(table), jnp.concatenate([out, stats_rows], axis=0)[None]
+
+    spec = P(SHARD_AXIS)
+    fn = jax.shard_map(
+        per_device, mesh=mesh, in_specs=(spec, spec), out_specs=(spec, spec)
+    )
+    return jax.jit(fn, donate_argnums=(0,))

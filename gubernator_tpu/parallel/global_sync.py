@@ -208,6 +208,7 @@ class GlobalShardedEngine(ShardedEngine):
         sync_out: int = 256,
         created_at_tolerance_ms=None,
         store=None,
+        route: str = "host",
     ):
         super().__init__(
             mesh,
@@ -215,6 +216,7 @@ class GlobalShardedEngine(ShardedEngine):
             max_exact_passes=max_exact_passes,
             created_at_tolerance_ms=created_at_tolerance_ms,
             store=store,
+            route=route,
         )
         # the replica table + collective step materialize on first GLOBAL
         # use: clustered daemons route GLOBAL over the host peer plane and

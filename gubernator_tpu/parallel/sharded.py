@@ -19,6 +19,14 @@ but across chips. Because dispatches route UNIQUE fingerprints (the pass
 planner aggregates same-key duplicates first, ops/plan.py), the hash spread
 over shards stays near-multinomial even under Zipf-skewed traffic — per-shard
 padding is counts.max() over a balanced draw, not the hot key's count.
+
+Two routing modes (ShardedEngine(route=...), GUBER_SHARD_ROUTE):
+* "host" (default): the host sorts rows into the ownership grid — simple and
+  fast on a single-host mesh;
+* "device": the host ships rows in ARRIVAL order and the mesh itself routes
+  them with a capacity-bounded all_to_all exchange (parallel/a2a.py) — zero
+  per-dispatch host routing work, the path that scales to multi-host slices
+  where each host only feeds its local devices.
 """
 
 from __future__ import annotations
@@ -126,13 +134,21 @@ class ShardedEngine:
         max_exact_passes: int = 8,
         created_at_tolerance_ms=None,
         store=None,
+        route: str = "host",
     ):
+        if route not in ("host", "device"):
+            raise ValueError(f"route must be 'host' or 'device', got {route!r}")
         self.mesh = mesh
         # per-engine clock-skew bound; None = the ops.batch process default
         self.created_at_tolerance_ms = created_at_tolerance_ms
         self.n_shards = int(mesh.devices.size)
         self.table = new_sharded_table(mesh, capacity_per_shard)
-        self._decide_fns = {}  # math mode → jitted mesh step (built lazily)
+        # routing mode: "host" sorts rows into an ownership grid on the host;
+        # "device" ships arrival-order rows and routes on-mesh with an
+        # all_to_all exchange (parallel/a2a.py) — zero host routing work,
+        # the multi-host-scale path
+        self.route = route
+        self._decide_fns = {}  # (kind, …, math) → jitted mesh step (lazy)
         self._install = make_sharded_install(mesh)
         self._batch_sharding = NamedSharding(mesh, P(SHARD_AXIS))
         self.max_exact_passes = max_exact_passes
@@ -281,12 +297,23 @@ class ShardedEngine:
         staged = self._stage(pass_batch, None)
         return pass_batch, staged
 
-    def _decide(self, table: Table2, staged: "_Staged"):
-        fn = self._decide_fns.get(staged.math)
-        if fn is None:
-            fn = self._decide_fns[staged.math] = make_sharded_decide(
-                self.mesh, math=staged.math
-            )
+    def _decide(self, table: Table2, staged):
+        if isinstance(staged, _StagedA2A):
+            from gubernator_tpu.parallel.a2a import make_a2a_decide
+
+            key = ("a2a", staged.c, staged.math)
+            fn = self._decide_fns.get(key)
+            if fn is None:
+                fn = self._decide_fns[key] = make_a2a_decide(
+                    self.mesh, staged.c, math=staged.math
+                )
+        else:
+            key = ("host", staged.math)
+            fn = self._decide_fns.get(key)
+            if fn is None:
+                fn = self._decide_fns[key] = make_sharded_decide(
+                    self.mesh, math=staged.math
+                )
         return fn(table, staged.dev)
 
     def issue_staged(self, staged: "_Staged", batch_rows: int):
@@ -297,23 +324,40 @@ class ShardedEngine:
 
     def finish_staged(self, pending, n: int):
         staged, out = pending
-        s, l, r, t, dropped, hit, st = self._unroute(staged, np.asarray(out), n)
-        return (s, l, r, t, dropped, hit), st
+        s, l, r, t, dropped, hit, unproc, evicted = self._unroute(
+            staged, np.asarray(out), n
+        )
+        # per-row accounting over the rows the kernel actually processed
+        # (pass rows are all active; a2a capacity drops count at their retry)
+        counted = ~unproc
+        st = (
+            int(hit[counted].sum()),
+            int((~hit[counted]).sum()),
+            int((s[counted] == 1).sum()),
+            evicted,
+        )
+        return (s, l, r, t, dropped, hit), st, unproc
 
-    def _redispatch_rows(self, batch: HostBatch, n: int):
+    def _redispatch_rows(self, batch: HostBatch, n: int, uncounted=None):
         """Pipelined-retry hook (engine thread): depth=1 counts evictions and
-        dispatches only — hits/misses/over were counted by the dropped
-        phase-1 pass (cf. LocalEngine._redispatch_rows)."""
-        _, (s, l, r, t, d, h) = self._dispatch(batch, depth=1)
+        dispatches, plus the hit/miss/over outcome of `uncounted` rows —
+        those the phase-1 pass never processed (a2a capacity drops); rows
+        the phase-1 kernel DID probe were already counted there (cf.
+        LocalEngine._redispatch_rows)."""
+        _, (s, l, r, t, d, h) = self._dispatch(batch, depth=1, count=uncounted)
         return s[:n], l[:n], r[:n], t[:n], d[:n], h[:n]
 
     # ------------------------------------------------------- dispatch core
 
-    def _stage(self, batch: HostBatch, shard: Optional[np.ndarray]) -> "_Staged":
-        """Host half of one mesh dispatch: route rows to shards, scatter the
-        packed (12, n) ingress columns into ONE (D, 12, b_local) grid, and
-        stage it shard-per-device. One device_put total (the per-column
-        layout cost 12)."""
+    def _stage(self, batch: HostBatch, shard: Optional[np.ndarray]):
+        """Host half of one mesh dispatch. route="host": sort rows by owning
+        shard and scatter the packed (12, n) columns into ONE (D, 12,
+        b_local) ownership grid. route="device": NO routing work — rows ship
+        in arrival order and the mesh exchanges them over ICI
+        (parallel/a2a.py). Explicit `shard` pins (the GLOBAL replica path)
+        always take the host grid: a2a routes by ownership hash only."""
+        if self.route == "device" and shard is None:
+            return self._stage_a2a(batch)
         D = self.n_shards
         routed = shard if shard is not None else shard_of(batch.fp, D)
         order, rs, offset, b_local = _route_plan(routed, D)
@@ -326,21 +370,49 @@ class ShardedEngine:
             math=_math_mode(batch),
         )
 
-    def _unroute(self, staged: "_Staged", outh: np.ndarray, n: int):
-        """Decode the fetched (D, b_local+2, 4) packed output grid back to
-        pass-row order + summed per-device stats (flag bits shared with the
-        single-device decoder, kernel2.FLAG_*/unpack_outputs)."""
-        from gubernator_tpu.ops.kernel2 import FLAG_DROPPED, FLAG_HIT, FLAG_STATUS
+    def _stage_a2a(self, batch: HostBatch) -> "_StagedA2A":
+        """Arrival-order staging: reshape the packed columns into (D, 12, c)
+        — row i lands on device i // c. O(1) routing work on the host."""
+        D = self.n_shards
+        n = batch.fp.shape[0]
+        c = _pad_size(max(1, -(-n // D)), floor=8)
+        packed = pack_host_batch(batch)  # (12, n)
+        padded = np.zeros((12, D * c), dtype=np.int64)
+        padded[:, :n] = packed
+        grid = np.ascontiguousarray(
+            padded.reshape(12, D, c).transpose(1, 0, 2)
+        )
+        dev = jax.device_put(grid, self._batch_sharding)
+        return _StagedA2A(c=c, dev=dev, math=_math_mode(batch))
 
-        st = outh[:, staged.b_local, :].sum(axis=0)  # hits/misses/over/evicted
-        per = np.empty((n, 4), dtype=np.int64)
-        per[staged.order] = outh[staged.rs, staged.offset]
+    def _unroute(self, staged, outh: np.ndarray, n: int):
+        """Decode the fetched (D, rows+2, 4) packed output grid back to
+        pass-row order: per-row responses, the `unprocessed` mask (rows the
+        a2a exchange capacity-dropped before they reached the kernel), and
+        the summed per-device evicted_unexpired (the only stat that cannot
+        be derived per row). Flag bits shared with the single-device decoder
+        (kernel2.FLAG_*/unpack_outputs)."""
+        from gubernator_tpu.ops.kernel2 import (
+            FLAG_DROPPED,
+            FLAG_HIT,
+            FLAG_STATUS,
+            FLAG_UNPROCESSED,
+        )
+
+        if isinstance(staged, _StagedA2A):
+            st = outh[:, staged.c, :].sum(axis=0)
+            per = outh[:, : staged.c, :].reshape(-1, 4)[:n].copy()
+        else:
+            st = outh[:, staged.b_local, :].sum(axis=0)  # hits/misses/over/…
+            per = np.empty((n, 4), dtype=np.int64)
+            per[staged.order] = outh[staged.rs, staged.offset]
         status = (per[:, 3] & FLAG_STATUS).astype(np.int32)
         hit = (per[:, 3] & FLAG_HIT) != 0
         dropped = (per[:, 3] & FLAG_DROPPED) != 0
+        unproc = (per[:, 3] & FLAG_UNPROCESSED) != 0
         return (
-            status, per[:, 0], per[:, 1], per[:, 2], dropped, hit,
-            (int(st[0]), int(st[1]), int(st[2]), int(st[3])),
+            status, per[:, 0], per[:, 1], per[:, 2], dropped, hit, unproc,
+            int(st[3]),
         )
 
     def _dispatch(
@@ -349,6 +421,7 @@ class ShardedEngine:
         depth: int = 0,
         shard: Optional[np.ndarray] = None,
         table_attr: str = "table",
+        count: Optional[np.ndarray] = None,
     ):
         """Route one unique-fp pass across shards, run, and un-route responses
         back to pass-row order. Rows dropped by the claim auction are
@@ -357,33 +430,37 @@ class ShardedEngine:
         `shard` overrides ownership routing (used by the GLOBAL path to pin
         requests to their home device's replica table); `table_attr` picks the
         state table ("table" = authoritative shards, "replica" = GLOBAL
-        read-replicas)."""
+        read-replicas). `count` masks the rows whose hit/miss/over outcome
+        this call should account (None = all active at depth 0, none at
+        retry depths): each row is counted exactly once, at the dispatch
+        that first PROCESSES it — claim-dropped rows were probed and count
+        immediately; a2a capacity-dropped rows (never probed, FLAG_UNPROCESSED)
+        count at the retry that finally reaches the kernel. Rows that
+        exhaust retries without ever being probed are not counted, matching
+        the host path where such rows cannot exist."""
         n = batch.fp.shape[0]
-        routed = shard if shard is not None else shard_of(batch.fp, self.n_shards)
-        staged = self._stage(batch, routed)
+        staged = self._stage(batch, shard)
         table, out = self._decide(getattr(self, table_attr), staged)
         setattr(self, table_attr, table)
         self.stats.dispatches += 1
-        status, limit, remaining, reset, dropped, hit, st = self._unroute(
-            staged, np.asarray(out), n
+        status, limit, remaining, reset, dropped, hit, unproc, evicted = (
+            self._unroute(staged, np.asarray(out), n)
         )
-        if depth == 0:
-            # retries re-run rows the claim auction dropped; accumulating their
-            # hit/miss/over_limit again would double-count (cf. LocalEngine
-            # _dispatch_with_retry's retry accounting)
-            self.stats.cache_hits += st[0]
-            self.stats.cache_misses += st[1]
-            self.stats.over_limit += st[2]
-            self.stats.evicted_unexpired += st[3]
-        else:
-            self.stats.evicted_unexpired += st[3]
+        if count is None:
+            count = np.asarray(batch.active) if depth == 0 else np.zeros(n, bool)
+        counted = count & ~unproc
+        self.stats.cache_hits += int(hit[counted].sum())
+        self.stats.cache_misses += int((~hit[counted]).sum())
+        self.stats.over_limit += int((status[counted] == 1).sum())
+        self.stats.evicted_unexpired += evicted
         if dropped.any() and depth < 3:
             rows = np.nonzero(dropped)[0]
             _, (s2, l2, r2, t2, d2, h2) = self._dispatch(
                 _subset(batch, rows),
                 depth=depth + 1,
-                shard=routed[rows] if shard is not None else None,
+                shard=shard[rows] if shard is not None else None,
                 table_attr=table_attr,
+                count=(count & unproc)[rows],
             )
             status = status.copy(); limit = limit.copy()
             remaining = remaining.copy(); reset = reset.copy()
@@ -408,6 +485,16 @@ class _Staged(NamedTuple):
     offset: np.ndarray  # (n,) position within the shard's grid row
     b_local: int  # padded per-shard width
     dev: object  # (D, 12, b_local) i64 device grid, shard-per-device
+    math: str  # static decision-graph mode ("token" | "mixed")
+
+
+class _StagedA2A(NamedTuple):
+    """One staged device-routed dispatch (parallel/a2a.py): arrival-order
+    grid; the mesh does the ownership exchange (capacity derives from c and
+    the mesh size inside make_a2a_decide)."""
+
+    c: int  # rows per device (pow2)
+    dev: object  # (D, 12, c) i64 device grid, arrival order
     math: str  # static decision-graph mode ("token" | "mixed")
 
 

@@ -112,6 +112,7 @@ class Daemon:
                 capacity_per_shard=max(1, conf.cache_size // n_dev),
                 created_at_tolerance_ms=int(conf.created_at_tolerance_ms),
                 store=store,
+                route=conf.shard_route,
             )
         else:
             self.engine = LocalEngine(

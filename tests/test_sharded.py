@@ -115,6 +115,78 @@ def test_zipf_skew_routes_balanced(mesh, frozen_now):
     assert b_local <= 2 * ideal, (b_local, ideal)
 
 
+def test_device_route_matches_host_route(mesh, frozen_now):
+    """route="device" (arrival-order rows, on-mesh all_to_all exchange —
+    parallel/a2a.py) must serve byte-identical responses and stats to the
+    host-routed ownership grid."""
+    t = frozen_now
+    host_eng = ShardedEngine(mesh, capacity_per_shard=2048, route="host")
+    dev_eng = ShardedEngine(mesh, capacity_per_shard=2048, route="device")
+    rng = np.random.default_rng(3)
+    for step in range(3):
+        ks = rng.integers(0, 500, size=200)
+        reqs = [
+            req(
+                f"a{k}",
+                hits=1 + int(k) % 3,
+                limit=1000,
+                algorithm=(
+                    Algorithm.TOKEN_BUCKET if k % 3 else Algorithm.LEAKY_BUCKET
+                ),
+                created_at=t + step,
+            )
+            for k in ks
+        ]
+        want = host_eng.check(reqs, now_ms=t + step)
+        got = dev_eng.check(reqs, now_ms=t + step)
+        for i, (a, b) in enumerate(zip(want, got)):
+            assert (a.status, a.remaining, a.reset_time, a.error) == (
+                b.status, b.remaining, b.reset_time, b.error,
+            ), f"row {i} step {step}"
+    assert dev_eng.stats.cache_hits == host_eng.stats.cache_hits
+    assert dev_eng.stats.cache_misses == host_eng.stats.cache_misses
+    # authoritative state converged identically on every shard
+    np.testing.assert_array_equal(host_eng.snapshot(), dev_eng.snapshot())
+
+
+def test_device_route_capacity_overflow_retries(mesh, frozen_now):
+    """A same-owner flood exceeds the per-(src,dst) exchange capacity; the
+    dropped rows must re-dispatch (claim-retry path) and hit conservation
+    must hold: the bucket's consumed count equals the hits of rows that
+    reported success."""
+    t = frozen_now
+    eng = ShardedEngine(mesh, capacity_per_shard=4096, route="device")
+    # craft keys all owned by one shard: shard_of uses fp's high bits
+    from gubernator_tpu.ops.batch import fingerprint_columns
+
+    N = 6000
+    names = np.array(["sh"] * N, dtype=object)  # req() uses name="sh"
+    keys = np.array([f"k{i}" for i in range(N)], dtype=object)
+    fps, _ = fingerprint_columns(names, keys)
+    shards = shard_of(fps, 8)
+    target = int(shards[0])
+    picked = [f"k{i}" for i in range(N) if int(shards[i]) == target][:512]
+    assert len(picked) == 512
+    reqs = [req(k, hits=1, limit=10, created_at=t) for k in picked]
+    out = eng.check(reqs, now_ms=t)
+    ok = [r for r in out if r.error == ""]
+    failed = [r for r in out if r.error != ""]
+    # the flood routes through retries; every row must resolve one way
+    assert len(ok) + len(failed) == 512
+    assert len(ok) > 0
+    for r in ok:
+        assert r.remaining == 9  # distinct keys: each consumed exactly once
+    # failed rows (if any) must carry the not-persisted error, nothing else
+    from gubernator_tpu.ops.engine import ERR_NOT_PERSISTED
+
+    assert all(r.error == ERR_NOT_PERSISTED for r in failed)
+    # stat conservation: every key is fresh and distinct, so each row the
+    # kernel actually probed is exactly one miss — capacity-dropped rows
+    # count at the retry that processes them, never twice, never as hits
+    assert eng.stats.cache_hits == 0
+    assert len(ok) <= eng.stats.cache_misses <= 512
+
+
 def test_sharded_pipeline_matches_serial(mesh, frozen_now):
     """The prepare/issue/finish split (served by the pipelined front door)
     must produce byte-identical responses to the serial sharded path."""

@@ -55,6 +55,30 @@ async def test_sharded_daemon_serves_over_grpc():
 
 
 @async_test
+async def test_sharded_daemon_device_route_serves_over_grpc():
+    """GUBER_SHARD_ROUTE=device: requests ship in arrival order and the mesh
+    routes them with an all_to_all exchange (parallel/a2a.py) — served
+    through the same pipelined gRPC front door."""
+    from gubernator_tpu.service.daemon import Daemon
+
+    d = await Daemon.spawn(
+        daemon_config(engine="sharded", cache_size=8192, shard_route="device")
+    )
+    assert d.engine.route == "device"
+    client = V1Client(d.conf.grpc_address)
+    try:
+        keys = [f"dr{i}" for i in range(96)]
+        r1 = await client.get_rate_limits([req(k, hits=2) for k in keys])
+        assert all(x.error == "" and x.remaining == 98 for x in r1.responses)
+        r2 = await client.get_rate_limits([req(k, hits=1) for k in keys])
+        assert all(x.remaining == 97 for x in r2.responses)
+        assert d.engine.live_count() >= 96
+    finally:
+        await client.close()
+        await d.close()
+
+
+@async_test
 async def test_sharded_daemons_global_converges():
     """Two sharded daemons: GLOBAL hits at the non-owner reach the owner and
     the authoritative status installs into the non-owner's mesh (the
