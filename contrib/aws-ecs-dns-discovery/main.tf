@@ -1,0 +1,208 @@
+# gubernator-tpu on AWS ECS Fargate with Cloud Map DNS peer discovery.
+#
+# Peers find each other the same way the k8s/compose deployments do: AWS
+# Cloud Map registers every task's IP under one service name, and each
+# daemon polls that FQDN with GUBER_PEER_DISCOVERY_TYPE=dns (multi-A
+# records -> full peer list; the pool keeps the last non-empty answer on
+# transient DNS failures). Mirrors the reference's ECS service-discovery
+# example (contrib/aws-ecs-service-discovery-deployment) with this
+# framework's env surface.
+
+data "aws_region" "current" {}
+
+# ------------------------------------------------------------------ network
+resource "aws_vpc" "this" {
+  cidr_block           = var.vpc_cidr
+  enable_dns_support   = true
+  enable_dns_hostnames = true
+  tags                 = { Name = "${var.prefix}-vpc" }
+}
+
+# Public subnets + IGW so Fargate can pull the image from ECR and ship logs
+# (the reference example does the same; for a fully private deployment swap
+# in ECR/S3/logs VPC endpoints and drop assign_public_ip)
+resource "aws_subnet" "public" {
+  count                   = length(var.subnet_cidrs)
+  vpc_id                  = aws_vpc.this.id
+  cidr_block              = var.subnet_cidrs[count.index]
+  availability_zone       = var.availability_zones[count.index]
+  map_public_ip_on_launch = true
+  tags                    = { Name = "${var.prefix}-public-${count.index}" }
+}
+
+resource "aws_internet_gateway" "this" {
+  vpc_id = aws_vpc.this.id
+}
+
+resource "aws_route_table" "public" {
+  vpc_id = aws_vpc.this.id
+  route {
+    cidr_block = "0.0.0.0/0"
+    gateway_id = aws_internet_gateway.this.id
+  }
+}
+
+resource "aws_route_table_association" "public" {
+  count          = length(var.subnet_cidrs)
+  subnet_id      = aws_subnet.public[count.index].id
+  route_table_id = aws_route_table.public.id
+}
+
+resource "aws_security_group" "peers" {
+  name   = "${var.prefix}-peers"
+  vpc_id = aws_vpc.this.id
+
+  # peer gRPC + HTTP/metrics, cluster-internal only
+  ingress {
+    from_port   = 1050
+    to_port     = 1051
+    protocol    = "tcp"
+    cidr_blocks = [var.vpc_cidr]
+  }
+  egress {
+    from_port   = 0
+    to_port     = 0
+    protocol    = "-1"
+    cidr_blocks = ["0.0.0.0/0"]
+  }
+}
+
+# ------------------------------------------------- Cloud Map DNS namespace
+resource "aws_service_discovery_private_dns_namespace" "this" {
+  name = var.dns_namespace
+  vpc  = aws_vpc.this.id
+}
+
+resource "aws_service_discovery_service" "peers" {
+  name = var.service_name
+  dns_config {
+    namespace_id = aws_service_discovery_private_dns_namespace.this.id
+    dns_records {
+      ttl  = 10 # short TTL: the DNS pool re-polls at min-TTL cadence
+      type = "A"
+    }
+    routing_policy = "MULTIVALUE"
+  }
+  health_check_custom_config {
+    failure_threshold = 1
+  }
+}
+
+# ---------------------------------------------------------------- ECS bits
+resource "aws_ecs_cluster" "this" {
+  name = "${var.prefix}-cluster"
+}
+
+resource "aws_cloudwatch_log_group" "this" {
+  name              = "/ecs/${var.prefix}"
+  retention_in_days = 7
+}
+
+resource "aws_iam_role" "execution" {
+  name               = "${var.prefix}-execution"
+  assume_role_policy = data.aws_iam_policy_document.ecs_assume.json
+}
+
+data "aws_iam_policy_document" "ecs_assume" {
+  statement {
+    actions = ["sts:AssumeRole"]
+    principals {
+      type        = "Service"
+      identifiers = ["ecs-tasks.amazonaws.com"]
+    }
+  }
+}
+
+resource "aws_iam_role_policy_attachment" "execution" {
+  role       = aws_iam_role.execution.name
+  policy_arn = "arn:aws:iam::aws:policy/service-role/AmazonECSTaskExecutionRolePolicy"
+}
+
+locals {
+  peers_fqdn = "${var.service_name}.${var.dns_namespace}"
+  guber_env = merge({
+    # listeners bind all interfaces; peers dial the task IP that Cloud Map
+    # publishes (ECS injects it as the task's private address)
+    GUBER_GRPC_ADDRESS        = "0.0.0.0:1051"
+    GUBER_HTTP_ADDRESS        = "0.0.0.0:1050"
+    GUBER_PEER_DISCOVERY_TYPE = "dns"
+    GUBER_DNS_FQDN            = local.peers_fqdn
+    GUBER_DNS_POLL            = "5s"
+    GUBER_CACHE_SIZE          = tostring(var.cache_size)
+  }, var.extra_env)
+}
+
+resource "aws_ecs_task_definition" "this" {
+  family                   = "${var.prefix}-task"
+  requires_compatibilities = ["FARGATE"]
+  network_mode             = "awsvpc"
+  cpu                      = var.task_cpu
+  memory                   = var.task_memory
+  execution_role_arn       = aws_iam_role.execution.arn
+
+  container_definitions = jsonencode([
+    {
+      name      = "gubernator-tpu"
+      image     = var.image
+      essential = true
+      # awsvpc mode: the container's interface IP IS the task IP that Cloud
+      # Map publishes — resolve it at startup and advertise it, or no
+      # daemon ever matches itself in the peer list and every health check
+      # reports "this instance is not in the peer list"
+      entryPoint = ["/bin/sh", "-c"]
+      command = [
+        "export GUBER_ADVERTISE_ADDRESS=$(hostname -i | cut -d' ' -f1):1051 && exec python -m gubernator_tpu"
+      ]
+      portMappings = [
+        { containerPort = 1050, protocol = "tcp" },
+        { containerPort = 1051, protocol = "tcp" },
+      ]
+      environment = [
+        for k, v in local.guber_env : { name = k, value = v }
+      ]
+      healthCheck = {
+        # the k8s probe binary doubles as the ECS health check
+        command  = ["CMD-SHELL", "python -m gubernator_tpu.cmd.healthcheck || exit 1"]
+        interval = 15
+        timeout  = 5
+        retries  = 3
+      }
+      logConfiguration = {
+        logDriver = "awslogs"
+        options = {
+          awslogs-group         = aws_cloudwatch_log_group.this.name
+          awslogs-region        = data.aws_region.current.name
+          awslogs-stream-prefix = "gubernator-tpu"
+        }
+      }
+    }
+  ])
+}
+
+resource "aws_ecs_service" "this" {
+  name            = "${var.prefix}-service"
+  cluster         = aws_ecs_cluster.this.id
+  task_definition = aws_ecs_task_definition.this.arn
+  desired_count   = var.desired_count
+  launch_type     = "FARGATE"
+
+  network_configuration {
+    subnets          = aws_subnet.public[*].id
+    security_groups  = [aws_security_group.peers.id]
+    assign_public_ip = true # required for ECR pull/log delivery without NAT
+  }
+
+  service_registries {
+    registry_arn = aws_service_discovery_service.peers.arn
+  }
+
+  deployment_circuit_breaker {
+    enable   = true
+    rollback = true
+  }
+}
+
+output "peers_fqdn" {
+  description = "FQDN every daemon polls for the peer list (GUBER_DNS_FQDN)"
+  value       = local.peers_fqdn
+}

@@ -1,0 +1,66 @@
+variable "prefix" {
+  description = "Name prefix for every resource"
+  type        = string
+  default     = "guber-tpu"
+}
+
+variable "image" {
+  description = "gubernator-tpu container image (build from the repo Dockerfile)"
+  type        = string
+}
+
+variable "desired_count" {
+  description = "Number of peer tasks"
+  type        = number
+  default     = 3
+}
+
+variable "task_cpu" {
+  type    = number
+  default = 1024
+}
+
+variable "task_memory" {
+  type    = number
+  default = 2048
+}
+
+variable "cache_size" {
+  description = "GUBER_CACHE_SIZE per daemon"
+  type        = number
+  default     = 1048576
+}
+
+variable "extra_env" {
+  description = "Additional GUBER_* env vars merged into the container"
+  type        = map(string)
+  default     = {}
+}
+
+variable "dns_namespace" {
+  description = "Private Cloud Map namespace (VPC-internal DNS zone)"
+  type        = string
+  default     = "guber.internal"
+}
+
+variable "service_name" {
+  description = "Discovery service name; peers poll <service>.<namespace>"
+  type        = string
+  default     = "peers"
+}
+
+variable "vpc_cidr" {
+  type    = string
+  default = "10.40.0.0/16"
+}
+
+variable "subnet_cidrs" {
+  type    = list(string)
+  default = ["10.40.1.0/24", "10.40.2.0/24"]
+}
+
+variable "availability_zones" {
+  description = "AZs for the subnets (match your region)"
+  type        = list(string)
+  default     = ["us-east-1a", "us-east-1b"]
+}

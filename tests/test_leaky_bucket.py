@@ -156,6 +156,23 @@ def test_zero_hits_probe(eng, frozen_now):
 # ----------------------------------------------- remainder precision bounds
 
 
+def test_sub_millisecond_rate_div_regression(eng, frozen_now):
+    """rate = duration/limit < 1 ms/token must not divide away the deficit
+    (reference TestLeakyBucketDivBug regression, functional_test.go:1569:
+    duration 1000 ms, limit 2000 → rate 0.5 ms/token)."""
+    t = frozen_now
+    r = eng.check(
+        [req(key="div", hits=1, limit=2000, duration=1000, created_at=t)],
+        now_ms=t,
+    )[0]
+    assert (r.status, r.remaining, r.limit) == (Status.UNDER_LIMIT, 1999, 2000)
+    r = eng.check(
+        [req(key="div", hits=100, limit=2000, duration=1000, created_at=t)],
+        now_ms=t,
+    )[0]
+    assert (r.remaining, r.limit) == (1899, 2000)
+
+
 def test_leaky_out_of_range_limit_and_burst_rejected(eng, frozen_now):
     """Limits/bursts beyond int32 are REJECTED at validation (pack_columns
     ERR_LIMIT_I32/ERR_BURST_I32) — the guard that keeps every storable leaky

@@ -73,6 +73,17 @@ async def test_sharded_daemon_device_route_serves_over_grpc():
         r2 = await client.get_rate_limits([req(k, hits=1) for k in keys])
         assert all(x.remaining == 97 for x in r2.responses)
         assert d.engine.live_count() >= 96
+        # GLOBAL rows take the replica plane (host-pinned dispatches) while
+        # everything else rides the a2a exchange — both under one engine
+        rg = await client.get_rate_limits(
+            [req("drg", hits=2, behavior=Behavior.GLOBAL)]
+        )
+        assert rg.responses[0].error == ""
+        assert rg.responses[0].remaining == 98
+        async def synced():
+            return d.engine.global_stats.sync_rounds >= 1
+
+        await wait_for(synced, timeout_s=30.0)
     finally:
         await client.close()
         await d.close()
