@@ -53,6 +53,7 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -557,8 +558,6 @@ def unpack_outputs(arr, n: int):
     ((status, limit, remaining, reset_time, dropped, hit), (cache_hits,
     cache_misses, over_limit, evicted_unexpired)). Response arrays are
     writable copies (retry fix-ups mutate them in place)."""
-    import numpy as np
-
     st = (int(arr[-2, 0]), int(arr[-2, 1]), int(arr[-2, 2]), int(arr[-2, 3]))
     limit = arr[:n, 0].copy()
     remaining = arr[:n, 1].copy()

@@ -43,7 +43,7 @@ from gubernator_tpu.ops.kernel2 import (
 )
 from gubernator_tpu.ops.engine import default_write_mode
 from gubernator_tpu.ops.table2 import Table2
-from gubernator_tpu.parallel.mesh import SHARD_AXIS
+from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_of
 
 i32 = jnp.int32
 i64 = jnp.int64
@@ -77,8 +77,8 @@ def make_a2a_decide(mesh: Mesh, c: int, math: str = "mixed"):
         a = arr[0]  # (12, c) i64, arrival order
         fp = a[0]
         active = a[11] != 0
-        # same ownership hash as mesh.shard_of (high bits; slot uses low)
-        owner = jnp.where(active, ((fp >> 32) % D), D).astype(i32)
+        # mesh.shard_of traces fine on jnp values — one ownership hash
+        owner = jnp.where(active, shard_of(fp, D), D).astype(i32)
         idx = jnp.arange(c, dtype=i32)
         o_s, idx_s = jax.lax.sort((owner, idx), num_keys=1)
         gstart = jnp.searchsorted(o_s, o_s).astype(i32)
@@ -87,8 +87,8 @@ def make_a2a_decide(mesh: Mesh, c: int, math: str = "mixed"):
 
         # send buffer by GATHER (scatters are slow on TPU): slot (d, j) takes
         # sorted row searchsorted(o_s, d) + j when j < count(d)
-        d_iota = (jnp.arange(D * C, dtype=i32) // C).astype(i32)
-        j_iota = (jnp.arange(D * C, dtype=i32) % C).astype(i32)
+        d_iota = jnp.arange(D * C, dtype=i32) // C
+        j_iota = jnp.arange(D * C, dtype=i32) % C
         g0 = jnp.searchsorted(o_s, d_iota).astype(i32)
         g1 = jnp.searchsorted(o_s, d_iota, side="right").astype(i32)
         src = g0 + j_iota

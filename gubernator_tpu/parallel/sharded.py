@@ -46,7 +46,14 @@ from gubernator_tpu.ops.batch import (
     ResponseColumns,
     pack_host_batch,
 )
-from gubernator_tpu.ops.kernel2 import decide2_packed_cols_impl, install2_impl
+from gubernator_tpu.ops.kernel2 import (
+    FLAG_DROPPED,
+    FLAG_HIT,
+    FLAG_STATUS,
+    FLAG_UNPROCESSED,
+    decide2_packed_cols_impl,
+    install2_impl,
+)
 from gubernator_tpu.ops.engine import (
     EngineStats,
     _math_mode,
@@ -58,10 +65,6 @@ from gubernator_tpu.ops.plan import _subset
 from gubernator_tpu.ops.table2 import Table2, new_table2
 from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_of
 from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
-
-
-def _stack_tree(trees):
-    return jax.tree.map(lambda *xs: np.stack(xs), *trees)
 
 
 def make_sharded_decide(mesh: Mesh, math: str = "mixed"):
@@ -392,13 +395,6 @@ class ShardedEngine:
         the summed per-device evicted_unexpired (the only stat that cannot
         be derived per row). Flag bits shared with the single-device decoder
         (kernel2.FLAG_*/unpack_outputs)."""
-        from gubernator_tpu.ops.kernel2 import (
-            FLAG_DROPPED,
-            FLAG_HIT,
-            FLAG_STATUS,
-            FLAG_UNPROCESSED,
-        )
-
         if isinstance(staged, _StagedA2A):
             st = outh[:, staged.c, :].sum(axis=0)
             per = outh[:, : staged.c, :].reshape(-1, 4)[:n].copy()
