@@ -13,7 +13,7 @@ import asyncio
 import functools
 
 from gubernator_tpu.client import V1Client
-from gubernator_tpu.types import Behavior, RateLimitRequest
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest
 
 from tests.cluster import Cluster, metric_value, scrape, wait_for
 
@@ -117,6 +117,124 @@ async def test_global_owner_hit_broadcasts():
             assert r.remaining == 97
     finally:
         await client.close()
+        await c.stop()
+
+
+@async_test
+async def test_global_negative_hits_propagate():
+    """Negative GLOBAL hits RAISE remaining beyond the limit and propagate
+    through owner broadcasts so later peers see the credit
+    (TestGlobalNegativeHits, functional_test.go)."""
+    c = await Cluster.start(4)
+    clients = [V1Client(d.conf.grpc_address) for d in c.daemons]
+    try:
+        peers = c.non_owning_daemons("glob", "neg")
+        pc = [clients[c.daemons.index(d)] for d in peers]
+
+        async def send(cl, hits, want_remaining):
+            r = (
+                await cl.get_rate_limits(
+                    [greq("neg", hits=hits, limit=2)]
+                )
+            ).responses[0]
+            assert r.error == ""
+            assert r.remaining == want_remaining, (hits, r.remaining)
+
+        async def installed_at_least(d, k):
+            return (await updates_installed(d)) >= k
+
+        # fresh bucket at peer0's replica: limit 2 minus (-1) = 3
+        await send(pc[0], -1, 3)
+        await wait_for(lambda: installed_at_least(peers[1], 1), timeout_s=20)
+        # peer1's replica saw the broadcast (remaining 3); another credit → 4
+        await send(pc[1], -1, 4)
+        await wait_for(lambda: installed_at_least(peers[2], 2), timeout_s=20)
+        # peer2 consumes all 4 banked tokens in one request
+        await send(pc[2], 4, 0)
+        await wait_for(lambda: installed_at_least(peers[0], 3), timeout_s=20)
+        await send(pc[0], 0, 0)
+    finally:
+        for cl in clients:
+            await cl.close()
+        await c.stop()
+
+
+@async_test
+async def test_global_request_more_than_available():
+    """Peers spread GLOBAL hits that together exceed the limit: each answers
+    UNDER from its replica (the documented over-consumption window), and
+    after the owner aggregates + broadcasts, further hits are OVER
+    (TestGlobalRequestMoreThanAvailable, functional_test.go)."""
+    c = await Cluster.start(3)
+    clients = [V1Client(d.conf.grpc_address) for d in c.daemons]
+    try:
+        peers = c.non_owning_daemons("glob", "over")
+        pc = [clients[c.daemons.index(d)] for d in peers]
+
+        def lreq(hits):
+            return RateLimitRequest(
+                name="glob", unique_key="over", hits=hits, limit=100,
+                duration=600_000, behavior=Behavior.GLOBAL,
+                algorithm=Algorithm.LEAKY_BUCKET,
+            )
+
+        # 50 hits at each non-owner: both UNDER locally (replicas are
+        # independent until the sync round lands)
+        for cl in pc:
+            r = (await cl.get_rate_limits([lreq(50)])).responses[0]
+            assert r.error == ""
+            assert r.status == 0
+
+        # the owner must aggregate BOTH peers' 50s and broadcast remaining 0
+        # — probe with ZERO hits so the wait cannot satisfy itself by
+        # consuming the local replica (each replica alone still holds 50)
+        async def depleted():
+            r = (await pc[0].get_rate_limits([lreq(0)])).responses[0]
+            return r.remaining == 0
+
+        await wait_for(depleted, timeout_s=20)
+        r = (await pc[0].get_rate_limits([lreq(1)])).responses[0]
+        assert r.status == 1
+    finally:
+        for cl in clients:
+            await cl.close()
+        await c.stop()
+
+
+@async_test
+async def test_global_load_balanced_owner_and_non_owner():
+    """Alternating GLOBAL hits between the owner and a non-owner (the
+    round-robin-LB client pattern) deplete one shared limit and then both
+    report OVER (TestGlobalRateLimitsWithLoadBalancing, functional_test.go)."""
+    c = await Cluster.start(3)
+    clients = [V1Client(d.conf.grpc_address) for d in c.daemons]
+    try:
+        owner = c.find_owning_daemon("glob", "lb")
+        non_owner = c.non_owning_daemons("glob", "lb")[0]
+        oc = clients[c.daemons.index(owner)]
+        nc = clients[c.daemons.index(non_owner)]
+
+        r = (await oc.get_rate_limits([greq("lb", hits=1, limit=2)])).responses[0]
+        assert (r.error, r.status) == ("", 0)
+        r = (await nc.get_rate_limits([greq("lb", hits=1, limit=2)])).responses[0]
+        assert (r.error, r.status) == ("", 0)
+
+        # pin the SYNC, not local depletion: zero-hit reads at BOTH ends
+        # must converge to the aggregated remaining (0) — the non-owner's
+        # replica alone would still hold 1 if broadcasts were broken
+        async def synced_to_zero():
+            a = (await oc.get_rate_limits([greq("lb", hits=0, limit=2)])).responses[0]
+            b = (await nc.get_rate_limits([greq("lb", hits=0, limit=2)])).responses[0]
+            return a.remaining == 0 and b.remaining == 0
+
+        await wait_for(synced_to_zero, timeout_s=20)
+        # every further hit is OVER at either end, and stays OVER
+        for cl in (oc, nc, nc):
+            r = (await cl.get_rate_limits([greq("lb", hits=1, limit=2)])).responses[0]
+            assert r.status == 1
+    finally:
+        for cl in clients:
+            await cl.close()
         await c.stop()
 
 
