@@ -1,0 +1,114 @@
+"""Gregorian-calendar expiration units — every granularity at its boundary
+(reference TestGregorianExpirationMinute/Hour/Day/Month/Year/Invalid,
+config_test.go; semantics from interval.go:84-148).
+
+The module computes in the HOST's local timezone (like the reference's Go
+time package), so assertions reconstruct boundaries with datetime rather
+than hard-coding epoch values.
+"""
+
+import datetime as dt
+import os
+import time
+
+import pytest
+
+from gubernator_tpu.gregorian import (
+    GregorianError,
+    gregorian_duration,
+    gregorian_expiration,
+)
+from gubernator_tpu.types import Gregorian
+
+
+@pytest.fixture(autouse=True)
+def utc_tz():
+    """Pin the process timezone: the module computes in host-local time (like
+    the reference's Go time package), and DST transitions change month/year
+    lengths by an hour — these boundary assertions need a DST-free zone."""
+    old = os.environ.get("TZ")
+    os.environ["TZ"] = "UTC"
+    time.tzset()
+    yield
+    if old is None:
+        os.environ.pop("TZ", None)
+    else:
+        os.environ["TZ"] = old
+    time.tzset()
+
+# fixed instant: 2023-11-14 ~22:13:20.987 UTC, mid-minute/-hour/-day
+NOW = 1_700_000_000_987
+
+
+def _local(ms: int) -> dt.datetime:
+    return dt.datetime.fromtimestamp(ms / 1000.0).astimezone()
+
+
+@pytest.mark.parametrize(
+    "granularity,length_ms",
+    [
+        (Gregorian.MINUTES, 60_000),
+        (Gregorian.HOURS, 3_600_000),
+        (Gregorian.DAYS, 86_400_000),
+    ],
+)
+def test_fixed_length_intervals(granularity, length_ms):
+    assert gregorian_duration(NOW, granularity) == length_ms
+    exp = gregorian_expiration(NOW, granularity)
+    # expiry is the LAST ms inside the interval containing NOW...
+    assert NOW <= exp < NOW + length_ms
+    # ...and exp+1 is an exact interval boundary in local time
+    b = _local(exp + 1)
+    assert (b.second, b.microsecond) == (0, 0)
+    if granularity != Gregorian.MINUTES:
+        assert b.minute == 0
+    if granularity == Gregorian.DAYS:
+        assert b.hour == 0
+
+
+def test_month_interval():
+    exp = gregorian_expiration(NOW, Gregorian.MONTHS)
+    assert exp >= NOW
+    b = _local(exp + 1)
+    assert (b.day, b.hour, b.minute, b.second, b.microsecond) == (1, 0, 0, 0, 0)
+    # the duration is this month's real length (28-31 days worth of ms)
+    dur = gregorian_duration(NOW, Gregorian.MONTHS)
+    assert dur in {d * 86_400_000 for d in (28, 29, 30, 31)}
+    # expiry sits exactly at month-begin + month-length - 1
+    n = _local(NOW)
+    begin = n.replace(day=1, hour=0, minute=0, second=0, microsecond=0)
+    assert exp == int(begin.timestamp() * 1000) + dur - 1
+
+
+def test_year_interval():
+    exp = gregorian_expiration(NOW, Gregorian.YEARS)
+    b = _local(exp + 1)
+    assert (b.month, b.day, b.hour, b.minute) == (1, 1, 0, 0)
+    dur = gregorian_duration(NOW, Gregorian.YEARS)
+    assert dur in {365 * 86_400_000, 366 * 86_400_000}
+
+
+def test_leap_year_february():
+    # 2024-02-10 12:00:00 UTC — February of a leap year is 29 days
+    feb_2024 = int(dt.datetime(2024, 2, 10, 12, 0, 0).timestamp() * 1000)
+    assert gregorian_duration(feb_2024, Gregorian.MONTHS) == 29 * 86_400_000
+    assert gregorian_duration(feb_2024, Gregorian.YEARS) == 366 * 86_400_000
+
+
+def test_december_rolls_into_next_year():
+    dec = int(dt.datetime(2023, 12, 31, 23, 59, 59).timestamp() * 1000)
+    exp = gregorian_expiration(dec, Gregorian.MONTHS)
+    b = _local(exp + 1)
+    assert (b.year, b.month, b.day) == (2024, 1, 1)
+
+
+def test_weeks_and_invalid_rejected():
+    # reference interval.go:88-89 rejects weeks; anything else is invalid
+    with pytest.raises(GregorianError):
+        gregorian_duration(NOW, Gregorian.WEEKS)
+    with pytest.raises(GregorianError):
+        gregorian_expiration(NOW, Gregorian.WEEKS)
+    with pytest.raises(GregorianError):
+        gregorian_duration(NOW, 999)
+    with pytest.raises(GregorianError):
+        gregorian_expiration(NOW, 999)
