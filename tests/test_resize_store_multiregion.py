@@ -153,6 +153,42 @@ def test_store_rehydrates_state_lost_to_restart(frozen_now):
     assert store.hydrated == 1
 
 
+def test_store_rehydrates_on_device_routed_mesh(frozen_now):
+    """Store write-through + miss-rehydrate on a ShardedEngine with
+    route="device": the check dispatch rides the a2a exchange while the
+    rehydrate install takes the host-pinned path — both under one engine
+    (regression guard for the route split)."""
+    import jax
+
+    from gubernator_tpu.parallel import ShardedEngine, make_mesh
+    from gubernator_tpu.store import DictStore
+
+    assert len(jax.devices()) == 8
+    mesh = make_mesh(8)
+    store = DictStore()
+    eng = ShardedEngine(mesh, capacity_per_shard=256, store=store,
+                        route="device")
+    keys = [f"sr{i}" for i in range(24)]
+    eng.check(
+        [RateLimitRequest(name="t", unique_key=k, hits=4, limit=10,
+                          duration=MINUTE) for k in keys],
+        now_ms=frozen_now,
+    )
+    assert len(store.rows) == 24
+    # restart: fresh sharded table, same store
+    eng2 = ShardedEngine(mesh, capacity_per_shard=256, store=store,
+                         route="device")
+    out = eng2.check(
+        [RateLimitRequest(name="t", unique_key=k, hits=1, limit=10,
+                          duration=MINUTE) for k in keys],
+        now_ms=frozen_now + 1_000,
+    )
+    for r in out:
+        assert r.error == ""
+        assert r.remaining == 5  # hydrated 6 remaining, minus this hit
+    assert store.hydrated == 24
+
+
 def test_store_rehydrate_preserves_custom_leaky_burst(frozen_now):
     """The ChangeSet carries the real burst: rehydrating a custom-burst leaky
     bucket must NOT trip the burst-changed upgrade path (math.py burst
