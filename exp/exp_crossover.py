@@ -1,0 +1,91 @@
+"""Calibrate engine._write_mode_for: sweep (cost ∝ table) vs XLA scatter
+(cost ∝ batch) at serving-size batches on the headline 1 GiB table."""
+
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+
+import gubernator_tpu  # noqa: F401
+import jax
+
+from gubernator_tpu.ops import kernel2 as k2
+from gubernator_tpu.ops.batch import ReqBatch
+from gubernator_tpu.ops.table2 import new_table2
+import jax.numpy as jnp
+
+
+def log(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def mk(fps, now):
+    b = fps.shape[0]
+    z = jnp.zeros(b, dtype=jnp.int64)
+    return ReqBatch(
+        fp=jnp.asarray(fps), algo=jnp.zeros(b, dtype=jnp.int32),
+        behavior=jnp.zeros(b, dtype=jnp.int32), hits=jnp.ones(b, dtype=jnp.int64),
+        limit=jnp.full(b, 1 << 20, dtype=jnp.int64), burst=z,
+        duration=jnp.full(b, 3_600_000, dtype=jnp.int64),
+        created_at=jnp.full(b, now, dtype=jnp.int64),
+        expire_new=jnp.full(b, now + 3_600_000, dtype=jnp.int64),
+        greg_interval=z, duration_eff=jnp.full(b, 3_600_000, dtype=jnp.int64),
+        active=jnp.ones(b, dtype=bool),
+    )
+
+
+def slope(fn, n_long=48):
+    fn()
+
+    def run(k):
+        t0 = time.perf_counter()
+        s = None
+        for _ in range(k):
+            s = fn()
+        _ = int(s)
+        return time.perf_counter() - t0
+
+    run(2)
+    t_s = min(run(2) for _ in range(3))
+    t_l = min(run(2 + n_long) for _ in range(3))
+    return (t_l - t_s) / n_long
+
+
+def main():
+    rng = np.random.default_rng(5)
+    now = 1_700_000_000_000
+    CAP = 1 << 24  # 1 GiB table, NB=2M rows
+    LIVE = 2_000_000
+    keyspace = rng.integers(1, (1 << 63) - 1, size=LIVE, dtype=np.int64)
+    state = {}
+    for write in ("sweep", "xla"):
+        table = new_table2(CAP)
+        for i in range(0, LIVE, 1 << 17):
+            table, _, s = k2.decide2(table, mk(keyspace[i : i + (1 << 17)], now),
+                                     write="sweep")
+        _ = int(s.cache_hits)
+        state[write] = table
+    for B in (2048, 4096, 8192, 16384):
+        batches = []
+        for _ in range(4):
+            draw = np.unique(keyspace[rng.integers(0, LIVE, size=2 * B)])
+            assert draw.shape[0] >= B
+            batches.append(jax.device_put(mk(rng.permutation(draw)[:B], now)))
+        for write in ("sweep", "xla"):
+            tb = {"t": state[write], "i": 0}
+
+            def fn():
+                b = batches[tb["i"] % 4]
+                tb["i"] += 1
+                tb["t"], _, s = k2.decide2(tb["t"], b, write=write, math="token")
+                return s.cache_hits
+
+            dt = slope(fn)
+            state[write] = tb["t"]
+            log(f"B={B:6d} write={write:5s}: {dt*1e3:7.3f} ms/dispatch")
+
+
+if __name__ == "__main__":
+    main()

@@ -372,6 +372,12 @@ class LocalEngine:
         store=None,
     ):
         self.table = table if table is not None else new_table2(capacity)
+        # one write mode for every dispatch: the Pallas sweep on TPU, XLA
+        # scatter on CPU meshes. A batch-size crossover to the scatter used
+        # to exist on a "scatter costs ∝ batch" assumption — measured FALSE
+        # at scale (exp/exp_crossover.py, v5e, 1 GiB table: scatter ≈ 58 ms
+        # at EVERY batch size 2K-16K vs sweep 4.1-4.9 ms), so it picked a
+        # 13× slower path exactly where latency mattered.
         self.write_mode = write_mode or default_write_mode()
         self._decide_fn = decide_fn
         # oracle engines return unpacked outputs; the begin/finish split
@@ -400,9 +406,8 @@ class LocalEngine:
             self.table, resp, stats = self._decide_fn(self.table, to_device(hb))
             return np.asarray(pack_outputs(resp, stats))
         dev = jax.device_put(pack_host_batch(hb))
-        write = self._write_mode_for(hb.fp.shape[0])
         self.table, packed = decide2_packed_cols(
-            self.table, dev, write=write, math=_math_mode(hb)
+            self.table, dev, write=self.write_mode, math=_math_mode(hb)
         )
         return np.asarray(packed)
 
@@ -410,9 +415,8 @@ class LocalEngine:
         """Issue one dispatch from a staged ingress array WITHOUT fetching:
         the table advances immediately; the packed output is fetched later
         on a fetch thread while this thread launches the next dispatch."""
-        write = self._write_mode_for(batch_rows)
         self.table, packed = decide2_packed_cols(
-            self.table, dev_arr, write=write, math=math
+            self.table, dev_arr, write=self.write_mode, math=math
         )
         return packed
 
@@ -487,17 +491,6 @@ class LocalEngine:
             dropped = nd
             retries += 1
         return dropped
-
-    def _write_mode_for(self, batch: int) -> str:
-        """Pick the write strategy per dispatch. The Pallas sweep streams the
-        WHOLE table (cost ∝ table size, ~3.3 ms/GiB); the XLA scatter costs
-        ∝ batch rows (~0.5 µs/row on v5e). Small batches against big tables
-        scatter; everything else sweeps. Crossover ≈ NB/350 rows — use NB/512
-        (biased toward the sweep, the better-exercised TPU path)."""
-        if self.write_mode != "sweep":
-            return self.write_mode
-        nb = self.table.rows.shape[0]
-        return "xla" if batch * 512 < nb else "sweep"
 
     def check(
         self,
