@@ -10,8 +10,14 @@ per-chip share 6.25M decisions/sec), plus the BASELINE config matrix:
   config2   leaky bucket, 1M keys, Zipf-1.1 skewed traffic      (config #2)
   config4   mixed token+leaky with RESET_REMAINING/DRAIN flags  (config #4)
 
-Also reports per-dispatch p99 latency (fetch-forced round trips, so the number
-includes the axon tunnel RTT — an upper bound on device latency) and runs a
+The headline is measured through an on-device fori_loop window
+(ops/loop.decide_loop) so one launch covers the whole timed run and tunnel
+RTT cancels — see Case.device_loop; every published number passes the
+bench_guard sanity gates (dt floor, RTT-dominance ratio, physical rate
+ceiling, proof-of-work counter reconciliation). The host-driven slope is
+reported per case as the secondary serving_* figures (those DO absorb the
+tunnel RTT per dispatch). Also reports per-dispatch p99 latency
+(fetch-forced round trips — an upper bound on device latency) and runs a
 sweep-vs-XLA write parity smoke on the real TPU (the only place the Pallas
 sweep runs un-interpreted; CI meshes are CPU).
 
@@ -30,9 +36,11 @@ import gubernator_tpu  # noqa: F401  (enables x64)
 import jax
 import jax.numpy as jnp
 
+from gubernator_tpu.bench_guard import WorkMismatchError, check_work, slope
 from gubernator_tpu.ops.batch import ReqBatch
 from gubernator_tpu.ops.engine import default_write_mode
 from gubernator_tpu.ops.kernel2 import decide2
+from gubernator_tpu.ops.loop import decide_loop, stack_batches
 from gubernator_tpu.ops.table2 import new_table2
 from gubernator_tpu.types import Algorithm, Behavior
 
@@ -91,27 +99,120 @@ def unique_agg(fps: np.ndarray) -> "tuple[np.ndarray, np.ndarray]":
 
 class Case:
     """One benchmark case: pre-staged device batches cycled through a
-    donated-table dispatch loop; throughput from the slope between a short and
-    a long pipelined run (the tunneled axon platform has no true
-    block_until_ready, so completion is forced by fetching a scalar).
+    donated-table dispatch loop.
+
+    The HEADLINE number comes from the on-device loop (ops/loop.decide_loop):
+    K kernel iterations inside one jitted fori_loop, so one launch + one
+    scalar fetch covers the whole timed window and tunnel RTT cancels in the
+    short/long difference — chip compute, not transport weather. The window
+    grows adaptively until the guard (bench_guard.slope) accepts the timing,
+    and the loop's accumulated counters must reconcile with the decision
+    count the rate claims (bench_guard.check_work) before anything is
+    published.
+
+    The host-driven slope (one dispatch per host call, fetch at the end) is
+    kept as the SECONDARY "serving overhead" figure — on the tunneled dev
+    platform it absorbs a round trip per dispatch and is reported as such.
 
     `math` mirrors the engine's per-dispatch static specialization
     (ops/engine._math_mode): all-token cases compile the decision graph
     without the emulated-f64 leaky lanes."""
 
     def __init__(self, name, capacity, batches, seed_batches=None, seed_iter=None,
-                 math="mixed"):
+                 math="mixed", active_counts=None):
         self.name = name
         self.table = new_table2(capacity)
         self.batches = batches
         self.seed_batches = seed_batches if seed_batches is not None else batches
         self.seed_iter = seed_iter  # lazy seeding for huge keyspaces
         self.math = math
+        # active rows per staged batch, known host-side at construction
+        # (padded cases pass the real counts; fetching active.sum() from the
+        # device would cost a serialized tunnel RTT per batch)
+        self.active_counts = (
+            active_counts
+            if active_counts is not None
+            else [int(b.fp.shape[0]) for b in batches]
+        )
         self.last_stats = None
 
     def dispatch(self, b):
         self.table, resp, stats = decide2(self.table, b, write=WRITE, math=self.math)
         return stats
+
+    def expected_decisions(self, k: int) -> int:
+        """Active decisions made by k dispatches cycling the staged batches
+        from batch 0 — both the proof-of-work expectation for the device
+        loop and the decision unit for every published rate (padding rows
+        are not decisions)."""
+        n = len(self.batches)
+        full, rem = divmod(k, n)
+        return full * sum(self.active_counts) + sum(self.active_counts[:rem])
+
+    def device_loop(self) -> dict:
+        """Primary measurement: slope between a short and a long on-device
+        fori_loop window (each is ONE launch — RTT appears once per run and
+        cancels in the difference). Adaptive: on guard rejection the long
+        window grows until device time dominates jitter."""
+        stacked = stack_batches(self.batches)
+        expected = self.expected_decisions
+
+        def timed(k: int):
+            t0 = time.perf_counter()
+            self.table, acc = decide_loop(
+                self.table, stacked, jnp.int32(k), write=WRITE, math=self.math
+            )
+            # ONE fetch of the whole counter vector forces the launch chain
+            # (per-element int() would pay one tunnel RTT per counter)
+            acc = [int(x) for x in np.asarray(acc)]
+            t = time.perf_counter() - t0
+            bad = check_work(acc[0] + acc[1], expected(k))
+            if bad:
+                raise WorkMismatchError(f"device loop k={k}: {bad}")
+            return t, acc
+
+        t0 = time.perf_counter()
+        try:
+            timed(2)  # compile + warm
+        except WorkMismatchError as exc:
+            # a failed proof-of-work must refuse, not kill the record
+            log(f"[{self.name}] device loop invalid: {exc}")
+            return {"device_invalid": str(exc)}
+        log(f"[{self.name}] device-loop compile: {time.perf_counter() - t0:.1f}s")
+
+        k_short, k_long = 4, 68
+        for attempt in range(5):
+            try:
+                t_short = min(timed(k_short)[0] for _ in range(3))
+                t_long = min(timed(k_long)[0] for _ in range(3))
+            except WorkMismatchError as exc:
+                log(f"[{self.name}] device loop invalid: {exc}")
+                return {"device_invalid": str(exc)}
+            rows_eff = (expected(k_long) - expected(k_short)) / (k_long - k_short)
+            s = slope(t_short, t_long, k_short, k_long, rows_eff)
+            if s.reason is None:
+                log(
+                    f"[{self.name}] device loop: {k_long - k_short} x "
+                    f"{rows_eff:.0f} decisions in {t_long - t_short:.3f}s = "
+                    f"{s.rate/1e6:.2f}M/s ({s.per_iter_ms:.2f} ms/dispatch "
+                    f"on-device; t_short={t_short:.3f}s t_long={t_long:.3f}s)"
+                )
+                return {
+                    "device_decisions_per_sec": round(s.rate, 1),
+                    "device_ms": round(s.per_iter_ms, 3),
+                    "device_loop_k": [k_short, k_long],
+                }
+            # size the next window from whatever signal this one carried
+            dt = t_long - t_short
+            if dt > 0.02:
+                per_iter = dt / (k_long - k_short)
+                need_dt = max(0.06, 0.6 * t_short)
+                k_long = k_short + min(4096, int(need_dt / per_iter) + 1)
+            else:
+                k_long = k_short + min(4096, 2 * (k_long - k_short))
+            log(f"[{self.name}] device loop rejected ({s.reason}); retry "
+                f"k_long={k_long}")
+        return {"device_invalid": s.reason}
 
     def run(self, dispatches=48, latency_probes=24):
         t0 = time.perf_counter()
@@ -126,6 +227,7 @@ class Case:
                 _ = int(stats.cache_hits)
         _ = int(stats.cache_hits)
         log(f"[{self.name}] compile+seed: {time.perf_counter() - t0:.1f}s")
+        device = self.device_loop()
         n = len(self.batches)
         # small batches dispatch in ~µs — scale the dispatch count up so the
         # timed work dwarfs tunnel RTT jitter, or the slope is pure noise
@@ -144,10 +246,17 @@ class Case:
         n_short, n_long = 4, 4 + dispatches
         t_short = min(timed_run(n_short)[0] for _ in range(3))
         t_long, hits, misses = min(timed_run(n_long) for _ in range(3))
-        dt = max(t_long - t_short, 1e-9)
-        batch = int(self.batches[0].fp.shape[0])
-        dps = dispatches * batch / dt
-        per_dispatch_ms = dt / dispatches * 1e3
+        batch = batch_rows
+        # serving-overhead slope: one host call per dispatch, so on the
+        # tunneled platform this number absorbs a round trip per dispatch —
+        # it is the secondary figure; min_ratio=1.0 because RTT-dominance is
+        # exactly what it reports. dt-floor and rate-ceiling still apply.
+        # Decision unit = ACTIVE rows, same as the device loop (padded cases
+        # would otherwise inflate the serving figure vs the device one).
+        rows_eff = (
+            self.expected_decisions(n_long) - self.expected_decisions(n_short)
+        ) / (n_long - n_short)
+        s = slope(t_short, t_long, n_short, n_long, rows_eff, min_ratio=1.0)
         # per-dispatch latency: force a round trip EVERY iteration (no
         # pipelining) — includes the host↔device fetch RTT, upper bound
         lat = []
@@ -158,21 +267,28 @@ class Case:
             lat.append(time.perf_counter() - t0)
         lat_ms = np.asarray(lat) * 1e3
         p50, p99 = float(np.percentile(lat_ms, 50)), float(np.percentile(lat_ms, 99))
-        log(
-            f"[{self.name}] slope: {dispatches} x {batch} decisions in {dt:.3f}s"
-            f" = {dps/1e6:.2f}M/s ({per_dispatch_ms:.2f} ms/dispatch); "
-            f"round-trip latency p50={p50:.1f}ms p99={p99:.1f}ms; "
-            f"timed-phase stats: hits={hits} misses={misses}"
-        )
-        return {
-            "decisions_per_sec": round(dps, 1),
-            "dispatch_ms": round(per_dispatch_ms, 3),
+        out = {
             "batch": batch,
             "rt_latency_p50_ms": round(p50, 2),
             "rt_latency_p99_ms": round(p99, 2),
             "timed_hits": hits,
             "timed_misses": misses,
+            **device,
         }
+        if s.reason is None:
+            log(
+                f"[{self.name}] serving slope: {dispatches} x {rows_eff:.0f} decisions"
+                f" in {t_long - t_short:.3f}s = {s.rate/1e6:.2f}M/s"
+                f" ({s.per_iter_ms:.2f} ms/dispatch incl. tunnel RTT);"
+                f" round-trip latency p50={p50:.1f}ms p99={p99:.1f}ms;"
+                f" timed-phase stats: hits={hits} misses={misses}"
+            )
+            out["serving_decisions_per_sec"] = round(s.rate, 1)
+            out["serving_dispatch_ms"] = round(s.per_iter_ms, 3)
+        else:
+            log(f"[{self.name}] serving slope rejected: {s.reason}")
+            out["serving_invalid"] = s.reason
+        return out
 
 
 def headline_case(rng, now) -> Case:
@@ -198,6 +314,7 @@ def config1_case(rng, now) -> Case:
     BATCH = 1 << 17
     keys = rng.integers(1, (1 << 63) - 1, size=1024, dtype=np.int64)
     batches = []
+    active_counts = []
     for _ in range(8):
         draw = keys[rng.integers(0, 1024, size=BATCH)]
         ufp, hits = unique_agg(draw)
@@ -207,8 +324,10 @@ def config1_case(rng, now) -> Case:
             hits = np.concatenate([hits, np.zeros(pad, dtype=np.int64)])
         b = make_req_batch(ufp, now, hits=hits, limit=1 << 30)
         b = b._replace(active=jnp.asarray(ufp != 0))
+        active_counts.append(int((ufp != 0).sum()))
         batches.append(jax.device_put(b))
-    c = Case("config1-token-1K", 1 << 14, batches, math="token")
+    c = Case("config1-token-1K", 1 << 14, batches, math="token",
+             active_counts=active_counts)
     c.logical_batch = BATCH  # decisions represented per dispatch
     return c
 
@@ -219,6 +338,7 @@ def config2_case(rng, now) -> Case:
     BATCH = 1 << 17
     keyspace = rng.integers(1, (1 << 63) - 1, size=LIVE, dtype=np.int64)
     batches = []
+    active_counts = []
     for _ in range(8):
         z = rng.zipf(1.1, size=BATCH * 2) - 1
         z = z[z < LIVE][:BATCH]
@@ -230,6 +350,7 @@ def config2_case(rng, now) -> Case:
         algo = np.full(BATCH, int(Algorithm.LEAKY_BUCKET), dtype=np.int32)
         b = make_req_batch(ufp, now, hits=hits, algo=algo, limit=1 << 30)
         b = b._replace(active=jnp.asarray(ufp != 0))
+        active_counts.append(int((ufp != 0).sum()))
         batches.append(jax.device_put(b))
     # seed with the full keyspace so steady state has 1M live keys
     seed = [
@@ -244,7 +365,7 @@ def config2_case(rng, now) -> Case:
         for i in range(LIVE // BATCH)
     ] + batches
     return Case("config2-leaky-1M-zipf", 1 << 21, batches, seed_batches=seed,
-                math="mixed")
+                math="mixed", active_counts=active_counts)
 
 
 def config4_case(rng, now) -> Case:
@@ -501,7 +622,11 @@ def main() -> None:
 
     parity_ok = sweep_parity_smoke(rng, now)
 
-    headline = headline_case(rng, now).run()
+    try:
+        headline = headline_case(rng, now).run()
+    except Exception as exc:  # the record must print even on a dead headline
+        log(f"[headline-10M] FAILED: {type(exc).__name__}: {exc}")
+        headline = {"error": str(exc)[:200]}
     matrix = {"parity_sweep_vs_xla": parity_ok}
     try:
         matrix["e2e-serving"] = e2e_serving_case()
@@ -510,12 +635,22 @@ def main() -> None:
         matrix["e2e-serving"] = {"error": str(exc)[:200]}
     for builder in (config1_case, config2_case, config4_case):
         case = builder(rng, now)
-        res = case.run(dispatches=24, latency_probes=12)
-        if hasattr(case, "logical_batch"):
-            # throughput in *client decisions* (pre-aggregation) per second
-            scale = case.logical_batch / res["batch"]
+        try:
+            res = case.run(dispatches=24, latency_probes=12)
+        except Exception as exc:  # one dead case must not sink the record
+            log(f"[{case.name}] FAILED: {type(exc).__name__}: {exc}")
+            matrix[case.name] = {"error": str(exc)[:200]}
+            continue
+        if hasattr(case, "logical_batch") and "device_decisions_per_sec" in res:
+            # throughput in *client decisions* (pre-aggregation) per second:
+            # each dispatch's ~active unique keys answer logical_batch
+            # client rows
+            mean_active = case.expected_decisions(len(case.batches)) / len(
+                case.batches
+            )
+            scale = case.logical_batch / mean_active
             res["client_decisions_per_sec"] = round(
-                res["decisions_per_sec"] * scale, 1
+                res["device_decisions_per_sec"] * scale, 1
             )
         matrix[case.name] = res
 
@@ -531,19 +666,25 @@ def main() -> None:
             log(f"[config5-100M] FAILED: {type(exc).__name__}: {exc}")
             matrix["config5-100M"] = {"error": str(exc)[:200]}
 
-    dps = headline["decisions_per_sec"]
+    # headline = on-device loop rate (chip compute, RTT-immune); the host
+    # serving slope is never promoted to the headline — if the device loop
+    # failed its guards the record says so instead of publishing weather
+    dps = headline.get("device_decisions_per_sec")
     matrix["headline-10M"] = headline
-    print(
-        json.dumps(
-            {
-                "metric": "ratelimit_decisions_per_sec_per_chip",
-                "value": dps,
-                "unit": "decisions/s",
-                "vs_baseline": round(dps / PER_CHIP_BASELINE, 3),
-                "matrix": matrix,
-            }
+    record = {
+        "metric": "ratelimit_decisions_per_sec_per_chip",
+        "value": dps if dps is not None else 0.0,
+        "unit": "decisions/s",
+        "vs_baseline": round((dps or 0.0) / PER_CHIP_BASELINE, 3),
+        "matrix": matrix,
+    }
+    if dps is None:
+        record["invalid"] = (
+            headline.get("device_invalid")
+            or headline.get("error")
+            or "no headline rate"
         )
-    )
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,21 @@
+"""Does the on-device fori_loop harness (ops/loop.decide_loop) compile and
+run the Pallas-sweep kernel on the real TPU, and does its rate agree with
+the host-slope at headline geometry?  (Round-5 RTT-immune bench check.)"""
+import sys, time
+import numpy as np
+import gubernator_tpu  # noqa
+import jax, jax.numpy as jnp
+from bench import Case, make_req_batch
+
+def log(m): print(m, file=sys.stderr, flush=True)
+
+rng = np.random.default_rng(42)
+now = int(time.time() * 1000)
+log(f"device: {jax.devices()[0]}")
+CAP, LIVE, BATCH = 1 << 24, 10_000_000, 1 << 17
+keyspace = rng.integers(1, (1 << 63) - 1, size=LIVE, dtype=np.int64)
+perm = rng.permutation(LIVE)
+batches = [jax.device_put(make_req_batch(keyspace[perm[i*BATCH:(i+1)*BATCH]], now)) for i in range(8)]
+c = Case("loop-headline", CAP, batches, math="token")
+res = c.run(dispatches=24, latency_probes=6)
+log(f"RESULT: {res}")

@@ -1,0 +1,109 @@
+"""Sanity guards between benchmark timing loops and the published record.
+
+Round 4's driver-recorded benchmark published two numbers that were not
+engineering: a headline 24x below the in-session measurement because every
+timed dispatch absorbed a degraded tunnel round trip, and a physically
+impossible 2.5e16 decisions/s from a dt that two noisy host timings drove
+to 0.000 s (min-of-3 on jittered clocks can make t_long <= t_short). Both
+failure modes are properties of the *timing arithmetic*, so the defense
+lives here as pure functions the suite can pin under simulated jitter
+(tests/test_bench_guard.py) — the bench publishes a rate only when these
+accept it, and publishes the refusal reason otherwise.
+
+The reference's CI has the same shape of defense at a coarser grain: it
+gates benchmark results relative to master with a +-200% band
+(reference .github/workflows/on-pull-request.yml:47-80) rather than
+trusting any single run.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+# A v5e chip cannot exceed ~2e9 decisions/s: each decision reads a 512 B
+# bucket row (gather) and the sweep write streams the whole table per
+# dispatch, so >=1 GiB tables bound throughput to ~1e8/s at headline batch
+# and even a degenerate tiny-table case is HBM-bound orders of magnitude
+# below this ceiling. Anything above it is a timing artifact, never a chip.
+MAX_SANE_RATE = 2e9
+
+
+class WorkMismatchError(Exception):
+    """A timed window's device counters did not reconcile with the decisions
+    its rate would claim (check_work refusal). Deliberately NOT a
+    RuntimeError: jaxlib's XlaRuntimeError subclasses RuntimeError, and a
+    catch broad enough to take both would mislabel infrastructure failures
+    as guard refusals (and then keep using a table poisoned by the failed
+    donated computation)."""
+
+
+class Slope(NamedTuple):
+    rate: Optional[float]  # decisions/s, None if rejected
+    per_iter_ms: Optional[float]
+    reason: Optional[str]  # rejection reason, None if accepted
+
+
+def slope(
+    t_short: float,
+    t_long: float,
+    n_short: int,
+    n_long: int,
+    rows_per_iter: int,
+    *,
+    min_dt: float = 0.050,
+    min_ratio: float = 1.4,
+    max_rate: float = MAX_SANE_RATE,
+) -> Slope:
+    """Validate a two-point slope timing and derive a rate.
+
+    t_short/t_long: wall time of a run of n_short/n_long iterations (each
+    run is ONE device launch when used with ops/loop.decide_loop, so the
+    per-run constant — launch + fetch RTT — cancels in the difference).
+
+    Rejections:
+      * dt under `min_dt` — the difference is smaller than host clock +
+        RTT jitter can resolve; round 4's config5 published 2.5e16/s from
+        exactly this (dt floored at 1e-9 instead of rejected).
+      * t_long < min_ratio * t_short — the run time is dominated by the
+        per-run constant, not the iterations: the slope would measure
+        transport weather, not compute. The caller's remedy is a longer
+        window (bigger n_long), not a retry of the same one.
+      * rate > max_rate — physically impossible for this hardware
+        regardless of how plausible the arithmetic looked.
+    """
+    if n_long <= n_short:
+        return Slope(None, None, f"n_long {n_long} <= n_short {n_short}")
+    dt = t_long - t_short
+    if dt < min_dt:
+        return Slope(
+            None, None,
+            f"dt {dt*1e3:.1f}ms under {min_dt*1e3:.0f}ms floor "
+            "(jitter-resolvable only)",
+        )
+    if t_long < min_ratio * t_short:
+        return Slope(
+            None, None,
+            f"t_long {t_long:.3f}s < {min_ratio}x t_short {t_short:.3f}s: "
+            "per-run constant dominates; grow the window",
+        )
+    rate = (n_long - n_short) * rows_per_iter / dt
+    if rate > max_rate:
+        return Slope(
+            None, None,
+            f"rate {rate:.3e}/s exceeds physical ceiling {max_rate:.0e}/s",
+        )
+    return Slope(rate, dt / (n_long - n_short) * 1e3, None)
+
+
+def check_work(
+    counted: int, expected: int, *, label: str = "decisions"
+) -> Optional[str]:
+    """Proof-of-work cross-check: the device-side counters accumulated by
+    the timed loop must equal the decisions the window claims to have made.
+    Returns a refusal reason, or None if the work is accounted for."""
+    if counted != expected:
+        return (
+            f"{label} counted {counted} != expected {expected}: "
+            "timed window did not do the work its rate claims"
+        )
+    return None
