@@ -437,6 +437,116 @@ def config5_case(rng, now) -> Case:
                 math="token")
 
 
+def config3_global_case(rng, now, live=10_000_000, batch=1 << 17,
+                        sync_out=16384) -> dict:
+    """BASELINE config #3: GLOBAL behavior at 10M keys (8-peer cluster ↦
+    mesh; reference global.go:31-307). On the one available chip the mesh is
+    1 device, so every key is owner-here: the measured GLOBAL path is
+    queue-merge (vectorized group-by, parallel/global_sync.PendingHits) +
+    owner-side authoritative dispatch + broadcast markers — the host-work
+    side that round 4 left unmeasured (the replica-answer dispatch is the
+    same kernel against the replica table, i.e. the plain-dispatch figure).
+    Reports:
+      * global vs plain dispatch throughput through the SAME engine-serving
+        loop (both absorb identical per-dispatch tunnel RTTs, so the RATIO
+        isolates the GLOBAL path's host overhead — the verdict's
+        within-2x-of-non-GLOBAL criterion);
+      * collective sync cost: ms per _sync_round tick and reconciled
+        entries/s at the configured outbox size (GlobalSyncWait analog,
+        reference config.go:142-146).
+    """
+    from gubernator_tpu.ops.batch import RequestColumns
+    from gubernator_tpu.parallel import make_mesh
+    from gubernator_tpu.parallel.global_sync import GlobalShardedEngine
+    from gubernator_tpu.parallel.sharded import ShardedEngine
+
+    GLOBAL = int(Behavior.GLOBAL)
+
+    def cols_for(fps, behavior):
+        n = fps.shape[0]
+        return RequestColumns(
+            fp=fps,
+            algo=np.zeros(n, dtype=np.int32),
+            behavior=np.full(n, behavior, dtype=np.int32),
+            hits=np.ones(n, dtype=np.int64),
+            limit=np.full(n, 1 << 30, dtype=np.int64),
+            burst=np.zeros(n, dtype=np.int64),
+            duration=np.full(n, 3_600_000, dtype=np.int64),
+            created_at=np.full(n, now, dtype=np.int64),
+            err=np.zeros(n, dtype=np.int8),
+        )
+
+    mesh = make_mesh(1)
+    keyspace = rng.integers(1, (1 << 63) - 1, size=live, dtype=np.int64)
+    perm = rng.permutation(live)
+    staged = [keyspace[perm[i * batch: (i + 1) * batch]] for i in range(8)]
+
+    out: dict = {"batch": batch, "live_keys": live, "sync_out": sync_out}
+    for name, mk in (
+        ("global", lambda: GlobalShardedEngine(
+            mesh, capacity_per_shard=1 << 24, sync_out=sync_out)),
+        ("plain", lambda: ShardedEngine(mesh, capacity_per_shard=1 << 24)),
+    ):
+        eng = mk()
+        t0 = time.perf_counter()
+        # seed the full keyspace through the PLAIN path on both engines
+        # (GLOBAL seeding would queue 10M broadcast markers)
+        for i in range(0, live, batch):
+            chunk = keyspace[i: i + batch]
+            eng.check_columns(cols_for(chunk, 0), now_ms=now)
+        log(f"[config3-global] {name}: seeded {live:,} keys in "
+            f"{time.perf_counter() - t0:.0f}s")
+        behavior = GLOBAL if name == "global" else 0
+
+        def timed(k):
+            t0 = time.perf_counter()
+            for i in range(k):
+                eng.check_columns(cols_for(staged[i % 8], behavior),
+                                  now_ms=now)
+            return time.perf_counter() - t0
+
+        timed(2)  # warm any residual shapes
+        n_short, n_long = 2, 14
+        t_short = min(timed(n_short) for _ in range(2))
+        t_long = min(timed(n_long) for _ in range(2))
+        s = slope(t_short, t_long, n_short, n_long, batch, min_ratio=1.0)
+        if s.reason is None:
+            out[f"{name}_decisions_per_sec"] = round(s.rate, 1)
+            out[f"{name}_dispatch_ms"] = round(s.per_iter_ms, 3)
+            log(f"[config3-global] {name}: {s.rate/1e6:.2f}M/s "
+                f"({s.per_iter_ms:.2f} ms/dispatch incl. RTT)")
+        else:
+            out[f"{name}_invalid"] = s.reason
+            log(f"[config3-global] {name} slope rejected: {s.reason}")
+        if name == "global":
+            # (b) collective sync: drain what the timed window queued,
+            # timing per tick — cost of the two-all_gather reconcile step
+            queued = eng.global_stats.send_queue_length
+            rounds = 0
+            t0 = time.perf_counter()
+            while eng.has_pending() and rounds < 64:
+                eng._sync_round(now_ms=now)
+                rounds += 1
+            dt = time.perf_counter() - t0
+            if rounds:
+                out["sync_ms_per_round"] = round(dt / rounds * 1e3, 2)
+                out["sync_entries_per_sec"] = round(
+                    min(queued, rounds * sync_out) / dt, 1
+                )
+                log(f"[config3-global] sync: {rounds} rounds x {sync_out} "
+                    f"outbox in {dt:.2f}s = {out['sync_ms_per_round']}ms/round")
+            # drop the remaining backlog without timing (bounded rounds
+            # above keep the bench finite at huge queue depths)
+            for p in eng.pending:
+                p.hb = p.hits = p.reset = None
+        del eng
+    if ("global_decisions_per_sec" in out and "plain_decisions_per_sec" in out):
+        out["global_vs_plain"] = round(
+            out["global_decisions_per_sec"] / out["plain_decisions_per_sec"], 3
+        )
+    return out
+
+
 def sweep_parity_smoke(rng, now):
     """Real-TPU check that the Pallas sweep write produces the same table and
     responses as the XLA scatter write. Returns True/False, or "skipped" on
@@ -653,6 +763,12 @@ def main() -> None:
                 res["device_decisions_per_sec"] * scale, 1
             )
         matrix[case.name] = res
+
+    try:
+        matrix["config3-global"] = config3_global_case(rng, now)
+    except Exception as exc:
+        log(f"[config3-global] FAILED: {type(exc).__name__}: {exc}")
+        matrix["config3-global"] = {"error": str(exc)[:200]}
 
     if jax.default_backend() == "tpu":
         # BASELINE #5 scale needs the real chip's HBM (8 GiB table); runs

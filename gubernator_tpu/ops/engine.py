@@ -256,7 +256,17 @@ def prepare_check_columns(engine, cols, now_ms=None) -> PendingCheck:
     no engine state): pack, clamp, plan same-key passes, and stage each
     pass's SINGLE packed ingress transfer on-device via the engine's
     `stage_pass` (LocalEngine: (12, B) array; ShardedEngine: routed
-    (D, 12, b_local) grid)."""
+    (D, 12, b_local) grid).
+
+    Engines with batch shapes the generic split cannot express (the
+    mesh-global engine's replica/owner fork) provide `prepare_columns`,
+    returning their own pending object — or None to fall through to the
+    generic path for batches without the special rows."""
+    hook = getattr(engine, "prepare_columns", None)
+    if hook is not None:
+        alt = hook(cols, now_ms=now_ms)
+        if alt is not None:
+            return alt
     now = now_ms if now_ms is not None else ms_now()
     hb, err = pack_columns(cols, now, tolerance_ms=engine.created_at_tolerance_ms)
     clamped = int(
@@ -275,6 +285,8 @@ def issue_check_columns(engine, pending: PendingCheck) -> PendingCheck:
     Later passes depend only on device state, not fetched outputs, so the
     whole chain enqueues back-to-back; each entry's staged ingress is
     replaced by its pending (un-fetched) output handle."""
+    if not isinstance(pending, PendingCheck):  # engine-specific pending
+        return engine.issue_pending(pending)
     for entry in pending.passes:
         _p, _n, batch, staged = entry
         entry[3] = engine.issue_staged(staged, int(batch.fp.shape[0]))
@@ -293,6 +305,8 @@ def finish_check_columns(
     routes them to the serial one): the Store contract needs rehydrates and
     write-throughs ordered against every same-key dispatch, which a
     pipeline with interleaved chunks cannot guarantee."""
+    if not isinstance(pending, PendingCheck):  # engine-specific pending
+        return engine.finish_pending(pending, fixup)
     hb, err, now = pending.hb, pending.err, pending.now
     n = hb.fp.shape[0]
     status = np.zeros(n, dtype=np.int32)

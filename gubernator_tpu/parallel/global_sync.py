@@ -32,6 +32,7 @@ cadence, identical to the reference's contract.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence
 
@@ -63,6 +64,99 @@ from gubernator_tpu.types import (
     has_behavior,
 )
 from gubernator_tpu.ops.engine import ERR_NOT_PERSISTED, _pad_size, default_write_mode, ms_now
+
+
+class PendingHits:
+    """Columnar per-home accumulator of GLOBAL hits awaiting the sync tick.
+
+    The merge is the reference's async-hit aggregation (global.go:109-123:
+    sum Hits, OR RESET_REMAINING, newest request's config wins) as ONE numpy
+    group-by per batch instead of a Python dict update per row — at 131K-row
+    batches the per-row loop was µs-per-row host work against a ms-per-batch
+    kernel. Entry order only affects which sync round an entry rides in
+    (sync() drains fully every tick), never the reconciled result."""
+
+    __slots__ = ("hb", "hits", "reset")
+
+    def __init__(self):
+        self.hb: Optional[HostBatch] = None  # unique-fp config carrier rows
+        self.hits: Optional[np.ndarray] = None  # (n,) i64 accumulated hits
+        self.reset: Optional[np.ndarray] = None  # (n,) i32 RESET bits OR-ed
+
+    def __len__(self) -> int:
+        # single read of self.hb: has_pending() is called from the event-loop
+        # thread while the engine thread's take() may set hb=None — two reads
+        # (check then use) would race
+        hb = self.hb
+        return 0 if hb is None else int(hb.fp.shape[0])
+
+    def merge(
+        self, hb: HostBatch, rows: np.ndarray, hits: np.ndarray,
+        reset: np.ndarray,
+    ) -> None:
+        """Fold batch rows `rows` of `hb` in (hits pre-zeroed for owner-side
+        rows that only mark a broadcast)."""
+        new = _subset(hb, rows)
+        if self.hb is not None:
+            new = HostBatch(
+                *[np.concatenate([a, b]) for a, b in zip(self.hb, new)]
+            )
+            hits = np.concatenate([self.hits, hits])
+            reset = np.concatenate([self.reset, reset])
+        uniq, inv = np.unique(new.fp, return_inverse=True)
+        m = uniq.size
+        h = np.zeros(m, dtype=np.int64)
+        np.add.at(h, inv, hits)
+        r = np.zeros(m, dtype=np.int32)
+        np.bitwise_or.at(r, inv, reset.astype(np.int32))
+        # newest config wins: highest concatenated position per key (existing
+        # entries precede the new batch's rows, which are in request order)
+        pos = np.full(m, -1, dtype=np.int64)
+        np.maximum.at(pos, inv, np.arange(new.fp.shape[0]))
+        self.hb = _subset(new, pos)
+        self.hits, self.reset = h, r
+
+    def take(self, k: int):
+        """Pop up to k entries → (config rows, hits, reset) columns."""
+        n = len(self)
+        k = min(k, n)
+        out = (_subset(self.hb, np.arange(k)), self.hits[:k], self.reset[:k])
+        if k == n:
+            self.hb = self.hits = self.reset = None
+        else:
+            self.hb = _subset(self.hb, np.arange(k, n))
+            self.hits = self.hits[k:]
+            self.reset = self.reset[k:]
+        return out
+
+
+@dataclass
+class _QueuedHits:
+    """Queue-merge inputs computed at PREPARE time, applied at ISSUE time —
+    the accumulator mutation must stay on the engine thread (single-writer),
+    while prepare runs on the pipeline's prep pool."""
+
+    hb: HostBatch  # the GLOBAL sub-batch (config carrier rows)
+    rows: np.ndarray  # rows to queue (active, nonzero hits)
+    hits: np.ndarray  # per-row hits (0 for owner-side broadcast markers)
+    reset: np.ndarray  # RESET_REMAINING bits
+    home: int  # the batch's rotating home device
+    n_remote: int  # non-owner rows (hits_queued metric delta)
+
+
+@dataclass
+class GlobalPending:
+    """In-flight pipelined GLOBAL check (the mesh-global engine's analog of
+    ops/engine.PendingCheck): staged replica/owner/plain dispatches plus the
+    deferred hit-queue merge."""
+
+    hb: HostBatch
+    err: np.ndarray
+    now: int
+    queue: _QueuedHits
+    # [Pass, n_rows, batch, staged→(staged, out), table_attr, home_pin, rowmap]
+    passes: list
+    clamped: int
 
 
 @dataclass
@@ -190,16 +284,6 @@ class GlobalShardedEngine(ShardedEngine):
     mesh_global = True  # daemon marker: this engine serves the GLOBAL
     # behavior through replica tables + collective sync
 
-    def can_pipeline(self, cols) -> bool:
-        """Per-batch pipeline gate (EngineRunner.check): batches with GLOBAL
-        rows need this class's check_columns — replica-table answers + hit
-        queueing for the sync tick — which the generic prepare/issue/finish
-        split would bypass. Pure non-GLOBAL batches pipeline as plain
-        sharded dispatches."""
-        return not bool(
-            ((np.asarray(cols.behavior) & int(Behavior.GLOBAL)) != 0).any()
-        )
-
     def __init__(
         self,
         mesh,
@@ -225,9 +309,14 @@ class GlobalShardedEngine(ShardedEngine):
         self.replica: Optional[Table2] = None
         self._sync_step = None
         self.sync_out = sync_out
-        self.pending: List[Dict[int, dict]] = [dict() for _ in range(self.n_shards)]
+        self.pending: List[PendingHits] = [
+            PendingHits() for _ in range(self.n_shards)
+        ]
         self.global_stats = GlobalStats()
         self._rr = 0  # rotating home-device assignment for served batches
+        # the home counter is the one piece of engine state the pipelined
+        # PREPARE stage touches (prep threads run concurrently)
+        self._rr_lock = threading.Lock()
 
     def _ensure_global_plane(self) -> None:
         if self.replica is None:
@@ -236,12 +325,13 @@ class GlobalShardedEngine(ShardedEngine):
             self._sync_step = _mk_sync_step(self.mesh, self.n_shards, self.sync_out)
 
     def _next_home(self) -> int:
-        h = self._rr % self.n_shards
-        self._rr += 1
-        return h
+        with self._rr_lock:
+            h = self._rr % self.n_shards
+            self._rr += 1
+            return h
 
     def has_pending(self) -> bool:
-        return any(self.pending)
+        return any(len(p) for p in self.pending)
 
     # ------------------------------------------------------------------ check
     def check(
@@ -268,20 +358,6 @@ class GlobalShardedEngine(ShardedEngine):
         for i, r in zip(glob, gsub):
             out[i] = r
         return out  # type: ignore[return-value]
-
-    def _queue(self, hb: HostBatch, i: int, home: int, hits: int) -> None:
-        fp = int(hb.fp[i])
-        agg = self.pending[home].get(fp)
-        if agg is None:
-            self.pending[home][fp] = {
-                "row": _subset(hb, np.array([i])),
-                "hits": hits,
-                "reset": int(hb.behavior[i]) & int(Behavior.RESET_REMAINING),
-            }
-        else:
-            agg["hits"] += hits
-            agg["reset"] |= int(hb.behavior[i]) & int(Behavior.RESET_REMAINING)
-            agg["row"] = _subset(hb, np.array([i]))  # newest config wins
 
     def _check_global(
         self, requests: Sequence[RateLimitRequest], now: int, home: int
@@ -362,6 +438,180 @@ class GlobalShardedEngine(ShardedEngine):
             reset_time=reset, err=err,
         )
 
+    # ------------------------------------------------- pipelined GLOBAL path
+    # The generic prepare/issue/finish split (ops/engine.py) can't express
+    # the GLOBAL fork (replica answers + owner applies + hit queueing), so
+    # this engine provides its own pending type through the
+    # `prepare_columns`/`issue_pending`/`finish_pending` hooks — GLOBAL
+    # batches ride the SAME pipeline as everything else instead of
+    # serializing the front door (the round-4 `can_pipeline` veto): the prep
+    # thread stages replica+owner+plain dispatches, the engine thread merges
+    # queued hits and launches all of them back-to-back, and the fetch
+    # thread materializes the outputs while the engine thread stages the
+    # next batch. Store-configured engines never reach these hooks
+    # (EngineRunner serializes them for write-through ordering).
+
+    def _global_fork(self, hb: HostBatch, home: int):
+        """Shared construction of the GLOBAL fork — the ONE place the queue
+        rules live (serial `_global_hb` and pipelined `prepare_columns` both
+        call it): zero-hit requests are never queued (global.go:85-95),
+        owner-side hits queue as hits=0 broadcast markers (QueueUpdate →
+        runBroadcasts), non-owner hits accumulate for the owner; non-owner
+        rows answer from the home replica with GLOBAL stripped and
+        NO_BATCHING forced (reference gubernator.go:416-422), owner rows run
+        the authoritative table."""
+        owner = shard_of(hb.fp, self.n_shards)
+        is_owner_here = (owner == home) & hb.active
+        q = np.nonzero(hb.active & (hb.hits != 0))[0]
+        queue = _QueuedHits(
+            hb=hb,
+            rows=q,
+            hits=np.where(is_owner_here[q], 0, hb.hits[q]).astype(np.int64),
+            reset=hb.behavior[q] & np.int32(Behavior.RESET_REMAINING),
+            home=home,
+            n_remote=int((~is_owner_here[q]).sum()),
+        )
+        hb_replica = hb._replace(
+            behavior=(hb.behavior & ~np.int32(Behavior.GLOBAL))
+            | np.int32(Behavior.NO_BATCHING),
+            active=hb.active & ~is_owner_here,
+        )
+        hb_owner = hb._replace(active=is_owner_here)
+        return is_owner_here, queue, hb_replica, hb_owner
+
+    def _apply_queue(self, qu: "_QueuedHits") -> None:
+        """Fold prepared queue-merge inputs into the sync accumulator
+        (engine thread only — single-writer)."""
+        if qu.rows.size:
+            self.pending[qu.home].merge(qu.hb, qu.rows, qu.hits, qu.reset)
+            self.global_stats.hits_queued += qu.n_remote
+        self.global_stats.send_queue_length = sum(len(p) for p in self.pending)
+
+    def prepare_columns(self, cols: RequestColumns, now_ms=None):
+        """Prepare hook (any thread): returns a GlobalPending for batches
+        carrying GLOBAL rows, or None to route pure-local batches through
+        the generic pipelined path."""
+        gmask = (np.asarray(cols.behavior) & np.int32(Behavior.GLOBAL)) != 0
+        if not gmask.any():
+            return None
+        now = now_ms if now_ms is not None else ms_now()
+        hb, err = pack_columns(
+            cols, now, tolerance_ms=self.created_at_tolerance_ms
+        )
+        clamped = int(
+            ((cols.created_at != 0) & (hb.created_at != cols.created_at)).sum()
+        )
+        home = self._next_home()
+        passes = []
+
+        def plan_into(batch, table_attr, home_pin, rowmap):
+            if not batch.active.any():
+                return
+            for p in plan_passes(batch, max_exact=self.max_exact_passes):
+                if len(p.rows) == 0:
+                    continue
+                shard = (
+                    np.full(p.batch.fp.shape[0], home_pin, dtype=np.int64)
+                    if home_pin is not None
+                    else None
+                )
+                staged = self._stage(p.batch, shard)
+                passes.append(
+                    [p, len(p.rows), p.batch, staged, table_attr, home_pin,
+                     rowmap]
+                )
+
+        rest = np.nonzero(~gmask)[0]
+        if rest.size:
+            plan_into(_subset(hb, rest), "table", None, rest)
+        g = np.nonzero(gmask)[0]
+        hbg = _subset(hb, g)
+        _owner_here, queue, hb_replica, hb_owner = self._global_fork(hbg, home)
+        plan_into(hb_replica, "replica", home, g)
+        plan_into(hb_owner, "table", None, g)
+        return GlobalPending(
+            hb=hb, err=err, now=now, queue=queue, passes=passes,
+            clamped=clamped,
+        )
+
+    def issue_pending(self, pending: "GlobalPending") -> "GlobalPending":
+        """Issue hook (engine thread): fold the queued hits into the sync
+        accumulator, then launch every staged dispatch without fetching."""
+        self._ensure_global_plane()
+        self._apply_queue(pending.queue)
+        for entry in pending.passes:
+            staged, table_attr = entry[3], entry[4]
+            table, out = self._decide(getattr(self, table_attr), staged)
+            setattr(self, table_attr, table)
+            entry[3] = (staged, out)
+        return pending
+
+    def finish_pending(self, pending: "GlobalPending", fixup):
+        """Finish hook (fetch thread): materialize every pass's output and
+        assemble the full response; claim-drop retries run on the engine
+        thread via `fixup` against the same table (replica pins preserved)."""
+        from gubernator_tpu.ops.engine import EngineStats
+
+        hb, err = pending.hb, pending.err
+        n = hb.fp.shape[0]
+        status = np.zeros(n, dtype=np.int32)
+        limit_o = np.zeros(n, dtype=np.int64)
+        remaining = np.zeros(n, dtype=np.int64)
+        reset = np.zeros(n, dtype=np.int64)
+        delta = EngineStats(created_at_clamped=pending.clamped, checks=n)
+        for p, np_, batch, pend, table_attr, home_pin, rowmap in pending.passes:
+            (s, l, r, t, dropped, hit), st, uncounted = self.finish_staged(
+                pend, np_
+            )
+            delta.cache_hits += st[0]
+            delta.cache_misses += st[1]
+            delta.over_limit += st[2]
+            delta.evicted_unexpired += st[3]
+            delta.dispatches += 1
+            if dropped.any():
+                rows = np.nonzero(dropped)[0]
+
+                def retry(rows=rows, batch=batch, uncounted=uncounted,
+                          table_attr=table_attr, home_pin=home_pin):
+                    sub = _subset(batch, rows)
+                    shard = (
+                        np.full(rows.size, home_pin, dtype=np.int64)
+                        if home_pin is not None
+                        else None
+                    )
+                    _, vals = self._dispatch(
+                        sub, depth=1, shard=shard, table_attr=table_attr,
+                        count=uncounted[rows] if uncounted is not None else None,
+                    )
+                    return vals
+
+                s2, l2, r2, t2, d2, h2 = fixup(retry)
+                s[rows], l[rows], r[rows], t[rows] = s2, l2, r2, t2
+                dropped[rows] = d2
+                hit[rows] = h2
+            if p.member_rows:
+                members = rowmap[np.concatenate(p.member_rows)]
+                src = np.repeat(
+                    np.arange(np_), [len(m) for m in p.member_rows]
+                )
+                status[members] = s[src]
+                limit_o[members] = l[src]
+                remaining[members] = r[src]
+                reset[members] = t[src]
+                err[members[dropped[src]]] = ERR_DROPPED
+            else:
+                rows_f = rowmap[p.rows]
+                status[rows_f] = s[:np_]
+                limit_o[rows_f] = l[:np_]
+                remaining[rows_f] = r[:np_]
+                reset[rows_f] = t[:np_]
+                err[rows_f[dropped[:np_]]] = ERR_DROPPED
+        rc = ResponseColumns(
+            status=status, limit=limit_o, remaining=remaining,
+            reset_time=reset, err=err,
+        )
+        return rc, delta
+
     def _global_hb(self, hb: HostBatch, home: int, now: Optional[int] = None):
         """The GLOBAL core over a packed batch: requests whose owner shard IS
         the home device run the owner path against the authoritative table and
@@ -371,39 +621,19 @@ class GlobalShardedEngine(ShardedEngine):
         gubernator.go:401-429). Returns per-row response arrays."""
         self._ensure_global_plane()
         n = hb.fp.shape[0]
-        owner = shard_of(hb.fp, self.n_shards)
-        is_owner_here = (owner == home) & hb.active
-
-        for i in range(n):
-            if not hb.active[i] or hb.hits[i] == 0:
-                continue  # zero-hit requests are never queued (global.go:85-95)
-            if is_owner_here[i]:
-                # owner-side hit: applied directly below; queue a broadcast of
-                # the updated status (QueueUpdate → runBroadcasts)
-                self._queue(hb, i, home, hits=0)
-            else:
-                self._queue(hb, i, home, hits=int(hb.hits[i]))
-                self.global_stats.hits_queued += 1
-        self.global_stats.send_queue_length = sum(len(p) for p in self.pending)
+        is_owner_here, queue, hb2, hb3 = self._global_fork(hb, home)
+        self._apply_queue(queue)
 
         status = np.zeros(n, dtype=np.int32)
         limit = np.zeros(n, dtype=np.int64)
         remaining = np.zeros(n, dtype=np.int64)
         reset = np.zeros(n, dtype=np.int64)
         dropped = np.zeros(n, dtype=bool)
-        # non-owner rows answer from the home replica: strip GLOBAL, force
-        # NO_BATCHING (reference gubernator.go:416-422)
-        hb2 = hb._replace(
-            behavior=(hb.behavior & ~np.int32(Behavior.GLOBAL))
-            | np.int32(Behavior.NO_BATCHING),
-            active=hb.active & ~is_owner_here,
-        )
         self._global_passes(hb2, status, limit, remaining, reset, dropped,
                             table_attr="replica", home=home)
         # owner rows run the authoritative path on the primary shard — with
         # the Store contract honored there (write-through + miss rehydrate,
         # like the reference's owner-side getLocalRateLimit)
-        hb3 = hb._replace(active=is_owner_here)
         self._global_passes(hb3, status, limit, remaining, reset, dropped,
                             table_attr="table", home=None, now=now)
         if self.store is not None and now is not None:
@@ -489,7 +719,7 @@ class GlobalShardedEngine(ShardedEngine):
         (global.go:125-151); a fixed one-round outbox would silently backlog
         hot global keys beyond `sync_out`."""
         self._sync_round(now_ms)
-        while any(self.pending):
+        while self.has_pending():
             self._sync_round(now_ms)
 
     def _sync_round(self, now_ms: Optional[int] = None) -> None:
@@ -499,19 +729,22 @@ class GlobalShardedEngine(ShardedEngine):
         OUT = self.sync_out
         boxes = []
         for d in range(self.n_shards):
-            entries = list(self.pending[d].items())[:OUT]
-            rows = [e[1]["row"] for e in entries]
-            if rows:
-                box = HostBatch(*[np.concatenate([r[k] for r in rows]) for k in range(len(rows[0]))])
+            k = min(len(self.pending[d]), OUT)
+            if k:
+                cfg, hits, reset = self.pending[d].take(OUT)
+                box = pad_batch(cfg, OUT)
+                box.hits[:k] = hits
+                box.behavior[:k] |= reset
+                box.created_at[:k] = now
             else:
-                box = HostBatch(*[np.zeros(0, dtype=f.dtype) for f in pack_requests([], now)[0]])
-            box = pad_batch(box, OUT)
-            for j, (fp, agg) in enumerate(entries):
-                box.hits[j] = agg["hits"]
-                box.behavior[j] |= agg["reset"]
-                box.created_at[j] = now
+                box = pad_batch(
+                    HostBatch(
+                        *[np.zeros(0, dtype=f.dtype)
+                          for f in pack_requests([], now)[0]]
+                    ),
+                    OUT,
+                )
             boxes.append(box)
-            self.pending[d] = dict(list(self.pending[d].items())[OUT:])
         stacked = HostBatch(*[np.stack([b[k] for b in boxes]) for k in range(len(boxes[0]))])
         dev_box = jax.tree.map(
             lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding), stacked

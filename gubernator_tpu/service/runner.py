@@ -57,9 +57,10 @@ class EngineRunner:
         write-through ordering and miss-rehydrates must serialize against
         every same-key dispatch, which interleaved pipelined chunks cannot
         guarantee — durability trades pipeline throughput. Engines may also
-        veto per batch via `can_pipeline(cols)` (the mesh-global engine
-        serializes batches containing GLOBAL rows, whose replica answers and
-        hit queueing live outside the prepare/issue/finish split)."""
+        veto per batch via `can_pipeline(cols)`; engines whose batches need
+        a custom split (the mesh-global engine's replica/owner fork) provide
+        their own pending type through the prepare_columns/issue_pending/
+        finish_pending hooks instead of vetoing."""
         can = getattr(self.engine, "can_pipeline", None)
         if (
             not getattr(self.engine, "supports_pipeline", False)
@@ -114,6 +115,12 @@ class EngineRunner:
                         time.perf_counter() - t0
                     )
                     self.metrics.observe_engine(self.engine.stats)
+                    # GLOBAL batches ride the pipeline too: without this the
+                    # queue-length gauge would only ever be observed post-
+                    # drain (sync_global) and read 0 forever
+                    gs = getattr(self.engine, "global_stats", None)
+                    if gs is not None:
+                        self.metrics.observe_global(gs)
 
             self._exec.submit(apply)  # fire-and-forget, engine thread
             return rc

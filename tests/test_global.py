@@ -150,3 +150,84 @@ def test_mixed_global_and_plain(mesh, frozen_now):
     assert out[0].remaining == 99
     assert out[1].remaining == 6
     assert out[2].remaining == 99
+
+
+def test_pipelined_hooks_match_serial_path(mesh, frozen_now):
+    """The prepare/issue/finish hooks (the pipelined front-door path for
+    GLOBAL batches — replaces round 4's can_pipeline veto) must produce the
+    same responses, queue state, and counters as the serial check_columns
+    on a twin engine, for a mixed GLOBAL + plain batch with duplicates."""
+    from gubernator_tpu.ops.batch import columns_from_requests
+    from gubernator_tpu.ops.engine import (
+        finish_check_columns,
+        issue_check_columns,
+        prepare_check_columns,
+    )
+
+    t = frozen_now
+    reqs = (
+        [greq(f"pk{i}", behavior=0, created_at=t) for i in range(4)]
+        + [greq(f"gk{i}", created_at=t) for i in range(6)]
+        + [greq("gk0", hits=2, created_at=t)]  # duplicate GLOBAL key
+        + [greq("pk0", behavior=0, created_at=t)]  # duplicate plain key
+    )
+    cols = columns_from_requests(reqs)
+
+    serial = GlobalShardedEngine(mesh, capacity_per_shard=1024, sync_out=64)
+    rc_serial = serial.check_columns(cols, now_ms=t)
+
+    piped = GlobalShardedEngine(mesh, capacity_per_shard=1024, sync_out=64)
+    pending = prepare_check_columns(piped, cols, now_ms=t)
+    from gubernator_tpu.parallel.global_sync import GlobalPending
+
+    assert isinstance(pending, GlobalPending)  # GLOBAL rows → custom pending
+    pending = issue_check_columns(piped, pending)
+    rc_piped, delta = finish_check_columns(piped, pending, lambda fn: fn())
+    piped.stats.merge(delta)
+
+    np.testing.assert_array_equal(rc_piped.status, rc_serial.status)
+    np.testing.assert_array_equal(rc_piped.remaining, rc_serial.remaining)
+    np.testing.assert_array_equal(rc_piped.reset_time, rc_serial.reset_time)
+    np.testing.assert_array_equal(rc_piped.err, rc_serial.err)
+
+    # queue state equal: same homes, same per-key accumulated hits
+    for ps, pp in zip(serial.pending, piped.pending):
+        assert len(ps) == len(pp)
+        if len(ps):
+            np.testing.assert_array_equal(
+                np.sort(ps.hb.fp), np.sort(pp.hb.fp)
+            )
+            order_s, order_p = np.argsort(ps.hb.fp), np.argsort(pp.hb.fp)
+            np.testing.assert_array_equal(
+                ps.hits[order_s], pp.hits[order_p]
+            )
+    assert serial.global_stats.hits_queued == piped.global_stats.hits_queued
+    assert serial.stats.cache_hits == piped.stats.cache_hits
+    assert serial.stats.cache_misses == piped.stats.cache_misses
+    assert serial.stats.checks == piped.stats.checks
+
+    # both sides reconcile identically at the next sync tick
+    serial.sync(now_ms=t)
+    piped.sync(now_ms=t)
+    assert (
+        serial.global_stats.broadcasts_applied
+        == piped.global_stats.broadcasts_applied
+    )
+    assert (
+        serial.global_stats.updates_installed
+        == piped.global_stats.updates_installed
+    )
+
+
+def test_pipelined_hooks_pure_local_falls_through(mesh, frozen_now):
+    """Batches without GLOBAL rows return None from prepare_columns and ride
+    the generic pipelined path."""
+    from gubernator_tpu.ops.batch import columns_from_requests
+    from gubernator_tpu.ops.engine import PendingCheck, prepare_check_columns
+
+    eng = GlobalShardedEngine(mesh, capacity_per_shard=1024)
+    cols = columns_from_requests(
+        [greq(f"k{i}", behavior=0, created_at=frozen_now) for i in range(4)]
+    )
+    pending = prepare_check_columns(eng, cols, now_ms=frozen_now)
+    assert isinstance(pending, PendingCheck)
