@@ -104,6 +104,12 @@ class BehaviorConfig:
     # concurrent device dispatches the front door keeps in flight (issue of
     # N+1 overlaps compute of N and fetch of N-1); 1 = the serial door
     pipeline_inflight: int = 4
+    # warm-up breadth: "" compiles only the 1-row shapes (fast spawn);
+    # "pow2" additionally compiles every pow2 coalesce shape up to
+    # coalesce_limit (token graph), "pow2-mixed" both math graphs — without
+    # this, the first request that produces a new coalesced batch geometry
+    # pays a multi-second XLA compile on the request path
+    warm_shapes: str = ""
 
     global_timeout_ms: float = 500.0  # GLOBAL rpc timeout (GlobalTimeout)
     global_sync_wait_ms: float = 100.0  # hit-sync cadence (GlobalSyncWait)
@@ -192,7 +198,13 @@ class DaemonConfig:
     graceful_termination_delay_s: float = 0.0
 
     log_level: str = "info"
+    # optional runtime metric collectors, comma-separated: "os" (process
+    # RSS/fds/CPU) and/or "python" (GC + platform; "golang" alias) —
+    # reference flags.go:19-57 FlagOSMetrics/FlagGolangMetrics
     metric_flags: str = ""
+    # bound gRPC connection lifetime so load balancers re-balance
+    # (reference GRPCMaxConnectionAgeSeconds, config.go:351; 0 = unbounded)
+    grpc_max_conn_age_s: float = 0.0
 
     def memberlist_keyring(self):
         """Decoded AES keyring from GUBER_MEMBERLIST_SECRET_KEYS — the ONE
@@ -326,6 +338,7 @@ def setup_daemon_config(
             batch_limit=_get_int(env, "GUBER_BATCH_LIMIT", 1000),
             coalesce_limit=_get_int(env, "GUBER_BATCH_COALESCE_LIMIT", 16384),
             pipeline_inflight=_get_int(env, "GUBER_PIPELINE_INFLIGHT", 4),
+            warm_shapes=_get(env, "GUBER_WARM_SHAPES", ""),
             global_timeout_ms=_get_float_ms(env, "GUBER_GLOBAL_TIMEOUT", 500.0),
             global_sync_wait_ms=_get_float_ms(env, "GUBER_GLOBAL_SYNC_WAIT", 100.0),
             global_batch_limit=_get_int(env, "GUBER_GLOBAL_BATCH_LIMIT", 1000),
@@ -372,6 +385,9 @@ def setup_daemon_config(
         / 1e3,
         log_level=_get(env, "GUBER_LOG_LEVEL", "info"),
         metric_flags=_get(env, "GUBER_METRIC_FLAGS", ""),
+        grpc_max_conn_age_s=float(
+            _get_int(env, "GUBER_GRPC_MAX_CONN_AGE_SEC", 0)
+        ),
     )
     # hostname convenience: GUBER_GRPC_ADDRESS=:1051 binds all interfaces but
     # advertises the hostname (reference net.go ResolveHostIP analog)

@@ -88,6 +88,11 @@ class EngineStats:
     checks: int = 0
     dispatches: int = 0
     created_at_clamped: int = 0  # client timestamps outside the skew tolerance
+    # rows that exhausted retries WITHOUT ever reaching the kernel (a2a
+    # exchange-capacity drops, parallel/a2a.py): they appear in no
+    # hit/miss/over counter, so without this the identity hits+misses ≈
+    # checks would drift silently under sustained hot-shard overflow
+    unprocessed_dropped: int = 0
 
     def accumulate(self, stats, count_dropped: bool = True) -> None:
         self.cache_hits += int(stats.cache_hits)
@@ -108,6 +113,7 @@ class EngineStats:
         self.checks += d.checks
         self.dispatches += d.dispatches
         self.created_at_clamped += d.created_at_clamped
+        self.unprocessed_dropped += d.unprocessed_dropped
 
 
 def serve_columns(engine, cols, now_ms, dispatch) -> ResponseColumns:

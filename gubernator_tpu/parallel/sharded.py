@@ -89,7 +89,10 @@ def make_sharded_decide(mesh: Mesh, math: str = "mixed"):
 
     spec = P(SHARD_AXIS)
     fn = jax.shard_map(
-        per_device, mesh=mesh, in_specs=(spec, spec), out_specs=(spec, spec)
+        per_device, mesh=mesh, in_specs=(spec, spec),
+        # check_vma=False: the Pallas sweep's out_shape carries no vma
+        # annotation, which the checker (jax>=0.9) rejects inside shard_map
+        out_specs=(spec, spec), check_vma=False
     )
     return jax.jit(fn, donate_argnums=(0,))
 
@@ -108,7 +111,10 @@ def make_sharded_install(mesh: Mesh):
 
     spec = P(SHARD_AXIS)
     fn = jax.shard_map(
-        per_device, mesh=mesh, in_specs=(spec, spec), out_specs=(spec, spec)
+        per_device, mesh=mesh, in_specs=(spec, spec),
+        # check_vma=False: the Pallas sweep's out_shape carries no vma
+        # annotation, which the checker (jax>=0.9) rejects inside shard_map
+        out_specs=(spec, spec), check_vma=False
     )
     return jax.jit(fn, donate_argnums=(0,))
 
@@ -451,10 +457,18 @@ class ShardedEngine:
         self.stats.evicted_unexpired += evicted
         if dropped.any() and depth < 3:
             rows = np.nonzero(dropped)[0]
+            sub_shard = shard[rows] if shard is not None else None
+            if sub_shard is None and self.route == "device" and depth == 2:
+                # FINAL retry falls back to host ownership routing: the
+                # reference never rejects a valid request on internal
+                # capacity, and the a2a exchange's bounded capacity must not
+                # either — the host grid has no capacity to exceed, so
+                # residual rows can only fail on (rare) claim contention
+                sub_shard = shard_of(batch.fp[rows], self.n_shards)
             _, (s2, l2, r2, t2, d2, h2) = self._dispatch(
                 _subset(batch, rows),
                 depth=depth + 1,
-                shard=shard[rows] if shard is not None else None,
+                shard=sub_shard,
                 table_attr=table_attr,
                 count=(count & unproc)[rows],
             )
@@ -466,8 +480,13 @@ class ShardedEngine:
             hit[rows] = h2
         elif dropped.any():
             # exhausted retries: decision was never persisted — callers
-            # surface ERR_NOT_PERSISTED per item instead of failing open
+            # surface ERR_NOT_PERSISTED per item instead of failing open.
+            # Rows that ALSO never reached a kernel (still FLAG_UNPROCESSED
+            # at terminal failure) are counted separately: they are absent
+            # from hits/misses/over, and this counter is what keeps that
+            # absence observable instead of silent drift
             self.stats.dropped += int(dropped.sum())
+            self.stats.unprocessed_dropped += int((dropped & unproc).sum())
         return np.arange(n), (status, limit, remaining, reset, dropped, hit)
 
 

@@ -89,7 +89,7 @@ class Daemon:
         # lags rather than stalling the serving path
         self.event_channel = event_channel
         self.events_dropped = 0
-        self.metrics = DaemonMetrics()
+        self.metrics = DaemonMetrics(metric_flags=conf.metric_flags)
         if engine is not None:
             self.engine = engine
             if store is not None:
@@ -343,6 +343,35 @@ class Daemon:
             duration=np.ones(1, dtype=np.int64),
             now_ms=1,
         )
+        # GUBER_WARM_SHAPES=pow2[-mixed]: additionally compile every pow2
+        # coalesce geometry up to the coalesce cap (like bench.py's e2e
+        # prewarm) so no production batch shape ever compiles on the
+        # request path; off by default — it multiplies spawn time by the
+        # shape count, which in-process test clusters cannot afford
+        mode = self.conf.behaviors.warm_shapes
+        if mode in ("pow2", "pow2-mixed"):
+            from gubernator_tpu.ops.engine import _pad_size
+
+            algos = [0] if mode == "pow2" else [0, 1]
+            size = 16
+            # up to the PADDED top shape: a non-pow2 coalesce_limit still
+            # pads saturated batches to the next pow2, which must be warm
+            top = _pad_size(int(self.conf.behaviors.coalesce_limit))
+            while size <= top:
+                for a in algos:
+                    warm = RequestColumns(
+                        fp=np.arange(1, size + 1, dtype=np.int64),
+                        algo=np.full(size, a, dtype=np.int32),
+                        behavior=np.zeros(size, dtype=np.int32),
+                        hits=np.zeros(size, dtype=np.int64),
+                        limit=np.ones(size, dtype=np.int64),
+                        burst=np.zeros(size, dtype=np.int64),
+                        duration=np.ones(size, dtype=np.int64),
+                        created_at=np.zeros(size, dtype=np.int64),
+                        err=np.zeros(size, dtype=np.int8),
+                    )
+                    await self.runner.check_columns(warm)
+                size *= 2
         # warm-up is not traffic: reset counters so tests and metrics see
         # only real requests
         from gubernator_tpu.ops.engine import EngineStats

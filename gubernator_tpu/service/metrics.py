@@ -10,6 +10,8 @@ in-process test cluster scrapes N independent endpoints.
 
 from __future__ import annotations
 
+import logging
+
 from prometheus_client import (
     CollectorRegistry,
     Counter,
@@ -22,11 +24,39 @@ from prometheus_client.parser import text_string_to_metric_families
 
 
 class DaemonMetrics:
-    """One daemon's metric family set (names mirror docs/prometheus.md)."""
+    """One daemon's metric family set (names mirror docs/prometheus.md).
 
-    def __init__(self) -> None:
+    `metric_flags` (GUBER_METRIC_FLAGS, comma-separated) opts into optional
+    runtime collectors, mirroring the reference's FlagOSMetrics /
+    FlagGolangMetrics (reference flags.go:19-57, daemon.go:293-306):
+      * "os"     → process collector (RSS/vsize, fds, CPU seconds, start
+                   time) under the gubernator namespace;
+      * "python" → interpreter runtime collectors (GC generations +
+                   platform info), the analog of the reference's Go
+                   collector ("golang" accepted as an alias).
+    Unknown flags are logged and ignored, like the reference's
+    getEnvMetricFlags."""
+
+    def __init__(self, metric_flags: str = "") -> None:
         self.registry = CollectorRegistry()
         r = self.registry
+        flags = {f.strip().lower() for f in metric_flags.split(",") if f.strip()}
+        for bad in sorted(flags - {"os", "python", "golang"}):
+            logging.getLogger("gubernator_tpu.metrics").error(
+                "invalid flag %r for GUBER_METRIC_FLAGS; valid options are "
+                "['os', 'python']", bad,
+            )
+        if "os" in flags:
+            from prometheus_client import process_collector
+
+            process_collector.ProcessCollector(
+                namespace="gubernator", registry=r
+            )
+        if flags & {"python", "golang"}:
+            from prometheus_client import gc_collector, platform_collector
+
+            gc_collector.GCCollector(registry=r)
+            platform_collector.PlatformCollector(registry=r)
         # --- request plane (grpc_stats.go:41-131 analog)
         self.grpc_request_counts = Counter(
             "gubernator_grpc_request_counts",
@@ -94,6 +124,13 @@ class DaemonMetrics:
         self.dropped_rows = Counter(
             "gubernator_tpu_dropped_rows_count",
             "Rows whose decision could not be persisted after retries",
+            registry=r,
+        )
+        self.unprocessed_dropped = Counter(
+            "gubernator_tpu_unprocessed_dropped_count",
+            "Rows that exhausted retries without ever reaching the decision "
+            "kernel (a2a exchange-capacity drops) — absent from hit/miss "
+            "counters by definition",
             registry=r,
         )
         # --- batching front door (gubernator.go:98-112 analog)
@@ -211,6 +248,9 @@ class DaemonMetrics:
         d_clamp = stats.created_at_clamped - last.get("clamped", 0)
         if d_clamp > 0:
             self.created_at_clamped.inc(d_clamp)
+        d_unproc = stats.unprocessed_dropped - last.get("unproc", 0)
+        if d_unproc > 0:
+            self.unprocessed_dropped.inc(d_unproc)
         self._last_engine = dict(
             hits=stats.cache_hits,
             misses=stats.cache_misses,
@@ -219,6 +259,7 @@ class DaemonMetrics:
             dropped=stats.dropped,
             disp=stats.dispatches,
             clamped=stats.created_at_clamped,
+            unproc=stats.unprocessed_dropped,
         )
 
     def observe_global(self, gs) -> None:

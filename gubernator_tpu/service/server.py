@@ -194,7 +194,19 @@ class HttpHandle:
 async def start_servers(daemon) -> None:
     """Bind + start the gRPC server and HTTP gateway; records actual ports on
     the daemon (port 0 supported for tests)."""
-    server = grpc.aio.server()
+    # transport limits mirroring the reference's server options
+    # (daemon.go:131-144): 1 MiB receive cap — a wire batch maxes out at
+    # MAX_BATCH_SIZE small messages, so anything bigger is abuse, not
+    # traffic — plus optional connection-age bounds for LB churn
+    # (GUBER_GRPC_MAX_CONN_AGE_SEC, config.go:351).
+    options = [("grpc.max_receive_message_length", 1024 * 1024)]
+    if daemon.conf.grpc_max_conn_age_s > 0:
+        age_ms = int(daemon.conf.grpc_max_conn_age_s * 1000)
+        options += [
+            ("grpc.max_connection_age_ms", age_ms),
+            ("grpc.max_connection_age_grace_ms", age_ms),
+        ]
+    server = grpc.aio.server(options=options)
     for h in build_grpc_services(daemon):
         server.add_generic_rpc_handlers((h,))
     creds = None

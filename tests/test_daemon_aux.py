@@ -631,3 +631,57 @@ async def test_coalesce_limit_caps_dispatch_size():
     assert len(sizes) >= 2  # really split
     await b.drain()
     runner.close()
+
+
+def test_metric_flags_collectors():
+    """GUBER_METRIC_FLAGS opts into process/runtime collector families
+    (reference flags.go:19-57 FlagOSMetrics/FlagGolangMetrics wired at
+    daemon.go:293-306) — the flag must actually grow /metrics, not just
+    parse."""
+    from gubernator_tpu.service.metrics import DaemonMetrics
+
+    base = DaemonMetrics().render().decode()
+    assert "process_open_fds" not in base
+    assert "python_gc_objects_collected" not in base
+
+    both = DaemonMetrics(metric_flags="os,python").render().decode()
+    assert "gubernator_process_open_fds" in both
+    assert "gubernator_process_resident_memory_bytes" in both
+    assert "python_gc_objects_collected_total" in both
+    assert "python_info" in both
+
+    # "golang" is accepted as an alias for the runtime collectors, and
+    # unknown flags are ignored (logged), matching getEnvMetricFlags
+    alias = DaemonMetrics(metric_flags="golang,bogus").render().decode()
+    assert "python_gc_objects_collected_total" in alias
+    assert "gubernator_process_open_fds" not in alias
+
+
+@async_test
+async def test_warm_shapes_pow2():
+    """GUBER_WARM_SHAPES=pow2 pre-compiles every pow2 coalesce geometry at
+    spawn so no production batch shape compiles on the request path; warm-up
+    traffic must not leak into stats, and real requests still serve."""
+    from gubernator_tpu.service.daemon import Daemon
+
+    conf = daemon_config()
+    conf.behaviors.warm_shapes = "pow2"
+    conf.behaviors.coalesce_limit = 64  # 16..64 → 3 shapes, keeps CI fast
+    d = await Daemon.spawn(conf)
+    client = V1Client(d.conf.grpc_address)
+    try:
+        assert d.engine.stats.checks == 0  # warm-up is not traffic
+        rs = await client.get_rate_limits(
+            [req(f"w{i}") for i in range(40)]  # coalesces into a pow2 shape
+        )
+        assert len(rs.responses) == 40
+        assert all(r.error == "" for r in rs.responses)
+        # the pipelined door applies the stats delta fire-and-forget on the
+        # engine thread AFTER replying — flush it before asserting
+        await asyncio.get_running_loop().run_in_executor(
+            d.runner._exec, lambda: None
+        )
+        assert d.engine.stats.checks == 40
+    finally:
+        await client.close()
+        await d.close()

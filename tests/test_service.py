@@ -367,3 +367,27 @@ async def test_daemon_close_leaves_no_running_tasks():
         and t is not asyncio.current_task()
     ]
     assert not leaked, [t.get_name() for t in leaked]
+
+
+@async_test
+async def test_oversize_message_rejected_by_transport():
+    """The public gRPC server caps receive size at 1 MiB (reference
+    daemon.go:133 MaxRecvMsgSize): a wire-legal batch inflated past the cap
+    must be refused at the transport with RESOURCE_EXHAUSTED, before any
+    handler work."""
+    import grpc
+
+    from gubernator_tpu.service.daemon import Daemon
+
+    d = await Daemon.spawn(daemon_config())
+    client = V1Client(d.conf.grpc_address)
+    try:
+        big = "x" * 1500
+        with pytest.raises(grpc.aio.AioRpcError) as e:
+            await client.get_rate_limits(
+                [req(f"{big}{i}") for i in range(1000)]
+            )
+        assert e.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+    finally:
+        await client.close()
+        await d.close()
