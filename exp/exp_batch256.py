@@ -1,0 +1,24 @@
+"""Does a 256K-row dispatch beat 2x 131K dispatches at headline scale?
+(Halves the per-dispatch fixed costs' share — probe sorts, DMA floor.)
+Device-loop timing (RTT-immune), 2^24-slot table, 10M live keys."""
+import sys, time
+import numpy as np
+import gubernator_tpu  # noqa
+import jax
+from bench import Case, make_req_batch
+
+def log(m): print(m, file=sys.stderr, flush=True)
+
+rng = np.random.default_rng(42)
+now = int(time.time() * 1000)
+log(f"device: {jax.devices()[0]}")
+CAP, LIVE = 1 << 24, 10_000_000
+keyspace = rng.integers(1, (1 << 63) - 1, size=LIVE, dtype=np.int64)
+perm = rng.permutation(LIVE)
+for BATCH in (1 << 18, 1 << 19):
+    nb = min(8, LIVE // BATCH)
+    batches = [jax.device_put(make_req_batch(keyspace[perm[i*BATCH:(i+1)*BATCH]], now)) for i in range(nb)]
+    c = Case(f"loop-{BATCH//1024}K", CAP, batches, math="token")
+    res = c.run(dispatches=8, latency_probes=2)
+    log(f"RESULT {BATCH}: {res.get('device_decisions_per_sec')} dec/s, {res.get('device_ms')} ms/dispatch")
+    del c, batches

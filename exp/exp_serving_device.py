@@ -1,0 +1,25 @@
+"""Device-only compute time at SERVING dispatch shapes (16K-row coalesced
+batches) for the README's co-located p99 budget: 128 MiB table (1M keys)
+and 1 GiB table (10M keys). Device-loop timing — RTT-immune."""
+import sys, time
+import numpy as np
+import gubernator_tpu  # noqa
+import jax
+from bench import Case, make_req_batch
+
+def log(m): print(m, file=sys.stderr, flush=True)
+
+rng = np.random.default_rng(42)
+now = int(time.time() * 1000)
+log(f"device: {jax.devices()[0]}")
+BATCH = 1 << 14
+for cap, live, tag in ((1 << 21, 1_000_000, "128MiB-1M"), ((1 << 24), 10_000_000, "1GiB-10M")):
+    keyspace = rng.integers(1, (1 << 63) - 1, size=live, dtype=np.int64)
+    perm = rng.permutation(live)
+    nb = 8
+    batches = [jax.device_put(make_req_batch(keyspace[perm[i*BATCH:(i+1)*BATCH]], now)) for i in range(nb)]
+    seed = [jax.device_put(make_req_batch(keyspace[i*BATCH:(i+1)*BATCH], now)) for i in range(live // BATCH)]
+    c = Case(f"serve-{tag}", cap, batches, seed_batches=seed, math="token")
+    res = c.run(dispatches=8, latency_probes=2)
+    log(f"RESULT {tag}: device_ms={res.get('device_ms')} dec/s={res.get('device_decisions_per_sec')}")
+    del c, batches, seed

@@ -1,0 +1,22 @@
+"""Device compute at 4K/8K-row serving dispatches, 128 MiB table (1M keys):
+the coalesce_limit operating points for the p99<2ms co-located budget."""
+import sys, time
+import numpy as np
+import gubernator_tpu  # noqa
+import jax
+from bench import Case, make_req_batch
+
+def log(m): print(m, file=sys.stderr, flush=True)
+rng = np.random.default_rng(42)
+now = int(time.time() * 1000)
+log(f"device: {jax.devices()[0]}")
+cap, live = 1 << 21, 1_000_000
+keyspace = rng.integers(1, (1 << 63) - 1, size=live, dtype=np.int64)
+perm = rng.permutation(live)
+for BATCH in (1 << 12, 1 << 13):
+    batches = [jax.device_put(make_req_batch(keyspace[perm[i*BATCH:(i+1)*BATCH]], now)) for i in range(8)]
+    seed = [jax.device_put(make_req_batch(keyspace[i*BATCH:(i+1)*BATCH], now)) for i in range(live // BATCH)]
+    c = Case(f"serve-{BATCH}", cap, batches, seed_batches=seed, math="token")
+    res = c.run(dispatches=8, latency_probes=2)
+    log(f"RESULT {BATCH}: device_ms={res.get('device_ms')} dec/s={res.get('device_decisions_per_sec')}")
+    del c, batches, seed

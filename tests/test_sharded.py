@@ -202,7 +202,6 @@ def test_device_route_terminal_unprocessed_counted(mesh, frozen_now):
     terminal depth disables both the retries and the host fallback, so
     capacity drops surface immediately."""
     from gubernator_tpu.ops.batch import fingerprint_columns, pack_requests
-    from gubernator_tpu.ops.engine import ERR_NOT_PERSISTED
 
     t = frozen_now
     eng = ShardedEngine(mesh, capacity_per_shard=4096, route="device")
