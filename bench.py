@@ -735,9 +735,9 @@ def e2e_serving_case() -> dict:
         t0 = time.perf_counter()
         deadline = t0 + SECONDS
         await asyncio.gather(*(worker(c, reqs) for c in range(CLIENTS)))
-        elapsed = time.perf_counter() - t0
+        distinct_elapsed = time.perf_counter() - t0
         distinct_lat = list(lat)
-        distinct_count, distinct_elapsed = counts[0], elapsed
+        distinct_count = counts[0]
         # scrape the per-stage breakdown NOW, before herd traffic pollutes
         # the cumulative stage_duration summaries — these means must explain
         # the distinct-phase latency figures they are reported next to
@@ -755,7 +755,6 @@ def e2e_serving_case() -> dict:
         await asyncio.gather(*(worker(c, hot_reqs) for c in range(CLIENTS)))
         hot_elapsed = time.perf_counter() - t0
         hot_count = counts[0]
-        lat, counts[0], elapsed = distinct_lat, distinct_count, distinct_elapsed
         # per-stage pipeline breakdown (mean ms) from the distinct-phase
         # scrape — where a request's time actually goes
         stages = {}
@@ -767,9 +766,9 @@ def e2e_serving_case() -> dict:
                 stages[st] = round(tot / cnt * 1e3, 3)
         await client.close()
         await d.close()
-        arr = np.asarray(sorted(lat)) * 1e3
+        arr = np.asarray(sorted(distinct_lat)) * 1e3
         hot_cps = round(hot_count / hot_elapsed, 1)
-        dis_cps = round(counts[0] / elapsed, 1)
+        dis_cps = round(distinct_count / distinct_elapsed, 1)
         return {
             "checks_per_sec": dis_cps,
             "clients": CLIENTS,

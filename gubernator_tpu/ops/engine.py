@@ -18,6 +18,7 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 from gubernator_tpu.ops.batch import (
     ERR_DROPPED,
@@ -247,9 +248,10 @@ class PendingCheck:
     fetch thread by `finish_check_columns` — the split that lets host pack +
     transfer of dispatch N+1 overlap device execution and fetch of N."""
 
-    __slots__ = ("hb", "err", "now", "passes", "clamped")
+    __slots__ = ("hb", "err", "now", "passes", "clamped", "stacked")
 
     def __init__(self, hb, err, now, passes, clamped):
+        self.stacked = None  # same-shape pass outputs fused for ONE fetch
         self.hb = hb
         self.err = err
         self.now = now
@@ -296,7 +298,41 @@ def issue_check_columns(engine, pending: PendingCheck) -> PendingCheck:
     for entry in pending.passes:
         _p, _n, batch, staged = entry
         entry[3] = engine.issue_staged(staged, int(batch.fp.shape[0]))
+    pending.stacked = _stack_pass_outputs(
+        [_pending_out(entry[3]) for entry in pending.passes]
+    )
     return pending
+
+
+# Per-pass pending handles differ by engine: LocalEngine issues a bare
+# output array, ShardedEngine a (staged, out) tuple. These two helpers are
+# the only place that distinction exists.
+def _pending_out(pend):
+    return pend[1] if isinstance(pend, tuple) else pend
+
+
+def _pending_with_out(pend, out):
+    return (pend[0], out) if isinstance(pend, tuple) else out
+
+
+# one extra launch that turns N per-pass output fetches into ONE — on
+# platforms where every device->host fetch is a serialized round trip (the
+# tunneled dev TPU: ~100 ms each), a multi-pass batch (hot-key herds plan up
+# to max_exact sequential passes) otherwise pays N round trips per request
+_stack_outs = jax.jit(lambda xs: jnp.stack(xs))
+
+
+def _stack_pass_outputs(outs):
+    """Fuse same-shape pass outputs into one stacked device array (None when
+    there is nothing to fuse or shapes differ — hot-key herds produce
+    uniformly tiny passes, the case that matters; mixed-shape pass lists
+    would compile a new stack per combination, so they stay per-pass)."""
+    if len(outs) < 2:
+        return None
+    shape = getattr(outs[0], "shape", None)
+    if shape is None or any(getattr(o, "shape", None) != shape for o in outs[1:]):
+        return None
+    return _stack_outs(tuple(outs))
 
 
 def finish_check_columns(
@@ -313,6 +349,12 @@ def finish_check_columns(
     pipeline with interleaved chunks cannot guarantee."""
     if not isinstance(pending, PendingCheck):  # engine-specific pending
         return engine.finish_pending(pending, fixup)
+    if pending.stacked is not None:
+        # ONE fetch materializes every pass's output; hand each pass its
+        # already-fetched slice (finish_staged's np.asarray is then a no-op)
+        fetched = np.asarray(pending.stacked)
+        for i, entry in enumerate(pending.passes):
+            entry[3] = _pending_with_out(entry[3], fetched[i])
     hb, err, now = pending.hb, pending.err, pending.now
     n = hb.fp.shape[0]
     status = np.zeros(n, dtype=np.int32)

@@ -165,6 +165,7 @@ class GlobalPending:
     # [Pass, n_rows, batch, staged→(staged, out), table_attr, home_pin, rowmap]
     passes: list
     clamped: int
+    stacked: object = None  # same-shape pass outputs fused for ONE fetch
 
 
 @dataclass
@@ -548,6 +549,8 @@ class GlobalShardedEngine(ShardedEngine):
     def issue_pending(self, pending: "GlobalPending") -> "GlobalPending":
         """Issue hook (engine thread): fold the queued hits into the sync
         accumulator, then launch every staged dispatch without fetching."""
+        from gubernator_tpu.ops.engine import _stack_pass_outputs
+
         self._ensure_global_plane()
         self._apply_queue(pending.queue)
         for entry in pending.passes:
@@ -555,6 +558,9 @@ class GlobalShardedEngine(ShardedEngine):
             table, out = self._decide(getattr(self, table_attr), staged)
             setattr(self, table_attr, table)
             entry[3] = (staged, out)
+        pending.stacked = _stack_pass_outputs(
+            [entry[3][1] for entry in pending.passes]
+        )
         return pending
 
     def finish_pending(self, pending: "GlobalPending", fixup):
@@ -563,6 +569,11 @@ class GlobalShardedEngine(ShardedEngine):
         thread via `fixup` against the same table (replica pins preserved)."""
         from gubernator_tpu.ops.engine import EngineStats
 
+        if pending.stacked is not None:
+            # ONE fetch for every pass's output (cf. finish_check_columns)
+            fetched = np.asarray(pending.stacked)
+            for i, entry in enumerate(pending.passes):
+                entry[3] = (entry[3][0], fetched[i])
         hb, err = pending.hb, pending.err
         n = hb.fp.shape[0]
         status = np.zeros(n, dtype=np.int32)

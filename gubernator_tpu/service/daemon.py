@@ -372,6 +372,27 @@ class Daemon:
                     )
                     await self.runner.check_columns(warm)
                 size *= 2
+            # herd geometries: a same-key batch plans j sequential passes
+            # (j ≤ max_exact) whose same-shape outputs fuse into one
+            # stacked fetch (ops/engine._stack_pass_outputs) — trace the
+            # stack kernel for every pass count now, or the first
+            # production herd pays that compile on the request path
+            max_exact = getattr(self.engine, "max_exact_passes", 8)
+            for j in range(2, max_exact + 1):
+                warm = RequestColumns(
+                    fp=np.full(j, 7, dtype=np.int64),
+                    algo=np.zeros(j, dtype=np.int32),
+                    behavior=np.zeros(j, dtype=np.int32),
+                    hits=np.zeros(j, dtype=np.int64),
+                    limit=np.ones(j, dtype=np.int64),
+                    burst=np.zeros(j, dtype=np.int64),
+                    duration=np.ones(j, dtype=np.int64),
+                    created_at=np.zeros(j, dtype=np.int64),
+                    err=np.zeros(j, dtype=np.int8),
+                )
+                # through the PIPELINED door: the stack kernel only traces
+                # on the issue path (serial check_columns never stacks)
+                await self.runner.check(warm)
         # warm-up is not traffic: reset counters so tests and metrics see
         # only real requests
         from gubernator_tpu.ops.engine import EngineStats

@@ -256,3 +256,39 @@ def test_sharded_pipeline_matches_serial(mesh, frozen_now):
         np.testing.assert_array_equal(got.err, want.err)
     assert piped.stats.cache_hits == serial.stats.cache_hits
     assert piped.stats.cache_misses == serial.stats.cache_misses
+
+
+def test_pipelined_multi_pass_single_fetch(mesh, frozen_now):
+    """A hot-key batch plans max_exact same-shape passes; the pipelined path
+    must fuse their outputs into ONE stacked fetch (pending.stacked) and
+    still produce responses identical to the serial path — on the tunneled
+    platform each fetch is a serialized round trip, so without the fuse a
+    herd request pays max_exact round trips."""
+    from gubernator_tpu.ops.batch import columns_from_requests
+    from gubernator_tpu.ops.engine import (
+        finish_check_columns,
+        issue_check_columns,
+        prepare_check_columns,
+    )
+
+    t = frozen_now
+    reqs = [req("herd", hits=1, limit=1 << 20, created_at=t) for _ in range(64)]
+    cols = columns_from_requests(reqs)
+
+    serial = ShardedEngine(mesh, capacity_per_shard=2048)
+    rc_serial = serial.check_columns(cols, now_ms=t)
+
+    piped = ShardedEngine(mesh, capacity_per_shard=2048)
+    pending = prepare_check_columns(piped, cols, now_ms=t)
+    assert len(pending.passes) > 1  # herd → multiple sequential passes
+    pending = issue_check_columns(piped, pending)
+    assert pending.stacked is not None  # same-shape passes fused
+    rc_piped, delta = finish_check_columns(piped, pending, lambda fn: fn())
+    piped.stats.merge(delta)
+
+    np.testing.assert_array_equal(rc_piped.status, rc_serial.status)
+    np.testing.assert_array_equal(rc_piped.remaining, rc_serial.remaining)
+    np.testing.assert_array_equal(rc_piped.err, rc_serial.err)
+    assert serial.stats.cache_hits == piped.stats.cache_hits
+    assert serial.stats.cache_misses == piped.stats.cache_misses
+    np.testing.assert_array_equal(serial.snapshot(), piped.snapshot())
