@@ -364,8 +364,12 @@ def config2_case(rng, now) -> Case:
         )
         for i in range(LIVE // BATCH)
     ] + batches
-    return Case("config2-leaky-1M-zipf", 1 << 21, batches, seed_batches=seed,
-                math="mixed", active_counts=active_counts)
+    c = Case("config2-leaky-1M-zipf", 1 << 21, batches, seed_batches=seed,
+             math="mixed", active_counts=active_counts)
+    # each dispatch's ~30K unique keys answer BATCH client rows (Zipf
+    # duplicates aggregated host-side) → client_decisions_per_sec scaling
+    c.logical_batch = BATCH
+    return c
 
 
 def config4_case(rng, now) -> Case:
