@@ -180,6 +180,13 @@ class Case:
             return {"device_invalid": str(exc)}
         log(f"[{self.name}] device-loop compile: {time.perf_counter() - t0:.1f}s")
 
+        # dt acceptance floor for the PRIMARY rate, above the guard default:
+        # small-batch cases otherwise accept windows barely past the floor,
+        # where +-30 ms launch jitter still moves the rate 2x between runs.
+        # The retry target and window cap derive from it so the adaptive
+        # loop can always reach an acceptable window.
+        MIN_DT = 0.15
+        K_CAP = 65536  # at the smallest case (~60 us/iter) dt reaches ~4s
         k_short, k_long = 4, 68
         for attempt in range(5):
             try:
@@ -189,7 +196,7 @@ class Case:
                 log(f"[{self.name}] device loop invalid: {exc}")
                 return {"device_invalid": str(exc)}
             rows_eff = (expected(k_long) - expected(k_short)) / (k_long - k_short)
-            s = slope(t_short, t_long, k_short, k_long, rows_eff)
+            s = slope(t_short, t_long, k_short, k_long, rows_eff, min_dt=MIN_DT)
             if s.reason is None:
                 log(
                     f"[{self.name}] device loop: {k_long - k_short} x "
@@ -206,10 +213,10 @@ class Case:
             dt = t_long - t_short
             if dt > 0.02:
                 per_iter = dt / (k_long - k_short)
-                need_dt = max(0.06, 0.6 * t_short)
-                k_long = k_short + min(4096, int(need_dt / per_iter) + 1)
+                need_dt = max(1.2 * MIN_DT, 0.8 * t_short)
+                k_long = k_short + min(K_CAP, int(need_dt / per_iter) + 1)
             else:
-                k_long = k_short + min(4096, 2 * (k_long - k_short))
+                k_long = k_short + min(K_CAP, 2 * (k_long - k_short))
             log(f"[{self.name}] device loop rejected ({s.reason}); retry "
                 f"k_long={k_long}")
         return {"device_invalid": s.reason}
