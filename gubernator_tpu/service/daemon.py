@@ -393,10 +393,28 @@ class Daemon:
                 # through the PIPELINED door: the stack kernel only traces
                 # on the issue path (serial check_columns never stacks)
                 await self.runner.check(warm)
+            if (
+                getattr(self.engine, "mesh_global", False)
+                and self.engine.store is None
+            ):
+                # pre-trace the collective sync steps (single + fused R
+                # variants) so the first deep GLOBAL backlog can't compile
+                # on the engine thread mid-tick
+                await asyncio.get_running_loop().run_in_executor(
+                    self.runner._exec, self.engine.warm_sync_steps
+                )
+                from gubernator_tpu.parallel.global_sync import GlobalStats
+
+                self.engine.global_stats = GlobalStats()
         # warm-up is not traffic: reset counters so tests and metrics see
-        # only real requests
+        # only real requests. The pipelined warms above apply their stats
+        # deltas fire-and-forget on the engine executor — flush it first or
+        # a late apply lands AFTER the reset and resurrects warm-up counts
         from gubernator_tpu.ops.engine import EngineStats
 
+        await asyncio.get_running_loop().run_in_executor(
+            self.runner._exec, lambda: None
+        )
         self.engine.stats = EngineStats()
         self.metrics._last_engine = None
 

@@ -231,3 +231,83 @@ def test_pipelined_hooks_pure_local_falls_through(mesh, frozen_now):
     )
     pending = prepare_check_columns(eng, cols, now_ms=frozen_now)
     assert isinstance(pending, PendingCheck)
+
+
+def test_fused_sync_drain_matches_serial_rounds(mesh, frozen_now):
+    """A deep backlog drains through the fused multi-round step (ONE launch
+    runs R rounds on-device); tables, replica state, and reconcile counters
+    must match an identical engine drained round-by-round."""
+    import jax.numpy as jnp
+
+    from gubernator_tpu.ops.batch import columns_from_requests
+
+    t = frozen_now
+
+    def load(eng):
+        # queue 3x sync_out entries per round-robin home → multi-round drain
+        for batch in range(3):
+            reqs = [
+                greq(f"fk{batch}_{i}", hits=2, created_at=t) for i in range(64)
+            ]
+            eng.check_columns(columns_from_requests(reqs), now_ms=t)
+
+    serial = GlobalShardedEngine(mesh, capacity_per_shard=1024, sync_out=16)
+    fused = GlobalShardedEngine(mesh, capacity_per_shard=1024, sync_out=16)
+    load(serial)
+    load(fused)
+    assert serial.global_stats.send_queue_length == \
+        fused.global_stats.send_queue_length > 16
+
+    # serial: force round-by-round; fused: the sync() fast path
+    while serial.has_pending():
+        serial._sync_round(now_ms=t)
+    fused.sync(now_ms=t)
+
+    assert not fused.has_pending()
+    # padded no-op rounds are excluded from the counter: identical traffic
+    # reports identical sync_rounds whichever drain path ran
+    assert serial.global_stats.sync_rounds == fused.global_stats.sync_rounds
+    assert (
+        serial.global_stats.broadcasts_applied
+        == fused.global_stats.broadcasts_applied
+    )
+    assert (
+        serial.global_stats.updates_installed
+        == fused.global_stats.updates_installed
+    )
+    assert bool(jnp.array_equal(serial.table.rows, fused.table.rows))
+    assert bool(jnp.array_equal(serial.replica.rows, fused.replica.rows))
+
+    # post-drain responses agree from any home (replica-served reads)
+    probe = [greq("fk1_3", hits=0, created_at=t)]
+    for home in range(8):
+        (a,) = serial.check(probe, now_ms=t, home_shard=home)
+        (b,) = fused.check(probe, now_ms=t, home_shard=home)
+        assert (a.status, a.remaining) == (b.status, b.remaining)
+
+
+def test_warm_sync_steps_pretraces_fused_variants(mesh, frozen_now):
+    """warm_sync_steps compiles the single-round + every fused-R sync step
+    with empty no-op outboxes, leaving state and counters untouched after
+    the caller's reset — the first deep backlog must not compile on the
+    serving path."""
+    from gubernator_tpu.parallel.global_sync import GlobalStats
+
+    eng = GlobalShardedEngine(mesh, capacity_per_shard=1024, sync_out=16)
+    eng.warm_sync_steps(now_ms=frozen_now)
+    assert sorted(eng._sync_multi) == [2, 4, 8, 16, 32, 64]
+    eng.global_stats = GlobalStats()
+
+    # a warm engine still reconciles correctly (state untouched by no-ops)
+    key = "wk1"
+    home = non_owner_of(key)
+    for _ in range(3):
+        eng.check([greq(key, created_at=frozen_now)], now_ms=frozen_now,
+                  home_shard=home)
+    eng.sync(now_ms=frozen_now)
+    assert eng.global_stats.broadcasts_applied == 1
+    (r,) = eng.check(
+        [greq(key, hits=0, created_at=frozen_now)], now_ms=frozen_now,
+        home_shard=owner_of(key),
+    )
+    assert r.remaining == 97
