@@ -311,3 +311,39 @@ def test_warm_sync_steps_pretraces_fused_variants(mesh, frozen_now):
         home_shard=owner_of(key),
     )
     assert r.remaining == 97
+
+
+def test_store_engine_sync_stays_serial(mesh, frozen_now):
+    """Store-configured engines must drain round-by-round: the fused step
+    returns no per-round bc, and the Store write-through depends on it —
+    every reconciled entry must reach on_change even on a deep backlog."""
+    from gubernator_tpu.ops.batch import columns_from_requests
+    from gubernator_tpu.store import RecordingStore
+
+    t = frozen_now
+    store = RecordingStore()
+    eng = GlobalShardedEngine(
+        mesh, capacity_per_shard=1024, sync_out=16, store=store
+    )
+    # queue a backlog deeper than one round per home
+    for batch in range(3):
+        reqs = [greq(f"sk{batch}_{i}", hits=1, created_at=t) for i in range(64)]
+        eng.check_columns(columns_from_requests(reqs), now_ms=t)
+    # the fused-vs-serial choice keys on PER-HOME depth, not the global sum
+    assert max(len(p) for p in eng.pending) > eng.sync_out
+    # check-time deliveries (owner-here rows write through immediately,
+    # like the reference's owner-side getLocalRateLimit OnChange)
+    n_check = sum(len(ch.fps) for ch in store.changes)
+    eng.sync(now_ms=t)
+    assert not eng.has_pending()
+    assert not eng._sync_multi  # fused variants never built
+    # the sync drain delivers every reconciled entry EXACTLY once via the
+    # per-round bc — the raw count catches double deliveries the set alone
+    # would hide (owner-here keys legitimately appear a second time: their
+    # check-time apply was its own state change)
+    synced_fps = [
+        fp for ch in store.changes for fp in np.asarray(ch.fps).tolist()
+    ][n_check:]
+    assert len(synced_fps) == 192
+    assert len(set(synced_fps)) == 192
+    assert store.touched_fps >= set(synced_fps)
