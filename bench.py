@@ -809,33 +809,48 @@ def e2e_serving_case() -> dict:
     return out
 
 
+def _attempt(label: str, fn, attempts: int = 2) -> dict:
+    """Run one bench case, retrying ONCE on failure: the tunneled platform
+    throws transient infra errors (observed: a remote_compile response cut
+    mid-body killed a whole headline), and the driver records exactly one
+    run — a one-shot transient must not zero the record. The thunk rebuilds
+    its case from scratch, so a retry never reuses state poisoned by a
+    failed donated computation."""
+    err = ""
+    for a in range(attempts):
+        try:
+            return fn()
+        except Exception as exc:  # the record must print regardless
+            # keep only the MESSAGE: holding the exception would pin its
+            # traceback (and through it the failed case's device buffers)
+            # alive across the retry — fatal when the retry needs the HBM
+            # the first attempt was supposed to release
+            err = f"{type(exc).__name__}: {exc}"
+            log(f"[{label}] FAILED (attempt {a + 1}/{attempts}): {err}")
+    return {"error": err[:200]}
+
+
 def main() -> None:
     dev = jax.devices()[0]
     log(f"device: {dev}  write mode: {WRITE}")
     now = int(time.time() * 1000)
-    rng = np.random.default_rng(42)
 
-    parity_ok = sweep_parity_smoke(rng, now)
+    # each case draws from its OWN deterministic generator: a retried case
+    # (transient tunnel failure) must not shift the entropy every later
+    # case sees, or the published matrix stops being comparable run-to-run
+    parity_ok = sweep_parity_smoke(np.random.default_rng(41), now)
 
-    try:
-        headline = headline_case(rng, now).run()
-    except Exception as exc:  # the record must print even on a dead headline
-        log(f"[headline-10M] FAILED: {type(exc).__name__}: {exc}")
-        headline = {"error": str(exc)[:200]}
+    headline = _attempt(
+        "headline-10M",
+        lambda: headline_case(np.random.default_rng(42), now).run(),
+    )
     matrix = {"parity_sweep_vs_xla": parity_ok}
-    try:
-        matrix["e2e-serving"] = e2e_serving_case()
-    except Exception as exc:  # the serving bench must never sink the headline
-        log(f"[e2e-serving] FAILED: {type(exc).__name__}: {exc}")
-        matrix["e2e-serving"] = {"error": str(exc)[:200]}
-    for builder in (config1_case, config2_case, config4_case):
-        case = builder(rng, now)
-        try:
-            res = case.run(dispatches=24, latency_probes=12)
-        except Exception as exc:  # one dead case must not sink the record
-            log(f"[{case.name}] FAILED: {type(exc).__name__}: {exc}")
-            matrix[case.name] = {"error": str(exc)[:200]}
-            continue
+    matrix["e2e-serving"] = _attempt("e2e-serving", e2e_serving_case)
+
+    def run_config(builder, name, seed):
+        case = builder(np.random.default_rng(seed), now)
+        assert case.name == name, (case.name, name)  # key-drift tripwire
+        res = case.run(dispatches=24, latency_probes=12)
         if hasattr(case, "logical_batch") and "device_decisions_per_sec" in res:
             # throughput in *client decisions* (pre-aggregation) per second:
             # each dispatch's ~active unique keys answer logical_batch
@@ -847,25 +862,33 @@ def main() -> None:
             res["client_decisions_per_sec"] = round(
                 res["device_decisions_per_sec"] * scale, 1
             )
-        matrix[case.name] = res
+        return res
 
-    try:
-        matrix["config3-global"] = config3_global_case(rng, now)
-    except Exception as exc:
-        log(f"[config3-global] FAILED: {type(exc).__name__}: {exc}")
-        matrix["config3-global"] = {"error": str(exc)[:200]}
+    configs = [
+        (config1_case, "config1-token-1K", 43),
+        (config2_case, "config2-leaky-1M-zipf", 44),
+        (config4_case, "config4-mixed-flags-1M", 45),
+    ]
+    for builder, name, seed in configs:
+        matrix[name] = _attempt(
+            name, lambda b=builder, n=name, s=seed: run_config(b, n, s)
+        )
+
+    matrix["config3-global"] = _attempt(
+        "config3-global",
+        lambda: config3_global_case(np.random.default_rng(46), now),
+    )
 
     if jax.default_backend() == "tpu":
         # BASELINE #5 scale needs the real chip's HBM (8 GiB table); runs
         # last so every other case's memory is already released, and must
         # never sink the headline
-        try:
-            case = config5_case(rng, now)
-            matrix[case.name] = case.run(dispatches=24, latency_probes=6)
-            del case
-        except Exception as exc:
-            log(f"[config5-100M] FAILED: {type(exc).__name__}: {exc}")
-            matrix["config5-100M"] = {"error": str(exc)[:200]}
+        matrix["config5-100M"] = _attempt(
+            "config5-100M",
+            lambda: config5_case(np.random.default_rng(47), now).run(
+                dispatches=24, latency_probes=6
+            ),
+        )
 
     # headline = on-device loop rate (chip compute, RTT-immune); the host
     # serving slope is never promoted to the headline — if the device loop
