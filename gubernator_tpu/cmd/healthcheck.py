@@ -27,12 +27,17 @@ def check(
     ca_file: str = "",
     cert_file: str = "",
     key_file: str = "",
+    strict: bool = False,
 ) -> None:
     """Raises NotHealthy if the daemon reports unhealthy, URLError and friends
-    on transport failure; returns on success. With TLS, probe over https
-    trusting `ca_file`; `cert_file`/`key_file` present a client certificate
-    so the probe also works against an mTLS gateway when no status listener
-    is configured."""
+    on transport failure; returns on success. A "degraded" status (peer
+    errors / open circuit breakers — the instance still serves every
+    request, see docs/robustness.md) passes unless `strict`: restarting a
+    pod because its PEERS are unreachable only amplifies a partition. With
+    TLS, probe over https trusting `ca_file`; `cert_file`/`key_file` present
+    a client certificate so the probe also works against an mTLS gateway
+    when no status listener is configured."""
+    ok = ("healthy",) if strict else ("healthy", "degraded")
     ctx = None
     if scheme == "https":
         ctx = ssl.create_default_context(cafile=ca_file or None)
@@ -53,7 +58,7 @@ def check(
             if i < attempts - 1:
                 time.sleep(delay_s)
             continue
-        if hc.get("status") != "healthy":
+        if hc.get("status") not in ok:
             last = NotHealthy(
                 f"not healthy: status={hc.get('status')!r} "
                 f"message={hc.get('message')!r} peer_count={hc.get('peer_count')} "
@@ -62,6 +67,10 @@ def check(
             if i < attempts - 1:
                 time.sleep(delay_s)
             continue
+        if hc.get("status") == "degraded":
+            print(
+                f"degraded (passing): message={hc.get('message')!r}", file=out
+            )
         return
     raise last
 
@@ -97,6 +106,7 @@ def main(argv=None) -> int:
         check(
             url, attempts, scheme=scheme, ca_file=ca_file,
             cert_file=cert_file, key_file=key_file,
+            strict=_get_bool(os.environ, "GUBER_HEALTHCHECK_STRICT", False),
         )
     except NotHealthy as exc:
         print(exc)

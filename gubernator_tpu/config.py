@@ -9,6 +9,7 @@ reference's (reference config.go:137-158) where a counterpart exists.
 
 from __future__ import annotations
 
+import enum
 import os
 import random
 import re
@@ -16,6 +17,21 @@ import socket
 import string
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
+
+
+class DegradationPolicy(str, enum.Enum):
+    """What a non-owner answers when the owner is unreachable (breaker open
+    or forward retries exhausted) — see docs/robustness.md.
+
+    ERROR: today's reference-compatible behavior — the item carries an
+    "Error while fetching rate limit from peer: ..." response.
+    LOCAL: best-effort local check against this daemon's own store; the
+    response is real (non-error) but marked via metadata["degraded"]="true"
+    so clients know it may not reflect the owner's authoritative state.
+    """
+
+    ERROR = "error"
+    LOCAL = "local"
 
 
 class ConfigError(ValueError):
@@ -117,6 +133,25 @@ class BehaviorConfig:
     global_peer_concurrency: int = 100  # GlobalPeerRequestsConcurrency
 
     force_global: bool = False  # reference config.go:65-66
+
+    # --- peer fault tolerance (docs/robustness.md) -------------------------
+    # consecutive RPC failures toward one peer that trip its breaker OPEN
+    peer_breaker_errors: int = 5
+    # jittered-exponential open-state cooldown: first trip cools for
+    # ~base/2..base, doubling per consecutive trip up to the cap
+    peer_breaker_backoff_base_ms: float = 500.0
+    peer_breaker_backoff_cap_ms: float = 30_000.0
+    # concurrent HALF_OPEN probe RPCs allowed while testing a tripped peer
+    peer_breaker_probes: int = 1
+    # owner-unreachable answer policy: "error" | "local" (DegradationPolicy)
+    degradation_policy: str = DegradationPolicy.ERROR.value
+    # failed GLOBAL hit batches re-merge into the pending queue this many
+    # times before the hits are dropped (0 restores the reference's
+    # drop-on-error, global.go:190-195)
+    global_requeue_retries: int = 3
+    # total pending-hit keys the requeue path may grow the queue to; beyond
+    # it, failed batches drop (bounds memory during long partitions)
+    global_queue_cap: int = 10_000
 
 
 @dataclass
@@ -304,6 +339,31 @@ class DaemonConfig:
             raise ConfigError("GUBER_PIPELINE_INFLIGHT must be >= 1")
         if self.behaviors.coalesce_limit <= 0:
             raise ConfigError("GUBER_BATCH_COALESCE_LIMIT must be positive")
+        if self.behaviors.peer_breaker_errors <= 0:
+            raise ConfigError("GUBER_PEER_BREAKER_ERRORS must be >= 1")
+        if self.behaviors.peer_breaker_probes <= 0:
+            raise ConfigError("GUBER_PEER_BREAKER_PROBES must be >= 1")
+        if self.behaviors.peer_breaker_backoff_base_ms <= 0:
+            raise ConfigError("GUBER_PEER_BREAKER_BACKOFF_BASE must be positive")
+        if (
+            self.behaviors.peer_breaker_backoff_cap_ms
+            < self.behaviors.peer_breaker_backoff_base_ms
+        ):
+            raise ConfigError(
+                "GUBER_PEER_BREAKER_BACKOFF_CAP must be >= the backoff base"
+            )
+        if self.behaviors.degradation_policy not in (
+            DegradationPolicy.ERROR.value,
+            DegradationPolicy.LOCAL.value,
+        ):
+            raise ConfigError(
+                "GUBER_DEGRADATION_POLICY must be error or local, got "
+                f"{self.behaviors.degradation_policy!r}"
+            )
+        if self.behaviors.global_requeue_retries < 0:
+            raise ConfigError("GUBER_GLOBAL_REQUEUE_RETRIES must be >= 0")
+        if self.behaviors.global_queue_cap <= 0:
+            raise ConfigError("GUBER_GLOBAL_QUEUE_CAP must be positive")
         if self.tls_client_auth not in ("", "require", "verify"):
             raise ConfigError("GUBER_TLS_CLIENT_AUTH must be require or verify")
         if self.created_at_tolerance_ms <= 0:
@@ -346,6 +406,21 @@ def setup_daemon_config(
                 env, "GUBER_GLOBAL_PEER_CONCURRENCY", 100
             ),
             force_global=_get_bool(env, "GUBER_FORCE_GLOBAL", False),
+            peer_breaker_errors=_get_int(env, "GUBER_PEER_BREAKER_ERRORS", 5),
+            peer_breaker_backoff_base_ms=_get_float_ms(
+                env, "GUBER_PEER_BREAKER_BACKOFF_BASE", 500.0
+            ),
+            peer_breaker_backoff_cap_ms=_get_float_ms(
+                env, "GUBER_PEER_BREAKER_BACKOFF_CAP", 30_000.0
+            ),
+            peer_breaker_probes=_get_int(env, "GUBER_PEER_BREAKER_PROBES", 1),
+            degradation_policy=_get(
+                env, "GUBER_DEGRADATION_POLICY", DegradationPolicy.ERROR.value
+            ),
+            global_requeue_retries=_get_int(
+                env, "GUBER_GLOBAL_REQUEUE_RETRIES", 3
+            ),
+            global_queue_cap=_get_int(env, "GUBER_GLOBAL_QUEUE_CAP", 10_000),
         ),
         peer_discovery_type=_get(env, "GUBER_PEER_DISCOVERY_TYPE", "none"),
         dns_fqdn=_get(env, "GUBER_DNS_FQDN", ""),

@@ -78,16 +78,29 @@ class ReplicatedConsistentHash:
         self._ring.sort(key=lambda t: t[0])
         self._ring_pts = None  # invalidate the vectorized-lookup cache
 
-    def get(self, key: str) -> PeerInfo:
+    def get(self, key: str, exclude: frozenset = frozenset()) -> PeerInfo:
         """Owner of `key` — first ring point at or after hash(key), wrapping
-        (reference replicated_hash.go:104-119)."""
+        (reference replicated_hash.go:104-119). `exclude` (grpc addresses)
+        skips peers along the ring — the fault-tolerance route-around: the
+        first non-excluded peer clockwise is the key's natural fallback
+        owner. Raises when every peer is excluded."""
         if not self._ring:
             raise RuntimeError("unable to pick a peer; pool is empty")
         point = self.hash_fn(key.encode())
         idx = bisect.bisect_left(self._ring, (point,))
         if idx == len(self._ring):
             idx = 0
-        return self._ring[idx][1]
+        if not exclude:
+            return self._ring[idx][1]
+        seen = set()
+        for off in range(len(self._ring)):
+            peer = self._ring[(idx + off) % len(self._ring)][1]
+            if peer.grpc_address not in exclude:
+                return peer
+            seen.add(peer.grpc_address)
+            if len(seen) == len(self._peers):
+                break
+        raise RuntimeError("unable to pick a peer; all peers excluded")
 
     def owners_of(self, points) -> List[PeerInfo]:
         """Vectorized get(): precomputed 32-bit ring points (numpy array) →

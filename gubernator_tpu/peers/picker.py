@@ -42,10 +42,21 @@ class RegionPicker:
             self._regions[peer.data_center] = ring
         ring.add(peer)
 
-    def get_clients(self, key: str) -> List[PeerInfo]:
+    def get_clients(
+        self, key: str, exclude: frozenset = frozenset()
+    ) -> List[PeerInfo]:
         """The owning peer of `key` in EVERY region (reference
-        region_picker.go:57-69) — the cross-region replication fan-out set."""
-        return [ring.get(key) for ring in self._regions.values()]
+        region_picker.go:57-69) — the cross-region replication fan-out set.
+        `exclude` routes around unreachable (open-breaker) peers within each
+        region's ring; a region whose peers are ALL excluded contributes no
+        target rather than failing the whole fan-out."""
+        out: List[PeerInfo] = []
+        for ring in self._regions.values():
+            try:
+                out.append(ring.get(key, exclude))
+            except RuntimeError:
+                continue  # every peer in this region excluded
+        return out
 
     def get_by_address(self, address: str) -> Optional[PeerInfo]:
         """First peer whose address matches, searching all regions

@@ -20,12 +20,13 @@ Routing per request item (reference GetRateLimits, gubernator.go:186-302):
 from __future__ import annotations
 
 import asyncio
+import random
 import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
-from gubernator_tpu.config import DaemonConfig
+from gubernator_tpu.config import DaemonConfig, DegradationPolicy
 from gubernator_tpu.hashing import fingerprint
 from gubernator_tpu.ops.batch import ERROR_STRINGS, RequestColumns
 from gubernator_tpu.ops.engine import LocalEngine, ms_now
@@ -35,8 +36,13 @@ from gubernator_tpu.proto import gubernator_pb2 as pb
 from gubernator_tpu.proto import peers_pb2 as peers_pb
 from gubernator_tpu.service.batcher import Batcher
 from gubernator_tpu.service.global_manager import GlobalManager
+from gubernator_tpu.service.breaker import BreakerState, CircuitBreaker
 from gubernator_tpu.service.metrics import DaemonMetrics
-from gubernator_tpu.service.peer_client import PeerClient, PeerError
+from gubernator_tpu.service.peer_client import (
+    PeerCircuitOpenError,
+    PeerClient,
+    PeerError,
+)
 from gubernator_tpu.service.runner import EngineRunner
 from gubernator_tpu.service.wire import (
     MAX_BATCH_SIZE,
@@ -501,13 +507,20 @@ class Daemon:
             if not info.is_owner:
                 client = self._peer_clients.get(info.grpc_address)
                 if client is None:
+                    b = self.conf.behaviors
                     client = PeerClient(
                         info,
-                        batch_wait_ms=self.conf.behaviors.batch_wait_ms,
-                        batch_limit=self.conf.behaviors.batch_limit,
-                        batch_timeout_ms=self.conf.behaviors.batch_timeout_ms,
+                        batch_wait_ms=b.batch_wait_ms,
+                        batch_limit=b.batch_limit,
+                        batch_timeout_ms=b.batch_timeout_ms,
                         metrics=self.metrics,
                         channel_credentials=self._client_creds,
+                        breaker=CircuitBreaker(
+                            failure_threshold=b.peer_breaker_errors,
+                            backoff_base_ms=b.peer_breaker_backoff_base_ms,
+                            backoff_cap_ms=b.peer_breaker_backoff_cap_ms,
+                            probe_budget=b.peer_breaker_probes,
+                        ),
                     )
                 keep[info.grpc_address] = client
         dropped = [
@@ -781,6 +794,8 @@ class Daemon:
             rc = await self.batcher.check(g)
             place(global_rows, rc)
 
+        degraded_rows: set = set()
+
         async def run_forward(row: int):
             item = materialize(row)
             out: List[Optional[pb.RateLimitResp]] = [None]
@@ -792,6 +807,8 @@ class Daemon:
             reset[row] = r.reset_time
             if r.error:
                 errors[int(row)] = r.error
+            if "degraded" in r.metadata:
+                degraded_rows.add(int(row))
 
         tasks = []
         if local_rows.size:
@@ -820,6 +837,23 @@ class Daemon:
         over = int((status == int(pb.OVER_LIMIT)).sum())
         if over:
             self.metrics.over_limit_counter.inc(over)
+        if degraded_rows:
+            # degraded responses carry the metadata marker, which the native
+            # encoder does not emit — partitions are the rare path, so fall
+            # back to pb encoding for the whole batch
+            resps = []
+            for i in range(n):
+                r = pb.RateLimitResp(
+                    status=int(status[i]),
+                    limit=int(limit[i]),
+                    remaining=int(remaining[i]),
+                    reset_time=int(reset[i]),
+                    error=errors.get(i, ""),
+                )
+                if i in degraded_rows:
+                    r.metadata["degraded"] = "true"
+                resps.append(r)
+            return pb.GetRateLimitsResp(responses=resps).SerializeToString()
         t0 = time.perf_counter()
         out_bytes = encode_response_columns(status, limit, remaining, reset, errors)
         self.metrics.stage_duration.labels(stage="encode").observe(
@@ -846,7 +880,11 @@ class Daemon:
 
     async def _forward(self, row: int, key: str, item, out) -> None:
         """Forward to the owner with ownership re-resolution on failure
-        (reference asyncRequest, gubernator.go:318-399)."""
+        (reference asyncRequest, gubernator.go:318-399), consulting the
+        owner's circuit breaker: an open breaker fails fast (no RPC, no
+        timeout wait) straight into the degradation policy, and retry
+        sleeps are jittered-exponential instead of fixed-linear (Dean &
+        Barroso, *The Tail at Scale*)."""
         last_err = "no peers available"
         for attempt in range(FORWARD_RETRIES):
             try:
@@ -867,14 +905,78 @@ class Daemon:
             try:
                 out[row] = await client.get_peer_rate_limit(item)
                 return
+            except PeerCircuitOpenError as exc:
+                # cooling down: retrying the same owner is pointless until
+                # the breaker half-opens — degrade/error immediately
+                last_err = str(exc)
+                break
             except PeerError as exc:
                 last_err = str(exc)
                 self.metrics.batch_send_retries.inc()
-                await asyncio.sleep(0.001 * (attempt + 1))
+                await asyncio.sleep(random.uniform(0, 0.002 * (2**attempt)))
+        await self._forward_fallback(row, key, item, out, last_err)
+
+    async def _forward_fallback(self, row: int, key: str, item, out, last_err) -> None:
+        """Owner unreachable: apply the degradation policy. LOCAL answers
+        from this daemon's own store (route-around first for pure reads),
+        marked metadata["degraded"]="true"; ERROR keeps the reference's
+        error response (gubernator.go:389-398)."""
+        if (
+            self.conf.behaviors.degradation_policy
+            == DegradationPolicy.LOCAL.value
+        ):
+            if item.hits == 0:
+                resp = await self._forward_around(key, item)
+                if resp is not None:
+                    out[row] = resp
+                    return
+            out[row] = await self._degraded_local(item)
+            return
         self.metrics.check_error_counter.labels(error="forward").inc()
         out[row] = pb.RateLimitResp(
             error=f"Error while fetching rate limit from peer: {last_err}"
         )
+
+    async def _forward_around(self, key: str, item) -> Optional["pb.RateLimitResp"]:
+        """Route a zero-hit read around the dead owner to the next live peer
+        on the ring — its replica state (GLOBAL broadcasts) may be fresher
+        than ours. Returns None when no usable alternate exists (the local
+        fallback handles it)."""
+        try:
+            owner = self.get_peer(key)
+        except Exception:
+            return None
+        exclude = {owner.grpc_address}
+        for addr, client in self._peer_clients.items():
+            if client.breaker.blocked:
+                exclude.add(addr)
+        try:
+            alt = self._local_picker.get(key, frozenset(exclude))
+        except RuntimeError:
+            return None
+        if self.is_self(alt):
+            return None
+        client = self.peer_client(alt)
+        if client is None:
+            return None
+        try:
+            resp = await client.get_peer_rate_limit(item)
+        except PeerError:
+            return None
+        resp.metadata["degraded"] = "true"
+        self.metrics.degraded_responses.inc()
+        return resp
+
+    async def _degraded_local(self, item) -> "pb.RateLimitResp":
+        """Best-effort local decision against this daemon's own store —
+        clients keep getting rate-limit answers during partitions, each
+        marked degraded so callers can tell it is not owner-authoritative."""
+        cols, _ = columns_from_pb([item])
+        rc = await self.batcher.check(cols)
+        resp = pb_from_response_columns(rc)[0]
+        resp.metadata["degraded"] = "true"
+        self.metrics.degraded_responses.inc()
+        return resp
 
     # --------------------------------------------------------- peers service
     async def get_peer_rate_limits(
@@ -958,33 +1060,50 @@ class Daemon:
 
     # ----------------------------------------------------------------- health
     async def health_check(self) -> "pb.HealthCheckResp":
-        """Aggregate per-peer recent errors (reference gubernator.go:562-643)."""
+        """Aggregate per-peer recent errors + breaker states (reference
+        gubernator.go:562-643). Tri-state status so probes can tell a
+        *degraded* instance (peer errors / open breakers, still serving
+        every request) from an *unhealthy* one (structurally broken —
+        e.g. not in its own peer list)."""
         errs: List[str] = []
+        breaker_alarm = False
         local = self.local_peers()
         for c in self._peer_clients.values():
             errs.extend(c.recent_errors())
+            if c.breaker.state is not BreakerState.CLOSED:
+                breaker_alarm = True
+        fatal: List[str] = []
         if local and not any(self.is_self(p) for p in local):
-            errs.append(
+            fatal.append(
                 f"this instance ({self.conf.advertise_address}) is not in the peer list"
             )
+        if fatal:
+            status = "unhealthy"
+        elif errs or breaker_alarm:
+            status = "degraded"
+        else:
+            status = "healthy"
         resp = pb.HealthCheckResp(
-            status="unhealthy" if errs else "healthy",
-            message="; ".join(errs[:5]),
+            status=status,
+            message="; ".join((fatal + errs)[:5]),
             peer_count=self._local_picker.size() + self._region_picker.size(),
             advertise_address=self.conf.advertise_address,
         )
+
+        def peer_entry(p: PeerInfo) -> "pb.PeerHealthResp":
+            e = pb.PeerHealthResp(
+                grpc_address=p.grpc_address, data_center=p.data_center
+            )
+            c = self._peer_clients.get(p.grpc_address)
+            if c is not None:  # no client toward self
+                e.breaker_state = c.breaker.state_name
+                e.recent_errors.extend(c.recent_errors()[:5])
+            return e
+
         for p in local:
-            resp.local_peers.append(
-                pb.PeerHealthResp(
-                    grpc_address=p.grpc_address, data_center=p.data_center
-                )
-            )
+            resp.local_peers.append(peer_entry(p))
         for p in self.region_peers():
-            resp.region_peers.append(
-                pb.PeerHealthResp(
-                    grpc_address=p.grpc_address, data_center=p.data_center
-                )
-            )
+            resp.region_peers.append(peer_entry(p))
         return resp
 
     def live_check(self) -> "pb.LiveCheckResp":

@@ -37,8 +37,12 @@ class GlobalManager:
         self.timeout_s = b.global_timeout_ms / 1e3
         self.concurrency = b.global_peer_concurrency
         self.metrics = daemon.metrics
+        self.requeue_retries = b.global_requeue_retries
+        self.queue_cap = b.global_queue_cap
         # pending hits: hash_key → aggregated RateLimitReq (non-owner side)
         self._hits: Dict[str, pb.RateLimitReq] = {}
+        # requeue accounting: hash_key → failed-send count (bounded retries)
+        self._hit_attempts: Dict[str, int] = {}
         # pending broadcasts: hash_key → latest owner-side request (config carrier)
         self._updates: Dict[str, pb.RateLimitReq] = {}
         self._hits_wake = asyncio.Event()
@@ -92,6 +96,7 @@ class GlobalManager:
         """Owner-side: mark the key for an authoritative broadcast (reference
         QueueUpdate, global.go:92-99)."""
         self._updates[key] = item
+        self.metrics.broadcast_queue_length.set(len(self._updates))
         if len(self._updates) >= self.batch_limit:
             self._bcast_wake.set()
 
@@ -116,42 +121,97 @@ class GlobalManager:
         if not self._hits:
             return
         batch, self._hits = self._hits, {}
-        self.metrics.global_queue_length.set(0)
+        self.metrics.global_queue_length.set(len(self._hits))
         t0 = time.perf_counter()
         # group by owning peer (reference sendHits, global.go:155-199)
-        by_peer: Dict[str, list] = {}
+        by_peer: Dict[str, list] = {}  # addr → [(hash_key, item)]
         infos = {}
         for key, item in batch.items():
             try:
                 info = self.daemon.get_peer(key)
             except Exception:
-                continue  # no peers; drop (eventual consistency tolerates it)
+                # no peers; drop (eventual consistency tolerates it)
+                self._hit_attempts.pop(key, None)
+                continue
             if self.daemon.is_self(info):
-                continue  # became owner since queueing; owner path handles it
-            by_peer.setdefault(info.grpc_address, []).append(item)
+                # became owner since queueing; owner path handles it
+                self._hit_attempts.pop(key, None)
+                continue
+            by_peer.setdefault(info.grpc_address, []).append((key, item))
             infos[info.grpc_address] = info
         sem = asyncio.Semaphore(self.concurrency)
 
-        async def send(addr, items):
+        async def send(addr, pairs):
             client = self.daemon.peer_client(infos[addr])
             if client is None:
+                # peer vanished between grouping and send — requeue so the
+                # next round re-resolves ownership
+                self._requeue(pairs)
+                return
+            if client.breaker.blocked:
+                # fail fast: no RPC (and no timeout wait) toward an open
+                # breaker; the batch requeues for the next sync round
+                self.metrics.check_error_counter.labels(
+                    error="global_send"
+                ).inc()
+                self._requeue(pairs)
                 return
             async with sem:
                 try:
                     await client.get_peer_rate_limits(
-                        peers_pb.GetPeerRateLimitsReq(requests=items),
+                        peers_pb.GetPeerRateLimitsReq(
+                            requests=[i for _, i in pairs]
+                        ),
                         timeout=self.timeout_s,
                     )
+                except asyncio.CancelledError:
+                    raise
                 except Exception:
-                    # counted + dropped, never retried (reference
-                    # global.go:190-195 — replication tolerates loss)
+                    # counted + REQUEUED (bounded): brief owner outages no
+                    # longer lose replication traffic (the reference drops
+                    # on error, global.go:190-195)
                     self.metrics.check_error_counter.labels(
                         error="global_send"
                     ).inc()
+                    self._requeue(pairs)
+                else:
+                    for key, _ in pairs:
+                        self._hit_attempts.pop(key, None)
 
-        await asyncio.gather(*(send(a, i) for a, i in by_peer.items()))
+        await asyncio.gather(*(send(a, p) for a, p in by_peer.items()))
         if by_peer:
             self.metrics.global_send_duration.observe(time.perf_counter() - t0)
+
+    def _requeue(self, pairs) -> None:
+        """Re-merge a failed batch into the pending queue, bounded by a
+        per-key retry cap and a total queue-size cap (so a long partition
+        degrades to the reference's drop behavior instead of growing
+        memory without bound)."""
+        requeued = dropped = 0
+        for key, item in pairs:
+            attempts = self._hit_attempts.get(key, 0) + 1
+            if attempts > self.requeue_retries or (
+                key not in self._hits and len(self._hits) >= self.queue_cap
+            ):
+                self._hit_attempts.pop(key, None)
+                dropped += 1
+                continue
+            self._hit_attempts[key] = attempts
+            agg = self._hits.get(key)
+            if agg is None:
+                self._hits[key] = item
+            else:
+                # fresh hits arrived for the key since the failed send: fold
+                # the failed batch back in (same merge rule as queue_hit —
+                # newest config wins, hits add, RESET_REMAINING sticks)
+                agg.hits += item.hits
+                agg.behavior |= item.behavior & int(Behavior.RESET_REMAINING)
+            requeued += 1
+        if requeued:
+            self.metrics.global_requeued.inc(requeued)
+        if dropped:
+            self.metrics.global_requeue_dropped.inc(dropped)
+        self.metrics.global_queue_length.set(len(self._hits))
 
     # -------------------------------------------------------- broadcast loop
     async def _broadcast_loop(self) -> None:
@@ -172,6 +232,7 @@ class GlobalManager:
         if not self._updates:
             return
         batch, self._updates = self._updates, {}
+        self.metrics.broadcast_queue_length.set(0)
         t0 = time.perf_counter()
         # re-read each key's current status with Hits=0 (reference
         # global.go:255-262) — a zero-hit check is the authoritative read
@@ -212,10 +273,20 @@ class GlobalManager:
             client = self.daemon.peer_client(info)
             if client is None:
                 return
+            if client.breaker.blocked:
+                # skip (no RPC) — broadcasts are not requeued: every owner
+                # update re-reads authoritative state, so the next round
+                # after the breaker closes refreshes this peer anyway
+                self.metrics.check_error_counter.labels(
+                    error="broadcast"
+                ).inc()
+                return
             async with sem:
                 try:
                     await client.update_peer_globals(req, timeout=self.timeout_s)
                     self.metrics.broadcast_counter.labels(condition="broadcast").inc()
+                except asyncio.CancelledError:
+                    raise
                 except Exception:
                     self.metrics.check_error_counter.labels(
                         error="broadcast"

@@ -154,6 +154,31 @@ class DaemonMetrics:
             "Forwarded requests re-sent after peer errors/ownership moves",
             registry=r,
         )
+        # --- peer fault tolerance (service/breaker.py; docs/robustness.md)
+        self.circuit_breaker_state = Gauge(
+            "gubernator_circuit_breaker_state",
+            "Per-peer circuit breaker state (0=closed, 1=half-open, 2=open)",
+            ["peer"],
+            registry=r,
+        )
+        self.degraded_responses = Counter(
+            "gubernator_degraded_response_count",
+            "Responses served from local state because the owner was "
+            "unreachable (DegradationPolicy.LOCAL)",
+            registry=r,
+        )
+        self.global_requeued = Counter(
+            "gubernator_global_requeue_count",
+            "GLOBAL pending hits re-merged into the queue after a failed "
+            "owner send (instead of dropped)",
+            registry=r,
+        )
+        self.global_requeue_dropped = Counter(
+            "gubernator_global_requeue_dropped_count",
+            "GLOBAL pending hits dropped after exhausting requeue retries "
+            "or hitting the queue cap",
+            registry=r,
+        )
         # --- GLOBAL behavior (global.go:53-79 analog; names must match, the
         # convergence tests key on them)
         self.global_send_duration = Summary(
@@ -175,6 +200,11 @@ class DaemonMetrics:
         self.global_queue_length = Gauge(
             "gubernator_global_queue_length",
             "Pending async GLOBAL hits awaiting the sync tick",
+            registry=r,
+        )
+        self.broadcast_queue_length = Gauge(
+            "gubernator_broadcast_queue_length",
+            "Owner-side keys queued for an authoritative broadcast",
             registry=r,
         )
         self.updates_installed = Counter(

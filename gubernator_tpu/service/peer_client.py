@@ -21,6 +21,7 @@ import grpc
 from gubernator_tpu import tracing
 from gubernator_tpu.proto import gubernator_pb2 as pb
 from gubernator_tpu.proto import peers_pb2 as peers_pb
+from gubernator_tpu.service.breaker import CircuitBreaker
 from gubernator_tpu.types import Behavior, PeerInfo, has_behavior
 
 GET_PEER_RATE_LIMITS = "/pb.gubernator.PeersV1/GetPeerRateLimits"
@@ -41,6 +42,21 @@ class PeerError(Exception):
         self.cause = cause
 
 
+class PeerCircuitOpenError(PeerError):
+    """Fast-fail: the peer's circuit breaker refused the attempt — no RPC
+    was made (and none should be retried against the same peer until the
+    cooldown elapses)."""
+
+    def __init__(self, address: str, retry_after_s: float = 0.0):
+        super().__init__(
+            address,
+            RuntimeError(
+                f"circuit breaker open (retry in {retry_after_s * 1e3:.0f} ms)"
+            ),
+        )
+        self.retry_after_s = retry_after_s
+
+
 class PeerClient:
     def __init__(
         self,
@@ -50,6 +66,7 @@ class PeerClient:
         batch_timeout_ms: float = 500.0,
         metrics=None,
         channel_credentials=None,
+        breaker: Optional[CircuitBreaker] = None,
     ):
         self.info = info
         self.batch_wait_s = batch_wait_ms / 1e3
@@ -57,6 +74,15 @@ class PeerClient:
         self.timeout_s = batch_timeout_ms / 1e3
         self.metrics = metrics
         self._creds = channel_credentials
+        if breaker is None:
+            breaker = CircuitBreaker()
+        self.breaker = breaker
+        if metrics is not None and breaker._on_state is None:
+            gauge = metrics.circuit_breaker_state.labels(
+                peer=info.grpc_address
+            )
+            gauge.set(int(breaker.state))
+            breaker._on_state = lambda s: gauge.set(int(s))
         self._channel: Optional[grpc.aio.Channel] = None
         self._queue: List[Tuple[pb.RateLimitReq, asyncio.Future]] = []
         self._wake: Optional[asyncio.Event] = None
@@ -89,18 +115,31 @@ class PeerClient:
         return [msg for ts, msg in self.last_errs if ts >= cutoff]
 
     async def _unary(self, path: str, req, resp_cls, timeout: Optional[float] = None):
+        # the breaker gates EVERY unary RPC toward this peer — forwards,
+        # GLOBAL hit-syncs and broadcasts all fail fast while it is open
+        # instead of stacking timeout waits on a dead peer
+        if not self.breaker.allow():
+            raise PeerCircuitOpenError(
+                self.info.grpc_address, self.breaker.retry_after_s()
+            )
         call = self._chan().unary_unary(
             path,
             request_serializer=lambda m: m.SerializeToString(),
             response_deserializer=resp_cls.FromString,
         )
         try:
-            return await call(req, timeout=timeout or self.timeout_s)
+            resp = await call(req, timeout=timeout or self.timeout_s)
         except asyncio.CancelledError:
-            raise  # task cancellation must propagate, not become a PeerError
+            # task cancellation must propagate, not become a PeerError; it is
+            # no verdict on the peer either — release any probe slot
+            self.breaker.record_discard()
+            raise
         except BaseException as exc:
+            self.breaker.record_failure()
             self._record_err(exc)
             raise PeerError(self.info.grpc_address, exc) from exc
+        self.breaker.record_success()
+        return resp
 
     # ------------------------------------------------------------ peer RPCs
     async def get_peer_rate_limits(
@@ -124,6 +163,15 @@ class PeerClient:
         sends go direct (reference peer_client.go:126-162)."""
         if self._closed:
             raise PeerError(self.info.grpc_address, RuntimeError("peer client closed"))
+        if self.breaker.blocked:
+            # fail BEFORE enqueueing: a request queued behind an open breaker
+            # would strand until the queue-wait deadline, defeating the
+            # fail-fast point of the breaker. `blocked` is side-effect-free —
+            # when the cooldown has elapsed, the flush RPC itself becomes the
+            # half-open probe via _unary's allow().
+            raise PeerCircuitOpenError(
+                self.info.grpc_address, self.breaker.retry_after_s()
+            )
         # propagate the active trace to the owner via request metadata
         # (reference peer_client.go:140-142, 364-367)
         tracing.inject(item.metadata)
@@ -242,12 +290,16 @@ class PeerClient:
         """Drain: stop the flush loop, send anything still queued, wait for
         in-flight sends, close the channel (reference peer_client.go:415-451)."""
         self._closed = True
-        if self._loop_task is not None and not self._loop_task.done():
-            self._wake.set()
-            await self._loop_task
-        # single-drainer invariant: the loop has exited, so no send is in
-        # flight here — this drain is the only sender left
-        await self._drain()
-        if self._channel is not None:
-            await self._channel.close()
-            self._channel = None
+        try:
+            if self._loop_task is not None and not self._loop_task.done():
+                self._wake.set()
+                await self._loop_task
+            # single-drainer invariant: the loop has exited, so no send is in
+            # flight here — this drain is the only sender left
+            await self._drain()
+        finally:
+            # a failing peer (PeerError/cancellation out of the final drain)
+            # must never leak the channel
+            if self._channel is not None:
+                await self._channel.close()
+                self._channel = None

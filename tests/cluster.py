@@ -37,23 +37,55 @@ def daemon_config(dc: str = "", **overrides) -> DaemonConfig:
 
 
 class Cluster:
-    def __init__(self, daemons: List[Daemon]):
+    def __init__(self, daemons: List[Daemon], proxies: Optional[list] = None):
         self.daemons = daemons
+        # chaos=True: proxies[i] fronts daemons[i]'s peer traffic
+        self.proxies = proxies or [None] * len(daemons)
 
     @classmethod
-    async def start(cls, n: int, dcs: Optional[List[str]] = None, **overrides):
+    async def start(
+        cls,
+        n: int,
+        dcs: Optional[List[str]] = None,
+        chaos: bool = False,
+        **overrides,
+    ):
         """Start n daemons (optionally with per-daemon datacenter labels) and
-        wire them together with explicit set_peers."""
+        wire them together with explicit set_peers.
+
+        chaos=True fronts each daemon's PEER plane with a ChaosProxy
+        (tests/chaos.py): the daemon advertises the proxy's port, so every
+        other daemon's forwards/hit-syncs/broadcasts flow through it and
+        tests inject faults per-peer at runtime. Direct client traffic
+        (V1Client at conf.grpc_address) bypasses the proxy."""
         dcs = dcs or [""] * n
-        daemons = [
-            await Daemon.spawn(daemon_config(dc=dcs[i], **overrides))
-            for i in range(n)
-        ]
+        proxies = [None] * n
+        daemons = []
+        for i in range(n):
+            conf_kw = dict(overrides)
+            if chaos:
+                from tests.chaos import ChaosProxy
+
+                proxies[i] = await ChaosProxy().start()
+                # advertise the proxy: ring identity and peer dialing both
+                # key on advertise_address, so ownership stays consistent
+                # across daemons while the transport detours via the proxy
+                conf_kw["advertise_address"] = proxies[i].address
+            daemons.append(
+                await Daemon.spawn(daemon_config(dc=dcs[i], **conf_kw))
+            )
+            if chaos:
+                host, _, port = daemons[i].conf.grpc_address.rpartition(":")
+                proxies[i].set_target(host, int(port))
         peers = [d.peer_info() for d in daemons]
         for d in daemons:
             # fresh PeerInfo copies: set_peers mutates is_owner per daemon
             d.set_peers([PeerInfo(**vars(p)) for p in peers])
-        return cls(daemons)
+        return cls(daemons, proxies)
+
+    def proxy_for(self, daemon: Daemon):
+        """The ChaosProxy fronting `daemon`'s peer traffic."""
+        return self.proxies[self.daemons.index(daemon)]
 
     def find_owning_daemon(self, name: str, key: str) -> Daemon:
         """reference cluster.FindOwningDaemon (cluster/cluster.go:81-110)."""
@@ -83,6 +115,9 @@ class Cluster:
 
     async def stop(self) -> None:
         await asyncio.gather(*(d.close() for d in self.daemons))
+        await asyncio.gather(
+            *(p.stop() for p in self.proxies if p is not None)
+        )
 
 
 async def scrape(daemon: Daemon) -> dict:
