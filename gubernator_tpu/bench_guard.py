@@ -107,3 +107,31 @@ def check_work(
             "timed window did not do the work its rate claims"
         )
     return None
+
+
+def check_dropped(
+    dropped: int,
+    decisions: int,
+    *,
+    max_frac: float = 0.01,
+    label: str = "decisions",
+) -> Optional[str]:
+    """Write-path proof of work. hit/miss reconciliation (check_work) cannot
+    see a write path that probes rows but fails to persist them — dropped
+    rows still count as probed — so a broken write (e.g. a sparse grid
+    mapping updates into the wrong blocks, or a window geometry that
+    overflows every run) would sail through check_work while the timed loop
+    'serves' decisions nobody could ever re-read. Such failures surface as a
+    drop storm in the loop's own dropped counter; legitimate drops (claim
+    dedup under contention, the rare window-overflow tail) stay far under
+    `max_frac` for the bench's unique-fingerprint batches. Returns a refusal
+    reason, or None if drops are within tolerance."""
+    if decisions <= 0:
+        return None
+    if dropped > max_frac * decisions:
+        return (
+            f"{dropped} of {decisions} {label} dropped "
+            f"(> {max_frac:.1%} tolerance): the write path did not persist "
+            "the work its rate claims"
+        )
+    return None

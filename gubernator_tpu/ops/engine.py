@@ -51,10 +51,13 @@ ERR_NOT_PERSISTED = "rate limit state could not be persisted (contended table); 
 
 
 def default_write_mode() -> str:
-    """Pallas sweep write on real TPU; XLA scatter everywhere else (CPU test
-    meshes, and any backend without the TPU Pallas pipeline — e.g. GPU, where
-    the sweep kernel has never been lowered)."""
-    return "sweep" if jax.default_backend() == "tpu" else "xla"
+    """Block-sparse Pallas write on real TPU — write cost ∝ batch, not table
+    size; kernel2.resolve_write falls each dispatch shape back to the full
+    table-streaming sweep when the sparse grid's coverage crosses
+    GUBER_WRITE_SPARSE_CROSSOVER (e.g. 131K-row bench batches). XLA scatter
+    everywhere else (CPU test meshes, and any backend without the TPU Pallas
+    pipeline — e.g. GPU, where the sweep kernel has never been lowered)."""
+    return "sparse" if jax.default_backend() == "tpu" else "xla"
 
 
 def ms_now() -> int:
@@ -434,9 +437,11 @@ class LocalEngine:
         store=None,
     ):
         self.table = table if table is not None else new_table2(capacity)
-        # one write mode for every dispatch: the Pallas sweep on TPU, XLA
-        # scatter on CPU meshes. A batch-size crossover to the scatter used
-        # to exist on a "scatter costs ∝ batch" assumption — measured FALSE
+        # one write mode for every dispatch: the block-sparse Pallas write
+        # on TPU (kernel2.resolve_write falls big-batch shapes back to the
+        # full sweep), XLA scatter on CPU meshes. A batch-size crossover to
+        # the SCATTER used to exist on a "scatter costs ∝ batch" assumption
+        # — measured FALSE
         # at scale (exp/exp_crossover.py, v5e, 1 GiB table: scatter ≈ 58 ms
         # at EVERY batch size 2K-16K vs sweep 4.1-4.9 ms), so it picked a
         # 13× slower path exactly where latency mattered.
@@ -455,6 +460,11 @@ class LocalEngine:
         self.store = store
         self.stats = EngineStats()
         self._seen_pad_sizes: set = set()  # compiled batch shapes (for resize warm)
+        # reason string when a failed donated launch left device state
+        # suspect (see GlobalShardedEngine._requeue_popped); surfaces as
+        # health_check "unhealthy". Never set on the single-device path
+        # today, but the daemon reads it engine-agnostically.
+        self.poisoned: Optional[str] = None
 
     def _decide_packed(self, hb: HostBatch) -> np.ndarray:
         """One dispatch → ONE host transfer each way: packed (12, B) ingress

@@ -22,8 +22,12 @@ per 131K-row dispatch on v5e) with the design measured fastest on real TPU
      block's updates into place; blocks whose update run fits their first
      u-window skip the second half's matmuls via a scalar-prefetched
      predicate (~4.2 ms for a 1 GiB table at headline batch,
-     exp/exp_sweep5.py). XLA scatter fallback (`write="xla"`) keeps identical
-     semantics for CPU meshes/tests.
+     exp/exp_sweep5.py). `write="sparse"` launches the SAME sweep grid only
+     over the batch's dirty blocks via scalar-prefetched block indices
+     (_write_sparse) — write cost ∝ batch, not table size — and resolves
+     back to the full sweep past a coverage crossover (resolve_write /
+     GUBER_WRITE_SPARSE_CROSSOVER). XLA scatter fallback (`write="xla"`)
+     keeps identical semantics for CPU meshes/tests.
 
 Dispatches are additionally specialized host-side by `math="token"|"mixed"`
 (engine._math_mode): all-token batches — the common case — compile a decision
@@ -49,6 +53,7 @@ float64 throughout (ops/math.py).
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Tuple
 
 import jax
@@ -85,6 +90,24 @@ i64 = jnp.int64
 i32 = jnp.int32
 f64 = jnp.float64
 f32 = jnp.float32
+
+# x64-disable context across jax versions: top-level on new jax,
+# jax.experimental on 0.4.x
+if hasattr(jax, "enable_x64"):
+    _enable_x64 = jax.enable_x64
+else:
+    from jax.experimental import enable_x64 as _enable_x64
+
+
+def _sweep_x64_ctx(interpret: bool):
+    """Trace the sweep/sparse pallas_call with x64 OFF on real TPU (Mosaic
+    rejects the x64-promoted scalars the surrounding graph traces with) but
+    leave the config ALONE under the CPU interpreter: flipping x64 mid-trace
+    makes the interpreter's grid loop emit mixed i32/i64 scalar helpers that
+    collide in the 0.4.x lowering cache ('func.call operand type mismatch')."""
+    import contextlib
+
+    return contextlib.nullcontext() if interpret else _enable_x64(False)
 
 
 def _lo32(x):
@@ -151,6 +174,72 @@ def sweep_geometry(n_buckets: int, batch: int) -> Tuple[int, int]:
             u = min(u, max(64, (1 << 19) // blk))
             return blk, u
         blk //= 2
+
+
+def _sparse_blk() -> int:
+    """Block rows per sparse-write grid step (GUBER_WRITE_SPARSE_BLK).
+
+    Small on purpose: the sparse path's HBM traffic is (dirty blocks) × BLK
+    rows, and dirty blocks ≈ min(batch, n_buckets/BLK) for hash-spread
+    targets — so BLK is the knob trading per-step pipeline overhead against
+    bytes touched per dirty block. Read per trace (host-side), so tuning
+    runs can flip it between compiles without a restart."""
+    return int(os.environ.get("GUBER_WRITE_SPARSE_BLK", "64"))
+
+
+def sparse_write_crossover() -> float:
+    """Coverage bound gating the sparse write (GUBER_WRITE_SPARSE_CROSSOVER):
+    `write="sparse"` resolves to the full sweep unless the sparse grid's
+    worst-case coverage (grid steps × BLK bucket rows) times this factor
+    still fits under n_buckets — i.e. sparse only runs when it provably
+    touches ≤ 1/crossover of the table, where its batch-proportional cost
+    beats the table-streaming sweep."""
+    return float(os.environ.get("GUBER_WRITE_SPARSE_CROSSOVER", "4"))
+
+
+def sparse_geometry(n_buckets: int, batch: int) -> Tuple[int, int, int]:
+    """(BLK bucket-rows per sparse block, U update window, G grid steps).
+
+    Unlike the dense sweep (BLK as large as VMEM allows — per-block overhead
+    amortizes over the whole-table stream), the sparse grid visits only
+    dirty blocks, so BLK stays SMALL: each of the ≤ min(batch, n_buckets/BLK)
+    dirty blocks costs BLK·512 B of HBM traffic regardless of how many
+    updates it holds. U follows the same Poisson-tail policy as
+    sweep_geometry (overflow rows drop to the engine's retry), and the VMEM
+    stack bound blk·u ≤ 2^19 is inherited unchanged."""
+    blk = min(_sparse_blk(), n_buckets)
+    while blk > 1 and n_buckets % blk:
+        # conforming tables (new_table2) are pow2 below 2048 buckets or a
+        # multiple of 2048 above — some pow2 ≤ blk always divides
+        blk //= 2
+    nblk = n_buckets // blk
+    mean = batch / nblk
+    u = int(mean + 5.0 * mean**0.5) + 64
+    p = 64
+    while p < u:
+        p *= 2
+    u = min(p, batch)
+    u = min(u, max(64, (1 << 19) // blk))
+    return blk, u, min(nblk, batch)
+
+
+def resolve_write(write: str, n_buckets: int, batch: int) -> str:
+    """Per-dispatch (static-shape) write-mode resolution. `"sparse"` falls
+    back to the full sweep when the worst-case dirty coverage crosses
+    GUBER_WRITE_SPARSE_CROSSOVER — a 131K-row headline dispatch on a 1 GiB
+    table resolves to the sweep, a 4K serving dispatch to the sparse grid.
+    Runs host-side at trace time (batch and table shapes are static), so the
+    jit cache key (the `write` string) stays stable per call site."""
+    if write not in ("sweep", "sparse", "xla"):
+        raise ValueError(
+            f"unknown write mode {write!r}; expected 'sweep', 'sparse' or 'xla'"
+        )
+    if write != "sparse":
+        return write
+    blk, _u, g = sparse_geometry(n_buckets, batch)
+    if g * blk * sparse_write_crossover() >= n_buckets:
+        return "sweep"
+    return "sparse"
 
 
 class Claim2(NamedTuple):
@@ -279,7 +368,7 @@ def _probe_claim2(
 # --------------------------------------------------------------------- write
 
 
-def _make_sweep_kernel(nwin: int, blk: int, u: int):
+def _make_sweep_kernel(nwin: int, blk: int, u: int, sparse: bool = False):
     """Kernel factory for the scalar-prefetch sweep (closes over geometry).
 
     Windowing lives IN the kernel: updates stay in target-sorted order; the
@@ -300,12 +389,14 @@ def _make_sweep_kernel(nwin: int, blk: int, u: int):
     update run actually crosses its first window boundary (`need2`, scalar-
     prefetched per block) — runs are ~mean-length and windows u-aligned, so
     most blocks take the single-half branch and the MXU work per sweep drops
-    by roughly the non-straddle fraction."""
+    by roughly the non-straddle fraction.
+
+    `sparse=True` builds the block-sparse variant (_write_sparse): grid step
+    i composes the dirty block named by the scalar-prefetched `db_ref[i]`
+    instead of block i — same body, data-dependent block base."""
     KBLK = K * blk
 
-    def kern(s_ref, n2_ref, p1, p2, t1, t2, tbl_in, tbl_out):
-        i = pl.program_id(0)
-        blk_base = i * KBLK
+    def body(i, blk_base, n2_ref, p1, p2, t1, t2, tbl_in, tbl_out):
         dot = functools.partial(
             jax.lax.dot_general,
             dimension_numbers=(((1,), (0,)), ((), ())),
@@ -350,6 +441,18 @@ def _make_sweep_kernel(nwin: int, blk: int, u: int):
             acc1, w1 = half(p1, t1)
             tbl_out[:] = jnp.where(w1 > 0, acc1, tbl_in[:])
 
+    if sparse:
+
+        def kern_sparse(db_ref, s_ref, n2_ref, p1, p2, t1, t2, tbl_in, tbl_out):
+            i = pl.program_id(0)
+            body(i, db_ref[i] * KBLK, n2_ref, p1, p2, t1, t2, tbl_in, tbl_out)
+
+        return kern_sparse
+
+    def kern(s_ref, n2_ref, p1, p2, t1, t2, tbl_in, tbl_out):
+        i = pl.program_id(0)
+        body(i, i * KBLK, n2_ref, p1, p2, t1, t2, tbl_in, tbl_out)
+
     return kern
 
 
@@ -389,14 +492,93 @@ def _write_sweep(rows_tbl, new16, c: Claim2, blk: int, u: int):
         ],
         out_specs=pl.BlockSpec((blk, ROW), lambda i, s, n2: (i, 0)),
     )
-    with jax.enable_x64(False):
+    interpret = jax.default_backend() == "cpu"
+    with _sweep_x64_ctx(interpret):
         out = pl.pallas_call(
             _make_sweep_kernel(nwin, blk, u),
-            interpret=jax.default_backend() == "cpu",
+            interpret=interpret,
             out_shape=jax.ShapeDtypeStruct(rows_tbl.shape, rows_tbl.dtype),
             grid_spec=grid_spec,
             input_output_aliases={6: 0},
         )(s_blk, need2, pay_s, pay_s, tgt_eff, tgt_eff, rows_tbl)
+    return out
+
+
+def _write_sparse(rows_tbl, new16, c: Claim2, blk: int, u: int, g: int):
+    """Block-sparse Pallas write: launch the sweep grid ONLY over dirty
+    blocks, so the write's HBM traffic scales with the batch, not the table.
+
+    The dirty-block set — the ≤ min(batch, nblk) unique `target // (K·blk)`
+    values over WRITTEN rows — is computed in-trace (sort + unique, a few µs
+    of vector work against the ms-scale sweep it replaces) and handed to the
+    kernel as a scalar-prefetched block-index vector: grid step i DMAs block
+    `db[i]` in and out, composing its update run exactly like the dense
+    sweep. Unvisited blocks are untouched — `input_output_aliases` makes the
+    output buffer the donated input, so their rows simply persist.
+
+    Grid padding (g is static, the dirty count dynamic): padded steps target
+    a provably-CLEAN block — the smallest block id absent from the dirty set
+    (first index where the sorted unique list skips a value) — and compose an
+    empty run, i.e. rewrite that block's unchanged content. Padding steps all
+    name the SAME block and sit contiguously at the end of the sorted list,
+    so Pallas' revisit rule (consecutive equal block indices share one VMEM
+    buffer, fetched and flushed once) makes them write identical bytes — no
+    read-after-write hazard, unlike duplicate DIRTY blocks, which is why the
+    real entries are deduplicated rather than clamped."""
+    NB = rows_tbl.shape[0]
+    B = new16.shape[0]
+    nblk = NB // blk
+    KBLK = K * blk
+    nwin = B // u
+    assert nwin * u == B, f"batch {B} not divisible by window {u}"
+    assert g >= 1
+
+    pay_s = new16[c.order]  # the ONE payload gather: original → sorted order
+    tgt_eff = jnp.where(
+        c.written_sorted, c.tgt_sorted, jnp.int32(NB * K)
+    ).astype(i32)[:, None]
+    NBLK = jnp.int32(nblk)
+    # dirty block per written row; sentinel nblk otherwise (merges with the
+    # unique fill value — both mean "padding step")
+    blk_w = jnp.where(c.written_sorted, c.tgt_sorted // jnp.int32(KBLK), NBLK)
+    du = jnp.unique(blk_w, size=g, fill_value=nblk).astype(i32)
+    # free (clean) block for padding steps: du is sorted unique, so the
+    # first index i with du[i] > i is a block id absent from the dirty set
+    # (padding entries du[i] = nblk > i always qualify, so when any padding
+    # exists the min is < nblk; with zero written rows it degrades to 0)
+    idxg = jnp.arange(g, dtype=i32)
+    free = jnp.min(jnp.where(du > idxg, idxg, NBLK))
+    db = jnp.where(du >= NBLK, free, du)
+
+    starts = jnp.searchsorted(c.tgt_sorted, db * jnp.int32(KBLK)).astype(i32)
+    ends = jnp.searchsorted(
+        c.tgt_sorted, (db + 1) * jnp.int32(KBLK)
+    ).astype(i32)
+    s_blk = jnp.clip(starts // u, 0, nwin - 1)
+    need2 = (ends > (s_blk + 1) * u).astype(i32)
+
+    second = lambda i, db_, s, n2: (jnp.minimum(s[i] + 1, nwin - 1), 0)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(g,),
+        in_specs=[
+            pl.BlockSpec((u, F), lambda i, db_, s, n2: (s[i], 0)),
+            pl.BlockSpec((u, F), second),
+            pl.BlockSpec((u, 1), lambda i, db_, s, n2: (s[i], 0)),
+            pl.BlockSpec((u, 1), second),
+            pl.BlockSpec((blk, ROW), lambda i, db_, s, n2: (db_[i], 0)),
+        ],
+        out_specs=pl.BlockSpec((blk, ROW), lambda i, db_, s, n2: (db_[i], 0)),
+    )
+    interpret = jax.default_backend() == "cpu"
+    with _sweep_x64_ctx(interpret):
+        out = pl.pallas_call(
+            _make_sweep_kernel(nwin, blk, u, sparse=True),
+            interpret=interpret,
+            out_shape=jax.ShapeDtypeStruct(rows_tbl.shape, rows_tbl.dtype),
+            grid_spec=grid_spec,
+            input_output_aliases={7: 0},
+        )(db, s_blk, need2, pay_s, pay_s, tgt_eff, tgt_eff, rows_tbl)
     return out
 
 
@@ -420,10 +602,17 @@ def decide2_impl(
 
     `math="token"` compiles the token-only decision graph (no emulated-f64
     leaky lanes — see ops/math.bucket_math); the engine selects it per
-    dispatch after a host-side check that the batch carries no leaky row."""
+    dispatch after a host-side check that the batch carries no leaky row.
+    `write="sparse"` resolves per dispatch shape (resolve_write): the
+    block-sparse grid when its coverage is a small fraction of the table,
+    the full sweep otherwise."""
     B = req.fp.shape[0]
     NB = table.rows.shape[0]
-    blk, u = sweep_geometry(NB, B)
+    write = resolve_write(write, NB, B)
+    if write == "sparse":
+        blk, u, gsteps = sparse_geometry(NB, B)
+    else:
+        blk, u = sweep_geometry(NB, B)
     now = req.created_at
     active = req.active
 
@@ -487,6 +676,8 @@ def decide2_impl(
 
     if write == "sweep":
         rows_out = _write_sweep(table.rows, new16, c, blk, u)
+    elif write == "sparse":
+        rows_out = _write_sparse(table.rows, new16, c, blk, u, gsteps)
     else:
         rows_out = _write_xla(table.rows, new16, c)
 
@@ -622,7 +813,11 @@ def install2_impl(
 
     B = inst.fp.shape[0]
     NB = table.rows.shape[0]
-    blk, u = sweep_geometry(NB, B)
+    write = resolve_write(write, NB, B)
+    if write == "sparse":
+        blk, u, g = sparse_geometry(NB, B)
+    else:
+        blk, u = sweep_geometry(NB, B)
     c = _probe_claim2(table.rows, inst.fp, inst.now, inst.active, blk, u)
 
     is_token = inst.algo == int(Algorithm.TOKEN_BUCKET)
@@ -663,6 +858,8 @@ def install2_impl(
     )
     if write == "sweep":
         rows_out = _write_sweep(table.rows, new16, c, blk, u)
+    elif write == "sparse":
+        rows_out = _write_sparse(table.rows, new16, c, blk, u, g)
     else:
         rows_out = _write_xla(table.rows, new16, c)
     return Table2(rows=rows_out), inst.active & c.written

@@ -43,7 +43,7 @@ from gubernator_tpu.ops.kernel2 import (
 )
 from gubernator_tpu.ops.engine import default_write_mode
 from gubernator_tpu.ops.table2 import Table2
-from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_of
+from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_map_compat, shard_of
 
 i32 = jnp.int32
 i64 = jnp.int64
@@ -62,13 +62,13 @@ def pair_capacity(c: int, D: int) -> int:
     return p
 
 
-def make_a2a_decide(mesh: Mesh, c: int, math: str = "mixed"):
+def make_a2a_decide(mesh: Mesh, c: int, math: str = "mixed", write=None):
     """Jitted all-shards decide with ON-DEVICE routing: (Table2[D,·],
     (D, 12, c) arrival-order grid) → (Table2', (D, c+2, 4) packed outputs in
     arrival order). `c` rows per device; the per-pair exchange capacity
     derives from (c, mesh size) — pair_capacity is the single source of
     truth for the exchange geometry."""
-    write = default_write_mode()
+    write = write or default_write_mode()
     D = int(mesh.devices.size)
     C = pair_capacity(c, D)
 
@@ -137,7 +137,7 @@ def make_a2a_decide(mesh: Mesh, c: int, math: str = "mixed"):
         return expand(table), jnp.concatenate([out, stats_rows], axis=0)[None]
 
     spec = P(SHARD_AXIS)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         per_device, mesh=mesh, in_specs=(spec, spec),
         # check_vma=False: the Pallas sweep's out_shape carries no vma
         # annotation, which the checker (jax>=0.9) rejects inside shard_map

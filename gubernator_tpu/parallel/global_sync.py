@@ -55,7 +55,7 @@ from gubernator_tpu.ops.batch import (
 from gubernator_tpu.ops.kernel2 import decide2_impl, install2_impl
 from gubernator_tpu.ops.plan import plan_passes, _subset
 from gubernator_tpu.ops.table2 import Table2
-from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_of
+from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_map_compat, shard_of
 from gubernator_tpu.parallel.sharded import ShardedEngine, new_sharded_table
 from gubernator_tpu.types import (
     Behavior,
@@ -119,15 +119,19 @@ class PendingHits:
     def take(self, k: int):
         """Pop up to k entries → (config rows, hits, reset) columns.
 
-        Slice views, not fancy-index copies: a sync tick drains a deep
-        queue in Q/k rounds, and copying the remainder each round would
-        make the drain O(Q²) in queue depth."""
+        The POPPED columns are copies: the outbox builder stamps
+        hits/behavior/created_at into them in place, and a popped box that
+        shared storage with the accumulator would write through into
+        whatever still aliases the same base buffer. The REMAINDER stays a
+        slice view — a sync tick drains a deep queue in Q/k rounds, and
+        copying the remainder each round would make the drain O(Q²) in
+        queue depth (copying the popped k is O(Q) total)."""
         n = len(self)
         k = min(k, n)
         out = (
-            HostBatch(*[f[:k] for f in self.hb]),
-            self.hits[:k],
-            self.reset[:k],
+            HostBatch(*[f[:k].copy() for f in self.hb]),
+            self.hits[:k].copy(),
+            self.reset[:k].copy(),
         )
         if k == n:
             self.hb = self.hits = self.reset = None
@@ -136,6 +140,11 @@ class PendingHits:
             self.hits = self.hits[k:]
             self.reset = self.reset[k:]
         return out
+
+    def clear(self) -> None:
+        """Drop every pending entry (bench/test harness reset — modeling a
+        steady state where the sync tick keeps the accumulator drained)."""
+        self.hb = self.hits = self.reset = None
 
 
 @dataclass
@@ -258,10 +267,10 @@ def _sync_core(primary, replica, outbox: ReqBatch, me, D: int, write: str):
     return primary, replica, counters, bc
 
 
-def _mk_sync_step(mesh, n_shards: int, out_size: int):
+def _mk_sync_step(mesh, n_shards: int, out_size: int, write: Optional[str] = None):
     """Build the jitted single-round collective sync step."""
     D = n_shards
-    write = default_write_mode()
+    write = write or default_write_mode()
 
     def per_device(primary, replica, outbox: ReqBatch):
         primary = jax.tree.map(lambda x: x[0], primary)
@@ -278,7 +287,7 @@ def _mk_sync_step(mesh, n_shards: int, out_size: int):
         return expand(primary), expand(replica), counters[None], expand(bc)
 
     spec = P(SHARD_AXIS)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         per_device,
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -290,7 +299,9 @@ def _mk_sync_step(mesh, n_shards: int, out_size: int):
     return jax.jit(fn, donate_argnums=(0, 1))
 
 
-def _mk_sync_step_multi(mesh, n_shards: int, rounds: int):
+def _mk_sync_step_multi(
+    mesh, n_shards: int, rounds: int, write: Optional[str] = None
+):
     """Fused R-round sync step: a fori_loop over R stacked outboxes inside
     ONE launch. A deep drain (sync() after a burst) otherwise pays the
     put + launch + fetch transport cost per round — on RTT-bound links
@@ -301,7 +312,7 @@ def _mk_sync_step_multi(mesh, n_shards: int, rounds: int):
     per-round bc must reach the Store write-through, so they stay on the
     single-round path."""
     D = n_shards
-    write = default_write_mode()
+    write = write or default_write_mode()
 
     def per_device(primary, replica, outboxes: ReqBatch):
         primary = jax.tree.map(lambda x: x[0], primary)
@@ -328,7 +339,7 @@ def _mk_sync_step_multi(mesh, n_shards: int, rounds: int):
         return expand(primary), expand(replica), counters[None]
 
     spec = P(SHARD_AXIS)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         per_device,
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -364,6 +375,7 @@ class GlobalShardedEngine(ShardedEngine):
         created_at_tolerance_ms=None,
         store=None,
         route: str = "host",
+        write_mode: Optional[str] = None,
     ):
         super().__init__(
             mesh,
@@ -372,6 +384,7 @@ class GlobalShardedEngine(ShardedEngine):
             created_at_tolerance_ms=created_at_tolerance_ms,
             store=store,
             route=route,
+            write_mode=write_mode,
         )
         # the replica table + collective step materialize on first GLOBAL
         # use: clustered daemons route GLOBAL over the host peer plane and
@@ -394,7 +407,9 @@ class GlobalShardedEngine(ShardedEngine):
         if self.replica is None:
             self.replica = new_sharded_table(self.mesh, self._capacity_per_shard)
         if self._sync_step is None:
-            self._sync_step = _mk_sync_step(self.mesh, self.n_shards, self.sync_out)
+            self._sync_step = _mk_sync_step(
+                self.mesh, self.n_shards, self.sync_out, write=self.write_mode
+            )
 
     def _next_home(self) -> int:
         with self._rr_lock:
@@ -819,17 +834,24 @@ class GlobalShardedEngine(ShardedEngine):
 
     _SYNC_FUSE_CAP = 64  # max rounds per fused launch (bounds put size)
 
-    def _build_box(self, d: int, now: int) -> HostBatch:
-        """Pop ≤ sync_out entries of home `d` into one padded outbox."""
+    def _build_box(self, d: int, now: int):
+        """Pop ≤ sync_out entries of home `d` into one padded outbox.
+        Returns (box, popped) — `popped` is the raw (cfg, hits, reset)
+        columns removed from the accumulator (None when empty), kept so a
+        failed collective launch can re-merge them instead of losing the
+        hits (take() hands back copies, so the box's in-place stamping
+        below never writes through into them)."""
         OUT = self.sync_out
         k = min(len(self.pending[d]), OUT)
         if k:
-            cfg, hits, reset = self.pending[d].take(OUT)
+            popped = self.pending[d].take(OUT)
+            cfg, hits, reset = popped
             box = pad_batch(cfg, OUT)
             box.hits[:k] = hits
             box.behavior[:k] |= reset
             box.created_at[:k] = now
         else:
+            popped = None
             box = pad_batch(
                 HostBatch(
                     *[np.zeros(0, dtype=f.dtype)
@@ -837,7 +859,23 @@ class GlobalShardedEngine(ShardedEngine):
                 ),
                 OUT,
             )
-        return box
+        return box, popped
+
+    def _requeue_popped(self, popped, exc: BaseException) -> None:
+        """A collective sync launch failed AFTER the accumulators were
+        popped and the tables donated into the dead computation: re-merge
+        every popped box (`popped`: (home, (cfg, hits, reset)) pairs) so the
+        hits survive (the reference requeues failed owner sends rather than
+        dropping; service/global_manager.py does the same on the peer
+        plane), and poison the engine — the donated table/replica buffers
+        may be invalid, so serving must surface unhealthy (daemon
+        health_check) instead of answering from them."""
+        for d, (cfg, hits, reset) in popped:
+            self.pending[d].merge(
+                cfg, np.arange(cfg.fp.shape[0]), hits, reset
+            )
+        self.global_stats.send_queue_length = sum(len(p) for p in self.pending)
+        self.poisoned = f"GLOBAL collective sync launch failed: {exc}"
 
     def _sync_rounds_fused(self, rounds_needed: int, now_ms: Optional[int]) -> None:
         """Drain up to R rounds in ONE launch: stack R outboxes per device,
@@ -852,14 +890,18 @@ class GlobalShardedEngine(ShardedEngine):
         # padded rounds all carry the same all-inactive outbox — build it
         # once (np.stack copies on assembly, so sharing the object is safe)
         empty_box = None
+        popped = []  # (home, cfg/hits/reset) columns popped this drain
 
         def box(d: int) -> HostBatch:
             nonlocal empty_box
             if len(self.pending[d]) == 0:
                 if empty_box is None:
-                    empty_box = self._build_box(d, now)
+                    empty_box, _ = self._build_box(d, now)
                 return empty_box
-            return self._build_box(d, now)
+            b, p = self._build_box(d, now)
+            if p is not None:
+                popped.append((d, p))
+            return b
 
         boxes = [[box(d) for d in range(self.n_shards)] for _r in range(R)]
         stacked = HostBatch(
@@ -873,16 +915,22 @@ class GlobalShardedEngine(ShardedEngine):
                 for k in range(len(boxes[0][0]))
             ]
         )  # leaves (D, R, OUT)
-        dev = jax.tree.map(
-            lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding),
-            stacked,
-        )
         step = self._sync_multi.get(R)
         if step is None:
             step = self._sync_multi[R] = _mk_sync_step_multi(
-                self.mesh, self.n_shards, R
+                self.mesh, self.n_shards, R, write=self.write_mode
             )
-        self.table, self.replica, counters = step(self.table, self.replica, dev)
+        try:
+            dev = jax.tree.map(
+                lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding),
+                stacked,
+            )
+            self.table, self.replica, counters = step(
+                self.table, self.replica, dev
+            )
+        except Exception as exc:
+            self._requeue_popped(popped, exc)
+            raise
         c = np.asarray(counters)
         # count the rounds that carried work, not the pow2 padding — the
         # gubernator_mesh_sync_rounds series must read the same for
@@ -912,14 +960,24 @@ class GlobalShardedEngine(ShardedEngine):
         """One collective hit-sync + broadcast round."""
         self._ensure_global_plane()
         now = now_ms if now_ms is not None else ms_now()
-        boxes = [self._build_box(d, now) for d in range(self.n_shards)]
+        built = [self._build_box(d, now) for d in range(self.n_shards)]
+        boxes = [b for b, _p in built]
+        popped = [(d, p) for d, (_b, p) in enumerate(built) if p is not None]
         stacked = HostBatch(*[np.stack([b[k] for b in boxes]) for k in range(len(boxes[0]))])
-        dev_box = jax.tree.map(
-            lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding), stacked
-        )
-        self.table, self.replica, counters, bc = self._sync_step(
-            self.table, self.replica, dev_box
-        )
+        try:
+            dev_box = jax.tree.map(
+                lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding),
+                stacked,
+            )
+            self.table, self.replica, counters, bc = self._sync_step(
+                self.table, self.replica, dev_box
+            )
+        except Exception as exc:
+            # the popped hit boxes must survive a failed launch (ADVICE r5):
+            # re-merge them and mark the engine unhealthy — the donated
+            # tables went into the dead computation
+            self._requeue_popped(popped, exc)
+            raise
         c = np.asarray(counters)
         self.global_stats.sync_rounds += 1
         self.global_stats.broadcasts_applied += int(c[:, 0].sum())

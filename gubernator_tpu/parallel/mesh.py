@@ -18,6 +18,25 @@ from jax.sharding import Mesh
 SHARD_AXIS = "shard"
 
 
+def shard_map_compat(f, *, mesh: Mesh, in_specs, out_specs, check_vma: bool = True):
+    """`jax.shard_map` across the jax versions this repo runs on: new jax
+    exposes it top-level with the `check_vma` flag; 0.4.x has
+    `jax.experimental.shard_map.shard_map` where the same knob is named
+    `check_rep`. Every shard_map call site routes through here so version
+    drift stays in one place."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
 def make_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
     """1-D mesh over `n_devices` (default: all local devices)."""
     if devices is None:

@@ -63,21 +63,22 @@ from gubernator_tpu.ops.engine import (
 )
 from gubernator_tpu.ops.plan import _subset
 from gubernator_tpu.ops.table2 import Table2, new_table2
-from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_of
+from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_map_compat, shard_of
 from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
 
 
-def make_sharded_decide(mesh: Mesh, math: str = "mixed"):
+def make_sharded_decide(mesh: Mesh, math: str = "mixed", write: Optional[str] = None):
     """Build the jitted all-shards decision step over the SINGLE-TRANSFER
     packed layout: (Table2[D,·], (D, 12, b) i64 ingress grid) → (Table2',
     (D, b+2, 4) i64 packed outputs). Each device unpacks its ingress block
     in-kernel (kernel2.req_from_arr) and packs responses+stats on-device
     (kernel2.pack_outputs) — one host→device put and ONE device→host fetch
     per mesh dispatch, however many shards (the per-column transfer layout
-    cost 12 puts + 6 grid fetches per dispatch). Write mode is resolved once
-    at build time (Pallas sweep on TPU, XLA scatter on CPU test meshes);
+    cost 12 puts + 6 grid fetches per dispatch). Write mode defaults to the
+    backend's (block-sparse Pallas on TPU with per-shape sweep fallback, XLA
+    scatter on CPU test meshes) and is overridable for parity tests;
     `math` picks the token-only or mixed decision graph (engine._math_mode)."""
-    write = default_write_mode()
+    write = write or default_write_mode()
 
     def per_device(table: Table2, arr: jnp.ndarray):
         table = jax.tree.map(lambda x: x[0], table)
@@ -88,7 +89,7 @@ def make_sharded_decide(mesh: Mesh, math: str = "mixed"):
         return expand(table), packed[None]
 
     spec = P(SHARD_AXIS)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         per_device, mesh=mesh, in_specs=(spec, spec),
         # check_vma=False: the Pallas sweep's out_shape carries no vma
         # annotation, which the checker (jax>=0.9) rejects inside shard_map
@@ -97,10 +98,10 @@ def make_sharded_decide(mesh: Mesh, math: str = "mixed"):
     return jax.jit(fn, donate_argnums=(0,))
 
 
-def make_sharded_install(mesh: Mesh):
+def make_sharded_install(mesh: Mesh, write: Optional[str] = None):
     """All-shards install step for owner-authoritative GLOBAL statuses —
     the UpdatePeerGlobals receive path on a sharded daemon."""
-    write = default_write_mode()
+    write = write or default_write_mode()
 
     def per_device(table: Table2, inst: InstallBatch):
         table = jax.tree.map(lambda x: x[0], table)
@@ -110,7 +111,7 @@ def make_sharded_install(mesh: Mesh):
         return expand(table), expand(installed)
 
     spec = P(SHARD_AXIS)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         per_device, mesh=mesh, in_specs=(spec, spec),
         # check_vma=False: the Pallas sweep's out_shape carries no vma
         # annotation, which the checker (jax>=0.9) rejects inside shard_map
@@ -144,6 +145,7 @@ class ShardedEngine:
         created_at_tolerance_ms=None,
         store=None,
         route: str = "host",
+        write_mode: Optional[str] = None,
     ):
         if route not in ("host", "device"):
             raise ValueError(f"route must be 'host' or 'device', got {route!r}")
@@ -157,12 +159,20 @@ class ShardedEngine:
         # all_to_all exchange (parallel/a2a.py) — zero host routing work,
         # the multi-host-scale path
         self.route = route
+        # one write mode for every mesh step (decide, install, GLOBAL sync);
+        # None = the backend default (kernel2.resolve_write still falls the
+        # sparse mode back to the full sweep per dispatch shape)
+        self.write_mode = write_mode or default_write_mode()
         self._decide_fns = {}  # (kind, …, math) → jitted mesh step (lazy)
-        self._install = make_sharded_install(mesh)
+        self._install = make_sharded_install(mesh, write=self.write_mode)
         self._batch_sharding = NamedSharding(mesh, P(SHARD_AXIS))
         self.max_exact_passes = max_exact_passes
         self.store = store  # write-through hook (gubernator_tpu.store.Store)
         self.stats = EngineStats()
+        # set (with a reason) when a donated collective launch failed after
+        # state was popped/donated: the tables may be poisoned, serving must
+        # surface unhealthy (daemon health_check reads this)
+        self.poisoned: Optional[str] = None
 
     def check(
         self,
@@ -314,14 +324,15 @@ class ShardedEngine:
             fn = self._decide_fns.get(key)
             if fn is None:
                 fn = self._decide_fns[key] = make_a2a_decide(
-                    self.mesh, staged.c, math=staged.math
+                    self.mesh, staged.c, math=staged.math,
+                    write=self.write_mode,
                 )
         else:
             key = ("host", staged.math)
             fn = self._decide_fns.get(key)
             if fn is None:
                 fn = self._decide_fns[key] = make_sharded_decide(
-                    self.mesh, math=staged.math
+                    self.mesh, math=staged.math, write=self.write_mode
                 )
         return fn(table, staged.dev)
 

@@ -1077,6 +1077,12 @@ class Daemon:
             fatal.append(
                 f"this instance ({self.conf.advertise_address}) is not in the peer list"
             )
+        poisoned = getattr(self.engine, "poisoned", None)
+        if poisoned:
+            # a donated collective launch died mid-flight: the engine's
+            # device buffers are suspect, so this instance must read
+            # unhealthy even though the process is alive
+            fatal.append(f"engine poisoned: {poisoned}")
         if fatal:
             status = "unhealthy"
         elif errs or breaker_alarm:

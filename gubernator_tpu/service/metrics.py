@@ -44,7 +44,7 @@ class DaemonMetrics:
         for bad in sorted(flags - {"os", "python", "golang"}):
             logging.getLogger("gubernator_tpu.metrics").error(
                 "invalid flag %r for GUBER_METRIC_FLAGS; valid options are "
-                "['os', 'python']", bad,
+                "['os', 'python', 'golang']", bad,
             )
         if "os" in flags:
             from prometheus_client import process_collector

@@ -10,7 +10,12 @@ measured through (gubernator_tpu/ops/loop.py)."""
 import numpy as np
 import pytest
 
-from gubernator_tpu.bench_guard import MAX_SANE_RATE, check_work, slope
+from gubernator_tpu.bench_guard import (
+    MAX_SANE_RATE,
+    check_dropped,
+    check_work,
+    slope,
+)
 from gubernator_tpu.ops.kernel2 import decide2
 from gubernator_tpu.ops.loop import decide_loop, stack_batches
 from gubernator_tpu.ops.table2 import new_table2
@@ -93,6 +98,23 @@ def test_check_work():
     assert check_work(100, 100) is None
     r = check_work(99, 100)
     assert r is not None and "99" in r
+
+
+def test_check_dropped():
+    """Write-path proof of work: hit/miss reconciliation can't see a write
+    that probes rows but never persists them (dropped rows still count as
+    probed) — the drop guard can."""
+    # healthy window: zero or rare drops pass
+    assert check_dropped(0, 1_000_000) is None
+    assert check_dropped(9999, 1_000_000) is None
+    # a broken write path (e.g. a sparse grid landing updates in the wrong
+    # blocks) surfaces as a drop storm and must refuse the record
+    r = check_dropped(500_000, 1_000_000)
+    assert r is not None and "persist" in r
+    # tolerance is a knob (latency cases may tighten it)
+    assert check_dropped(2, 1000, max_frac=0.001) is not None
+    # degenerate windows don't divide by zero
+    assert check_dropped(0, 0) is None
 
 
 # ------------------------------------------------- on-device loop harness

@@ -347,3 +347,54 @@ def test_store_engine_sync_stays_serial(mesh, frozen_now):
     assert len(synced_fps) == 192
     assert len(set(synced_fps)) == 192
     assert store.touched_fps >= set(synced_fps)
+
+
+def test_sync_launch_failure_requeues_hits_and_poisons(mesh, frozen_now):
+    """A collective sync launch that dies AFTER the accumulators were popped
+    must not lose the hits (ADVICE r5): the popped boxes re-merge into
+    pending, and the engine is marked poisoned so health surfaces unhealthy
+    instead of serving from the donated (now-suspect) tables."""
+    eng = GlobalShardedEngine(mesh, capacity_per_shard=1024, sync_out=64)
+    t = frozen_now
+    for i in range(6):
+        eng.check([greq(f"rq{i}", hits=2, created_at=t)], now_ms=t,
+                  home_shard=i % 8)
+    queued_before = eng.global_stats.send_queue_length
+    assert queued_before == 6
+    # per-home breakdown must survive the failure round-trip exactly
+    pending_before = [len(p) for p in eng.pending]
+    per_key_hits = {
+        int(fp): int(h)
+        for p in eng.pending if len(p)
+        for fp, h in zip(p.hb.fp, p.hits)
+    }
+
+    eng._ensure_global_plane()
+
+    class Boom(RuntimeError):
+        pass
+
+    def dead_step(*_a, **_k):
+        raise Boom("donated launch died")
+
+    eng._sync_step = dead_step
+    with pytest.raises(Boom):
+        eng._sync_round(now_ms=t)
+
+    assert [len(p) for p in eng.pending] == pending_before
+    assert eng.global_stats.send_queue_length == queued_before
+    after = {
+        int(fp): int(h)
+        for p in eng.pending if len(p)
+        for fp, h in zip(p.hb.fp, p.hits)
+    }
+    assert after == per_key_hits
+    assert eng.poisoned is not None and "sync" in eng.poisoned
+
+    # a healthy step afterwards drains the re-merged hits (fresh engine
+    # state validates the re-merge kept well-formed columns)
+    eng._sync_step = None
+    eng._ensure_global_plane()
+    eng.sync(now_ms=t)
+    assert eng.global_stats.send_queue_length == 0
+    assert eng.global_stats.broadcasts_applied == 6

@@ -93,6 +93,39 @@ def test_empty_accumulator():
     assert len(p) == 0
 
 
+def test_clear_drops_everything():
+    """clear() is the harness-reset entry point (bench.py's steady-state
+    queue drain) — no more reaching into __slots__ private fields."""
+    p = PendingHits()
+    hb = hb_for([(f"k{i}", 1, 10, 0) for i in range(5)])
+    p.merge(hb, np.arange(5), hb.hits.copy(), np.zeros(5, dtype=np.int32))
+    assert len(p) == 5
+    p.clear()
+    assert len(p) == 0
+    assert p.hb is None and p.hits is None and p.reset is None
+    # cleared accumulator accepts fresh merges
+    p.merge(hb, np.arange(5), hb.hits.copy(), np.zeros(5, dtype=np.int32))
+    assert len(p) == 5
+
+
+def test_take_popped_columns_are_copies():
+    """The POPPED box must not share storage with the accumulator either
+    (the de-alias guarantee take() now makes): stamping the popped columns
+    in place — exactly what _build_box does — must never write through
+    into entries still queued, in either drain order."""
+    p = PendingHits()
+    hb = hb_for([(f"k{i}", 1, 10, 0) for i in range(8)])
+    p.merge(hb, np.arange(8), hb.hits.copy(), np.zeros(8, dtype=np.int32))
+    cfg, hits, reset = p.take(4)
+    assert not np.shares_memory(cfg.hits, p.hb.hits)
+    assert not np.shares_memory(hits, p.hits)
+    assert not np.shares_memory(reset, p.reset)
+    # full-drain pop of the remainder is also a copy (accumulator nulls out)
+    cfg2, hits2, _ = p.take(100)
+    cfg2.hits[:] = 123  # must be dead storage now
+    assert len(p) == 0
+
+
 def test_owner_marker_zero_hits_entry_kept():
     """Owner-side rows queue with hits=0 (broadcast markers) and must
     survive aggregation as entries — the sync round broadcasts them even
