@@ -469,6 +469,170 @@ def config5_case(rng, now) -> Case:
                 math="token")
 
 
+def _pipelined_checks(eng, cols_iter, now):
+    """Drive check batches through the engine's prepare/issue/finish split
+    with a depth-1 software pipeline — the serving loop the daemon's
+    EngineRunner runs across threads, single-threaded here: issue(N+1)
+    enqueues while N's outputs are still on-device, so the fetch leaves the
+    per-dispatch critical path. The serial check_columns loop paid host
+    stage + launch + fetch back-to-back per dispatch — on an RTT-bound
+    transport that is the whole config3 gap (BENCH_r05: 2412 ms/dispatch
+    vs ~10 ms of device time)."""
+    from gubernator_tpu.ops.engine import (
+        finish_check_columns,
+        issue_check_columns,
+        prepare_check_columns,
+    )
+
+    fixup = lambda fn: fn()
+    prev = None
+    for cols in cols_iter:
+        pending = issue_check_columns(
+            eng, prepare_check_columns(eng, cols, now_ms=now)
+        )
+        if prev is not None:
+            _rc, delta = finish_check_columns(eng, prev, fixup)
+            eng.stats.merge(delta)
+        prev = pending
+    if prev is not None:
+        _rc, delta = finish_check_columns(eng, prev, fixup)
+        eng.stats.merge(delta)
+
+
+def sharded_ingress_case(rng, now, batch=1 << 17) -> dict:
+    """Sharded-vs-local dispatch with the host-stage/device split (the
+    tentpole's proof surface): the mesh serving path (ShardedEngine at the
+    backend-default route/dedup — on-device a2a routing + in-trace dedup on
+    TPU) against LocalEngine on identical 131K-row batches at 1M and 10M
+    live keys. Reports per-dispatch wall ms through the pipelined split,
+    the mesh path's host-staging split (route/pack/put ms — the shard_*
+    stage_duration labels), and a batch-proportionality probe: host-stage
+    ms per dispatch at batch vs batch/8 must scale with ROWS, not live
+    keys, now that routing/dedup live in-trace and staging buffers persist.
+    On non-TPU backends runs a shrunken smoke through the identical code
+    path (ci/bench_cpu.py gates on the same figures)."""
+    from gubernator_tpu.ops.batch import RequestColumns
+    from gubernator_tpu.ops.engine import LocalEngine
+    from gubernator_tpu.parallel import make_mesh
+    from gubernator_tpu.parallel.sharded import ShardedEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        lives = [1 << 20, 10_000_000]
+        cap = 1 << 24
+        n_disp = 24
+    else:
+        lives = [8192]
+        cap = 1 << 15
+        batch = min(batch, 2048)
+        n_disp = 48  # small CPU dispatches need a longer window for the
+        # slope's dt floor
+
+    def cols_for(fps):
+        n = fps.shape[0]
+        return RequestColumns(
+            fp=fps,
+            algo=np.zeros(n, dtype=np.int32),
+            behavior=np.zeros(n, dtype=np.int32),
+            hits=np.ones(n, dtype=np.int64),
+            limit=np.full(n, 1 << 30, dtype=np.int64),
+            burst=np.zeros(n, dtype=np.int64),
+            duration=np.full(n, 3_600_000, dtype=np.int64),
+            created_at=np.full(n, now, dtype=np.int64),
+            err=np.zeros(n, dtype=np.int8),
+        )
+
+    mesh = make_mesh()
+    out: dict = {"batch": batch, "mesh_devices": int(mesh.devices.size)}
+    for live in lives:
+        keyspace = rng.integers(1, (1 << 63) - 1, size=live, dtype=np.int64)
+        perm = rng.permutation(live)
+        nb = max(1, live // batch)
+        staged = [
+            keyspace[perm[(i % nb) * batch : (i % nb) * batch + batch]]
+            for i in range(8)
+        ]
+        staged = [s for s in staged if s.shape[0] == batch]
+        entry: dict = {"live_keys": live}
+        sharded = ShardedEngine(
+            mesh, capacity_per_shard=max(1024, cap // int(mesh.devices.size))
+        )
+        local = LocalEngine(capacity=cap)
+        entry["route"] = sharded.route
+        entry["dedup"] = sharded.dedup
+        for name, eng in (("sharded", sharded), ("local", local)):
+            for i in range(0, live, batch):  # seed the live keyspace
+                eng.check_columns(cols_for(keyspace[i : i + batch]), now_ms=now)
+            _pipelined_checks(eng, (cols_for(staged[i % len(staged)])
+                                    for i in range(2)), now)  # warm
+
+            def timed(k, eng=eng):
+                t0 = time.perf_counter()
+                _pipelined_checks(
+                    eng,
+                    (cols_for(staged[i % len(staged)]) for i in range(k)),
+                    now,
+                )
+                return time.perf_counter() - t0
+
+            n_short, n_long = 2, 2 + n_disp
+            if hasattr(eng, "take_stage_deltas"):
+                eng.take_stage_deltas()  # reset the split to the timed window
+                d0 = eng.stage_dispatches
+            t_short = min(timed(n_short) for _ in range(3))
+            t_long = min(timed(n_long) for _ in range(3))
+            s = slope(t_short, t_long, n_short, n_long, batch, min_ratio=1.0)
+            rec: dict = {}
+            if s.reason is None:
+                rec["dispatch_ms"] = round(s.per_iter_ms, 3)
+                rec["decisions_per_sec"] = round(s.rate, 1)
+            else:
+                rec["invalid"] = s.reason
+            if hasattr(eng, "take_stage_deltas"):
+                stage = eng.take_stage_deltas()
+                nd = max(1, eng.stage_dispatches - d0)
+                rec["host_stage_ms"] = {
+                    k: round(v / nd, 3) for k, v in stage.items()
+                }
+                rec["host_stage_total_ms"] = round(
+                    sum(stage.values()) / nd, 3
+                )
+            # a drop storm would let a "fast" path publish while shedding
+            # work into retries (bench_guard gate, same as config6)
+            guard = check_dropped(
+                eng.stats.dropped, eng.stats.checks or 1
+            )
+            if guard:
+                rec["guard"] = guard
+            entry[name] = rec
+            log(f"[sharded-ingress:{live}] {name}: "
+                f"{rec.get('dispatch_ms', rec.get('invalid'))} ms/dispatch"
+                + (f", host stage {rec['host_stage_total_ms']} ms"
+                   if "host_stage_total_ms" in rec else ""))
+        # batch-proportionality probe on the mesh path: host-stage ms at
+        # batch/8 — in-trace dedup + persistent staging must make staging
+        # scale with rows shipped, not with the keyspace or a host sort
+        small = batch // 8
+        sharded.take_stage_deltas()
+        d0 = sharded.stage_dispatches
+        _pipelined_checks(
+            sharded,
+            (cols_for(staged[i % len(staged)][:small]) for i in range(6)),
+            now,
+        )
+        stage_small = sharded.take_stage_deltas()
+        nd = max(1, sharded.stage_dispatches - d0)
+        small_ms = sum(stage_small.values()) / nd
+        entry["host_stage_small_ms"] = round(small_ms, 3)
+        big_ms = entry["sharded"].get("host_stage_total_ms")
+        if big_ms:
+            # rows ratio is 8×; proportional staging keeps the cost ratio in
+            # the same decade, keyspace-bound staging would not move at all
+            entry["host_stage_big_vs_small"] = round(big_ms / max(small_ms, 1e-6), 2)
+        out[f"{live}"] = entry
+    return out
+
+
 def config3_global_case(rng, now, live=10_000_000, batch=1 << 17,
                         sync_out=16384) -> dict:
     """BASELINE config #3: GLOBAL behavior at 10M keys (8-peer cluster ↦
@@ -541,12 +705,36 @@ def config3_global_case(rng, now, live=10_000_000, batch=1 << 17,
                 p.clear()
 
     def timed(name, k):
+        # the daemon's serving loop, not the serial path: prepare/issue of
+        # dispatch N+1 overlaps the on-device execution and fetch of N
+        # (depth-1 software pipeline, cf. _pipelined_checks) — the serial
+        # check_columns loop measured transport round trips, not the path
+        # requests actually take through EngineRunner
+        from gubernator_tpu.ops.engine import (
+            finish_check_columns,
+            issue_check_columns,
+            prepare_check_columns,
+        )
+
         eng = engines[name]
         behavior = GLOBAL if name == "global" else 0
+        fixup = lambda fn: fn()
+        prev = None
         t0 = time.perf_counter()
         for i in range(k):
-            eng.check_columns(cols_for(staged[i % 8], behavior), now_ms=now)
+            pending = issue_check_columns(
+                eng,
+                prepare_check_columns(
+                    eng, cols_for(staged[i % 8], behavior), now_ms=now
+                ),
+            )
             drain_queue(eng)
+            if prev is not None:
+                _rc, delta = finish_check_columns(eng, prev, fixup)
+                eng.stats.merge(delta)
+            prev = pending
+        _rc, delta = finish_check_columns(eng, prev, fixup)
+        eng.stats.merge(delta)
         return time.perf_counter() - t0
 
     # INTERLEAVED timing: tunnel RTT drifts on the minutes scale, so
@@ -576,6 +764,15 @@ def config3_global_case(rng, now, live=10_000_000, batch=1 << 17,
         else:
             out[f"{name}_invalid"] = s.reason
             log(f"[config3-global] {name} slope rejected: {s.reason}")
+        # the mesh path's host-staging split (route/pack/put ms per
+        # dispatch, cumulative average — the shard_* stage_duration series)
+        eng = engines[name]
+        nd = max(1, eng.stage_dispatches)
+        out[f"{name}_host_stage_ms"] = {
+            k: round(v / nd, 3) for k, v in eng.stage_ms.items()
+        }
+        out[f"{name}_route"] = eng.route
+        out[f"{name}_dedup"] = eng.dedup
 
     # (b) collective sync: queue a few batches' worth of hits, then time
     # the FUSED drain (sync() runs R rounds per launch); the first pass is
@@ -1008,6 +1205,13 @@ def main() -> None:
     matrix["config3-global"] = _attempt(
         "config3-global",
         lambda: config3_global_case(np.random.default_rng(46), now),
+    )
+
+    # mesh-ingress phase: sharded vs local dispatch with the host-stage /
+    # device split at 1M/10M live keys (docs/latency.md "mesh ingress")
+    matrix["sharded-ingress"] = _attempt(
+        "sharded-ingress",
+        lambda: sharded_ingress_case(np.random.default_rng(49), now),
     )
 
     # latency phase (sweep vs sparse vs xla device terms per table size);

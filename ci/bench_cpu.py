@@ -7,7 +7,13 @@ TPU, so the gate measures the XLA-CPU lowering of the same serving path
 trees run in the SAME job and only their ratio matters — machine speed
 cancels out.
 
-Prints one JSON line: {"decisions_per_sec": N}.
+Also runs a sharded-dispatch ingress smoke on a virtual 8-device mesh
+(route="device" + in-trace dedup — the TPU serving default): regressions
+that re-grow the host staging cost with batch size (a reintroduced host
+group-by or argsort on the dispatch path) fail fast here, gated by
+bench_guard.check_dropped so a drop-storm can't masquerade as fast staging.
+
+Prints one JSON line: {"decisions_per_sec": N, "sharded_smoke": {...}}.
 """
 
 import json
@@ -19,7 +25,12 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# the ingress smoke needs a multi-device mesh; must be set before jax init
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
 import gubernator_tpu  # noqa: F401,E402  (x64 on)
+from gubernator_tpu.bench_guard import check_dropped
 from gubernator_tpu.ops.batch import RequestColumns
 from gubernator_tpu.ops.engine import LocalEngine
 
@@ -42,6 +53,63 @@ def cols(fp: np.ndarray) -> RequestColumns:
     )
 
 
+def sharded_smoke() -> dict:
+    """Ingress-path regression gate: host-stage ms per dispatch through the
+    device-routed, in-trace-dedup mesh path must stay batch-proportional.
+    Staging at 8× the rows may cost up to 8× (proportional) times slack —
+    a reintroduced keyspace-bound or super-linear host step (np.unique,
+    argsort routing, per-dispatch grid realloc at table scale) blows the
+    bound; flat-to-linear passes. check_dropped rejects a run that 'wins'
+    by shedding rows into terminal drops."""
+    from gubernator_tpu.parallel import make_mesh
+    from gubernator_tpu.parallel.sharded import ShardedEngine
+
+    mesh = make_mesh(8)
+    eng = ShardedEngine(
+        mesh, capacity_per_shard=1 << 12, write_mode="xla",
+        route="device", dedup="device",
+    )
+    rng = np.random.default_rng(1)
+    big, small = 4096, 512
+    fps = rng.integers(1, (1 << 63) - 1, size=big * 4, dtype=np.int64)
+    batches = {
+        n: [fps[i * n : (i + 1) * n] for i in range(4)] for n in (big, small)
+    }
+    for n in (small, big):  # compile + seed
+        for f in batches[n]:
+            eng.check_columns(cols(f), now_ms=NOW)
+
+    def stage_ms_per_dispatch(n: int, k: int = 12) -> float:
+        eng.take_stage_deltas()
+        d0 = eng.stage_dispatches
+        for i in range(k):
+            eng.check_columns(cols(batches[n][i % 4]), now_ms=NOW)
+        stage = eng.take_stage_deltas()
+        return sum(stage.values()) / max(1, eng.stage_dispatches - d0)
+
+    small_ms = min(stage_ms_per_dispatch(small) for _ in range(3))
+    big_ms = min(stage_ms_per_dispatch(big) for _ in range(3))
+    rows_ratio = big / small
+    SLACK = 4.0
+    ok = big_ms <= rows_ratio * SLACK * max(small_ms, 1e-4)
+    guard = check_dropped(eng.stats.dropped, max(1, eng.stats.checks))
+    out = {
+        "host_stage_small_ms": round(small_ms, 4),
+        "host_stage_big_ms": round(big_ms, 4),
+        "rows_ratio": rows_ratio,
+        "proportional": bool(ok),
+        "dropped_guard": guard or "ok",
+    }
+    if not ok:
+        print(json.dumps({"error": "sharded ingress host-stage cost is "
+                          "super-linear in batch rows", **out}))
+        sys.exit(1)
+    if guard:
+        print(json.dumps({"error": f"sharded smoke drop storm: {guard}", **out}))
+        sys.exit(1)
+    return out
+
+
 def main() -> None:
     eng = LocalEngine(capacity=1 << 15, write_mode="xla")
     rng = np.random.default_rng(0)
@@ -58,7 +126,10 @@ def main() -> None:
             eng.check_columns(cols(fps[i % 4]), now_ms=NOW)
         dt = time.perf_counter() - t0
         best = max(best, n_disp * B / dt)
-    print(json.dumps({"decisions_per_sec": round(best, 1)}))
+    print(json.dumps({
+        "decisions_per_sec": round(best, 1),
+        "sharded_smoke": sharded_smoke(),
+    }))
 
 
 if __name__ == "__main__":

@@ -175,10 +175,16 @@ class DaemonConfig:
     # (0 = fixed size like the reference's LRU; >0 = growth ceiling in slots)
     cache_max_size: int = 0
     engine: str = "local"  # "local" (one device) | "sharded" (mesh)
-    # sharded request routing: "host" (ownership grid built host-side) |
-    # "device" (arrival-order rows, on-mesh all_to_all exchange — the
-    # multi-host-scale path, parallel/a2a.py)
-    shard_route: str = "host"
+    # sharded request routing: "auto" (device on TPU backends, host
+    # elsewhere — parallel/sharded.default_shard_route) | "host" (ownership
+    # grid built host-side) | "device" (arrival-order rows, on-mesh
+    # all_to_all exchange — the multi-host-scale path, parallel/a2a.py)
+    shard_route: str = "auto"
+    # sharded duplicate-key handling: "auto" (device on TPU backends) |
+    # "host" (pass-planner group-by, exact sequential same-key semantics) |
+    # "device" (in-trace aggregation — hits summed, RESET OR-ed, newest
+    # config wins; O(1) host planning, kernel2.dedup_packed_cols)
+    shard_dedup: str = "auto"
     workers: int = 0  # 0 = auto; host-side executor width
 
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
@@ -326,9 +332,15 @@ class DaemonConfig:
             )
         if self.engine not in ("local", "sharded"):
             raise ConfigError(f"GUBER_ENGINE: must be local or sharded, got {self.engine!r}")
-        if self.shard_route not in ("host", "device"):
+        if self.shard_route not in ("auto", "host", "device"):
             raise ConfigError(
-                f"GUBER_SHARD_ROUTE: must be host or device, got {self.shard_route!r}"
+                f"GUBER_SHARD_ROUTE: must be auto, host or device, got "
+                f"{self.shard_route!r}"
+            )
+        if self.shard_dedup not in ("auto", "host", "device"):
+            raise ConfigError(
+                f"GUBER_SHARD_DEDUP: must be auto, host or device, got "
+                f"{self.shard_dedup!r}"
             )
         if self.cache_size <= 0:
             raise ConfigError("GUBER_CACHE_SIZE must be positive")
@@ -390,7 +402,8 @@ def setup_daemon_config(
         cache_size=_get_int(env, "GUBER_CACHE_SIZE", 50_000),
         cache_max_size=_get_int(env, "GUBER_CACHE_MAX_SIZE", 0),
         engine=_get(env, "GUBER_ENGINE", "local"),
-        shard_route=_get(env, "GUBER_SHARD_ROUTE", "host"),
+        shard_route=_get(env, "GUBER_SHARD_ROUTE", "auto"),
+        shard_dedup=_get(env, "GUBER_SHARD_DEDUP", "auto"),
         workers=_get_int(env, "GUBER_WORKER_COUNT", 0),
         behaviors=BehaviorConfig(
             batch_timeout_ms=_get_float_ms(env, "GUBER_BATCH_TIMEOUT", 500.0),

@@ -401,15 +401,24 @@ def pad_batch(b: HostBatch, to_size: int) -> HostBatch:
     )
 
 
-def pack_host_batch(b: HostBatch) -> np.ndarray:
+def pack_host_batch(b: HostBatch, out: Optional[np.ndarray] = None) -> np.ndarray:
     """Pack a HostBatch into ONE (12, B) int64 array for a single host→
     device transfer — the ingress mirror of kernel2.pack_outputs' single-
     fetch egress. On a tunneled device every device_put costs an RTT, so 12
     per-column puts dominated the dispatch-issue path; one put amortizes it.
     The device side reconstructs the ReqBatch inside the kernel's jit
-    (kernel2.req_from_arr), costing a few casts that fuse into the kernel."""
+    (kernel2.req_from_arr), costing a few casts that fuse into the kernel.
+
+    `out` lets the mesh engines pack straight into a persistent staging
+    buffer (parallel/sharded._StagingPool) — may be a strided view into the
+    pooled (D, 12, c) ingress grid, so no fresh (12, B) allocation and no
+    second scatter per dispatch."""
     n = b.fp.shape[0]
-    arr = np.empty((12, n), dtype=np.int64)
+    if out is None:
+        arr = np.empty((12, n), dtype=np.int64)
+    else:
+        assert out.shape == (12, n) and out.dtype == np.int64, out.shape
+        arr = out
     arr[0] = b.fp
     arr[1] = b.algo
     arr[2] = b.behavior

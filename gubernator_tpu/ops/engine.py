@@ -120,6 +120,16 @@ class EngineStats:
         self.unprocessed_dropped += d.unprocessed_dropped
 
 
+def _plan(engine, hb):
+    """One batch's pass plan: the engine's `plan` hook when it has one
+    (mesh engines aggregate duplicates in-trace and plan O(1) —
+    parallel/sharded.ShardedEngine.plan), else the host group-by planner."""
+    plan = getattr(engine, "plan", None)
+    if plan is not None:
+        return plan(hb)
+    return plan_passes(hb, max_exact=engine.max_exact_passes)
+
+
 def serve_columns(engine, cols, now_ms, dispatch) -> ResponseColumns:
     """The shared columns-in/columns-out serving loop: pack + clamp-count,
     plan same-key passes, dispatch each (member-row fan-out, ERR_DROPPED for
@@ -137,7 +147,7 @@ def serve_columns(engine, cols, now_ms, dispatch) -> ResponseColumns:
     limit_o = np.zeros(n, dtype=np.int64)
     remaining = np.zeros(n, dtype=np.int64)
     reset = np.zeros(n, dtype=np.int64)
-    for pi, p in enumerate(plan_passes(hb, max_exact=engine.max_exact_passes)):
+    for pi, p in enumerate(_plan(engine, hb)):
         np_ = len(p.rows)
         outs = dispatch(p.batch, np_)
         if pi == 0 and engine.store is not None:
@@ -284,7 +294,7 @@ def prepare_check_columns(engine, cols, now_ms=None) -> PendingCheck:
         ((cols.created_at != 0) & (hb.created_at != cols.created_at)).sum()
     )
     passes = []
-    for p in plan_passes(hb, max_exact=engine.max_exact_passes):
+    for p in _plan(engine, hb):
         n = len(p.rows)
         batch, staged = engine.stage_pass(p.batch, n)
         passes.append([p, n, batch, staged])

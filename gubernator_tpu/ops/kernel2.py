@@ -742,6 +742,12 @@ FLAG_DROPPED = 4
 # kernel stats row, so the engine counts their hit/miss/over outcome at the
 # retry that finally processes them
 FLAG_UNPROCESSED = 8
+# set on rows whose response was fanned out from a same-key aggregation
+# carrier by the in-trace dedup (dedup_packed_cols): such rows were merged
+# INTO the carrier before the kernel ran, so host-side hit/miss/over
+# accounting must skip them — exactly like the host planner's member rows,
+# which serve_columns answers from the aggregate without counting
+FLAG_MEMBER = 16
 
 
 def unpack_outputs(arr, n: int):
@@ -798,6 +804,113 @@ def decide2_packed_cols_impl(
 decide2_packed_cols = functools.partial(
     jax.jit, donate_argnums=(0,), static_argnames=("write", "math")
 )(decide2_packed_cols_impl)
+
+
+# --------------------------------------------------------- in-trace dedup
+#
+# The kernel's unique-fingerprint contract used to be discharged on the HOST:
+# plan_passes runs an O(n log n) numpy group-by over every batch before any
+# dispatch (ops/plan.py). On the mesh serving path that group-by sits on a
+# single Python process's critical path while D devices idle — the staging
+# bottleneck BENCH_r05 measured at 230× the device time. These helpers move
+# the duplicate-key aggregation INTO the traced program (sort + segment-sum,
+# the same vector recipe the GLOBAL collective already uses for cross-device
+# hit merging, parallel/global_sync._sync_core), so the host ships raw
+# arrival-order batches with zero planning work.
+#
+# Semantics: ALL duplicates aggregate — hits summed, RESET_REMAINING OR-ed,
+# newest request's config wins, and every member row is answered with the
+# aggregate's response (flagged FLAG_MEMBER). That is plan_passes'
+# aggregated-tail rule applied from occurrence 0, i.e. the reference's own
+# hot-key aggregation on the GLOBAL async path (global.go:109-123). The host
+# planner's exact per-occurrence sequential passes remain available as the
+# fallback and test oracle (ShardedEngine dedup="host" ≍ plan_passes;
+# dedup="device" ≍ plan_passes(max_exact=1)).
+
+RESET_REMAINING_BIT = 8  # Behavior.RESET_REMAINING (shared with ops/plan.py)
+
+
+def dedup_packed_cols(arr: jnp.ndarray):
+    """Aggregate duplicate fingerprints of a packed (12, n) ingress array
+    in-trace. Returns (deduped arr, carrier, member):
+
+    * deduped arr — same shape/order; each key's CARRIER row (its newest
+      member, plan_passes' config rule) stays active carrying the summed
+      hits and OR-ed RESET_REMAINING bit; all other duplicates are
+      deactivated (fp→0) so the kernel sees unique fingerprints;
+    * carrier — (n,) i32, each row's carrier index (itself when unique);
+    * member — (n,) bool, active rows whose response must be fanned out
+      from their carrier (fanout_packed).
+    """
+    fp = arr[0]
+    active = arr[11] != 0
+    n = fp.shape[0]
+    idx = jnp.arange(n, dtype=i32)
+    # inactive rows key to 0 (below every real fp, hashing.py keeps fps ≥ 1):
+    # they sort into one leading segment that no active row can join
+    key = jnp.where(active, fp, i64(0))
+    key_s, idx_s = jax.lax.sort((key, idx), num_keys=1)
+    first = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), key_s[1:] != key_s[:-1]]
+    )
+    seg = jnp.cumsum(first.astype(i32)) - 1
+    act_s = active[idx_s]
+    hits_s = jnp.where(act_s, arr[3][idx_s], i64(0))
+    seg_hits = jax.ops.segment_sum(hits_s, seg, num_segments=n)
+    reset_s = jnp.where(
+        act_s, arr[2][idx_s] & i64(RESET_REMAINING_BIT), i64(0)
+    )
+    seg_reset = jax.ops.segment_max(reset_s, seg, num_segments=n)
+    # carrier = newest member = max original index (plan.py: "newest member
+    # of each group carries the config")
+    seg_carrier = jax.ops.segment_max(
+        jnp.where(act_s, idx_s, i32(-1)), seg, num_segments=n
+    )
+    # un-sort each row's segment id back to original order
+    _, seg_u = jax.lax.sort((idx_s, seg), num_keys=1)
+    carrier = jnp.clip(seg_carrier[seg_u], 0, n - 1).astype(i32)
+    is_carrier = active & (carrier == idx)
+    member = active & ~is_carrier
+    ded = jnp.concatenate(
+        [
+            jnp.where(is_carrier, fp, i64(0))[None],
+            arr[1:2],
+            (arr[2] | seg_reset[seg_u])[None],
+            jnp.where(is_carrier, seg_hits[seg_u], i64(0))[None],
+            arr[4:11],
+            is_carrier.astype(i64)[None],
+        ],
+        axis=0,
+    )
+    return ded, carrier, member
+
+
+def fanout_packed(
+    packed: jnp.ndarray, carrier: jnp.ndarray, member: jnp.ndarray, n: int
+) -> jnp.ndarray:
+    """Fan each member row's response out from its aggregation carrier in
+    the packed (n+2, 4) output array, marking it FLAG_MEMBER so host-side
+    accounting skips it (the carrier already represents the whole group in
+    the kernel's stats rows)."""
+    rows = packed[:n]
+    fan = rows[carrier]
+    fan = fan.at[:, 3].set(fan[:, 3] | i64(FLAG_MEMBER))
+    rows = jnp.where(member[:, None], fan, rows)
+    return jnp.concatenate([rows, packed[n:]], axis=0)
+
+
+def decide2_packed_dedup_impl(
+    table: Table2, arr: jnp.ndarray, *, write: str = "sweep", math: str = "mixed"
+) -> Tuple[Table2, jnp.ndarray]:
+    """Single-transfer serving entry with IN-TRACE duplicate aggregation:
+    raw (possibly duplicate-keyed) packed ingress in, packed outputs out
+    with member rows answered from their aggregation carrier. The mesh
+    engines build their per-device programs on this when dedup="device"
+    (parallel/sharded.py, parallel/a2a.py), which lets the host skip
+    plan_passes entirely (ops/plan.single_pass)."""
+    ded, carrier, member = dedup_packed_cols(arr)
+    table, packed = decide2_packed_cols_impl(table, ded, write=write, math=math)
+    return table, fanout_packed(packed, carrier, member, arr.shape[1])
 
 
 # -------------------------------------------------------------------- install

@@ -40,6 +40,20 @@ def _subset(b: HostBatch, rows: np.ndarray) -> HostBatch:
     return HostBatch(*[f[rows] for f in b])
 
 
+def single_pass(b: HostBatch) -> List[Pass]:
+    """O(1) plan for engines that aggregate duplicate keys IN-TRACE
+    (kernel2.dedup_packed_cols, ShardedEngine dedup="device"): one pass, the
+    raw batch, no host group-by. The np.unique sweep below is the host-side
+    cost the mesh path eliminates — on a 131K-row dispatch the sort alone is
+    milliseconds of single-process work while every device idles. Member
+    fan-out happens on-device too (kernel2.fanout_packed), so member_rows
+    stays empty and each row comes back with its own (aggregate) response."""
+    act = np.nonzero(b.active)[0]
+    if act.size == b.fp.shape[0]:
+        return [Pass(rows=act, batch=b, member_rows=[])]
+    return [Pass(rows=act, batch=_subset(b, act), member_rows=[])]
+
+
 def plan_passes(b: HostBatch, max_exact: int = 8) -> List[Pass]:
     """Split a packed batch into unique-fingerprint passes. Rows with
     active=False (padding or per-request validation errors) are skipped."""

@@ -32,14 +32,19 @@ engine decodes both paths with the same machinery and ONE fetch.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from gubernator_tpu.ops.kernel2 import (
     FLAG_DROPPED,
+    FLAG_MEMBER,
     FLAG_UNPROCESSED,
     decide2_packed_cols_impl,
+    decide2_packed_dedup_impl,
+    dedup_packed_cols,
 )
 from gubernator_tpu.ops.engine import default_write_mode
 from gubernator_tpu.ops.table2 import Table2
@@ -49,32 +54,67 @@ i32 = jnp.int32
 i64 = jnp.int64
 
 
+def a2a_capacity_sigma() -> float:
+    """Multinomial tail bound for the per-pair exchange capacity
+    (GUBER_A2A_CAPACITY_SIGMA, default 5.0 standard deviations). Read
+    host-side at trace time like the sparse-write knobs, so tuning runs can
+    flip it between compiles without a restart. Lower values shrink the
+    exchanged (D, C) buffers (less ICI traffic per dispatch) at the price of
+    more capacity-overflow drops → engine retries; the overflow contract
+    (FLAG_DROPPED|FLAG_UNPROCESSED → retry, never a lost request) is pinned
+    by tests/test_a2a_capacity.py and does not change with the knob."""
+    return float(os.environ.get("GUBER_A2A_CAPACITY_SIGMA", "5.0"))
+
+
 def pair_capacity(c: int, D: int) -> int:
-    """Per-(src,dst) row capacity: mean + 5σ of the multinomial count of c
-    hash-routed rows over D destinations, pow2 for shape reuse. Overflow is
-    dropped → engine retry (a perf knob, not correctness), exactly like the
-    sweep's update-window bound (kernel2.sweep_geometry)."""
+    """Per-(src,dst) row capacity: mean + σ·sqrt(mean) of the multinomial
+    count of c hash-routed rows over D destinations (σ from
+    a2a_capacity_sigma, default 5) plus a small-c slack of 8, rounded up to
+    a power of two ≥ 8 for shape reuse. Overflow is dropped → engine retry
+    (a perf knob, not correctness), exactly like the sweep's update-window
+    bound (kernel2.sweep_geometry)."""
     mean = c / D
-    cap = int(mean + 5.0 * mean**0.5) + 8
+    cap = int(mean + a2a_capacity_sigma() * mean**0.5) + 8
     p = 8
     while p < cap:
         p *= 2
     return p
 
 
-def make_a2a_decide(mesh: Mesh, c: int, math: str = "mixed", write=None):
+def make_a2a_decide(
+    mesh: Mesh, c: int, math: str = "mixed", write=None, dedup: bool = False
+):
     """Jitted all-shards decide with ON-DEVICE routing: (Table2[D,·],
-    (D, 12, c) arrival-order grid) → (Table2', (D, c+2, 4) packed outputs in
-    arrival order). `c` rows per device; the per-pair exchange capacity
-    derives from (c, mesh size) — pair_capacity is the single source of
-    truth for the exchange geometry."""
+    (D, 12, c) arrival-order grid, (D, c+2, 4) recycled egress buffer) →
+    (Table2', (D, c+2, 4) packed outputs in arrival order). `c` rows per
+    device; the per-pair exchange capacity derives from (c, mesh size) —
+    pair_capacity is the single source of truth for the exchange geometry.
+
+    All three inputs are DONATED: the table advances in place as before, the
+    ingress grid's HBM is reclaimed at launch (the engine's staging pool
+    re-puts into it next dispatch instead of growing the heap), and the
+    egress buffer — a previous dispatch's already-fetched output, recycled
+    by the engine (ShardedEngine._take_egress) — aliases this dispatch's
+    output allocation, so steady-state serving allocates nothing.
+
+    `dedup=True` aggregates duplicate keys IN-TRACE at both ends of the
+    exchange (kernel2.dedup_packed_cols): once per source block before owner
+    computation — local duplicates collapse to one exchanged row, so a
+    Zipf-hot key costs ≤ 1 slot of each pair's capacity instead of flooding
+    its owner's — and once on the owner over the received rows, merging the
+    ≤ D cross-source carriers. Member rows answer from their carrier with
+    FLAG_MEMBER, exactly like the host-grid dedup program."""
     write = write or default_write_mode()
     D = int(mesh.devices.size)
     C = pair_capacity(c, D)
 
-    def per_device(table: Table2, arr: jnp.ndarray):
+    def per_device(table: Table2, arr: jnp.ndarray, out_buf: jnp.ndarray):
         table = jax.tree.map(lambda x: x[0], table)
         a = arr[0]  # (12, c) i64, arrival order
+        if dedup:
+            # source-local merge: duplicate keys within this device's block
+            # collapse onto their carrier; members deactivate (not sent)
+            a, carrier0, member0 = dedup_packed_cols(a)
         fp = a[0]
         active = a[11] != 0
         # mesh.shard_of traces fine on jnp values — one ownership hash
@@ -105,9 +145,17 @@ def make_a2a_decide(mesh: Mesh, c: int, math: str = "mixed", write=None):
         )  # (D, 12, C), leading = source device
         local = recv.transpose(1, 0, 2).reshape(12, D * C)
 
-        table, packed = decide2_packed_cols_impl(
-            table, local, write=write, math=math
-        )
+        if dedup:
+            # owner-side merge: the same key can arrive from up to D source
+            # carriers; aggregate them before the kernel (its unique-fp
+            # contract) and fan the response back to every received row
+            table, packed = decide2_packed_dedup_impl(
+                table, local, write=write, math=math
+            )
+        else:
+            table, packed = decide2_packed_cols_impl(
+                table, local, write=write, math=math
+            )
         resp = packed[: D * C].reshape(D, C, 4)
         stats_rows = packed[D * C :]  # (2, 4)
 
@@ -132,15 +180,31 @@ def make_a2a_decide(mesh: Mesh, c: int, math: str = "mixed", write=None):
         )
         out = jnp.where(sent[:, None], out, i64(0))
         out = out.at[:, 3].set(jnp.where(sent, out[:, 3], drop_flags))
+        if dedup:
+            # source-local members were never exchanged: they answer from
+            # their carrier's (aggregate) response. A capacity-dropped
+            # carrier hands its members the drop flags too, so the engine's
+            # retry re-dispatches the whole group and re-aggregates it.
+            fan = out[carrier0]
+            fan = fan.at[:, 3].set(fan[:, 3] | i64(FLAG_MEMBER))
+            out = jnp.where(member0[:, None], fan, out)
 
         expand = lambda t: jax.tree.map(lambda x: x[None], t)
         return expand(table), jnp.concatenate([out, stats_rows], axis=0)[None]
 
     spec = P(SHARD_AXIS)
     fn = shard_map_compat(
-        per_device, mesh=mesh, in_specs=(spec, spec),
+        per_device, mesh=mesh, in_specs=(spec, spec, spec),
         # check_vma=False: the Pallas sweep's out_shape carries no vma
         # annotation, which the checker (jax>=0.9) rejects inside shard_map
         out_specs=(spec, spec), check_vma=False
     )
-    return jax.jit(fn, donate_argnums=(0,))
+    # keep_unused: out_buf exists only to donate its buffer into the
+    # same-shape output allocation (XLA aliases donated inputs to outputs
+    # with matching shape/dtype); jit would otherwise prune the unused arg
+    # and drop the aliasing with it. Staging donation is TPU-only
+    # (sharded._staging_donate): XLA:CPU zero-copies host numpy buffers and
+    # donating memory it doesn't own corrupts the process.
+    from gubernator_tpu.parallel.sharded import _staging_donate
+
+    return jax.jit(fn, donate_argnums=_staging_donate(), keep_unused=True)

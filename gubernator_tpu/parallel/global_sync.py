@@ -53,7 +53,7 @@ from gubernator_tpu.ops.batch import (
     pad_batch,
 )
 from gubernator_tpu.ops.kernel2 import decide2_impl, install2_impl
-from gubernator_tpu.ops.plan import plan_passes, _subset
+from gubernator_tpu.ops.plan import _subset
 from gubernator_tpu.ops.table2 import Table2
 from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_map_compat, shard_of
 from gubernator_tpu.parallel.sharded import ShardedEngine, new_sharded_table
@@ -374,8 +374,9 @@ class GlobalShardedEngine(ShardedEngine):
         sync_out: int = 256,
         created_at_tolerance_ms=None,
         store=None,
-        route: str = "host",
+        route: Optional[str] = None,
         write_mode: Optional[str] = None,
+        dedup: Optional[str] = None,
     ):
         super().__init__(
             mesh,
@@ -385,6 +386,7 @@ class GlobalShardedEngine(ShardedEngine):
             store=store,
             route=route,
             write_mode=write_mode,
+            dedup=dedup,
         )
         # the replica table + collective step materialize on first GLOBAL
         # use: clustered daemons route GLOBAL over the host peer plane and
@@ -594,7 +596,7 @@ class GlobalShardedEngine(ShardedEngine):
         def plan_into(batch, table_attr, home_pin, rowmap):
             if not batch.active.any():
                 return
-            for p in plan_passes(batch, max_exact=self.max_exact_passes):
+            for p in self.plan(batch):
                 if len(p.rows) == 0:
                     continue
                 shard = (
@@ -767,7 +769,7 @@ class GlobalShardedEngine(ShardedEngine):
             table_attr == "table" and home is None
             and self.store is not None and now is not None
         )
-        for pi, p in enumerate(plan_passes(hb, max_exact=self.max_exact_passes)):
+        for pi, p in enumerate(self.plan(hb)):
             nrows = len(p.rows)
             batch = pad_batch(p.batch, _pad_size(nrows))
             shard = (

@@ -21,17 +21,38 @@ over shards stays near-multinomial even under Zipf-skewed traffic — per-shard
 padding is counts.max() over a balanced draw, not the hot key's count.
 
 Two routing modes (ShardedEngine(route=...), GUBER_SHARD_ROUTE):
-* "host" (default): the host sorts rows into the ownership grid — simple and
-  fast on a single-host mesh;
-* "device": the host ships rows in ARRIVAL order and the mesh itself routes
-  them with a capacity-bounded all_to_all exchange (parallel/a2a.py) — zero
-  per-dispatch host routing work, the path that scales to multi-host slices
-  where each host only feeds its local devices.
+* "host": the host sorts rows into the ownership grid — simple and fast on
+  a single-host mesh, and the exact-sequential-semantics fallback;
+* "device" (TPU default): the host ships rows in ARRIVAL order and the mesh
+  itself routes them with a capacity-bounded all_to_all exchange
+  (parallel/a2a.py) — zero per-dispatch host routing work, the path that
+  scales to multi-host slices where each host only feeds its local devices.
+
+Two dedup modes (ShardedEngine(dedup=...), GUBER_SHARD_DEDUP) decide WHERE
+the kernel's unique-fingerprint contract is discharged:
+* "host": the pass planner's numpy group-by (ops/plan.plan_passes) — exact
+  per-occurrence sequential semantics, O(n log n) single-process work on
+  every dispatch's critical path;
+* "device" (TPU default): duplicate keys aggregate IN-TRACE
+  (kernel2.dedup_packed_cols — hits summed, RESET_REMAINING OR-ed, newest
+  config wins, members answered from the carrier) and the host plans O(1)
+  (ops/plan.single_pass). Same semantics as plan_passes(max_exact=1), i.e.
+  the reference's GLOBAL hot-key aggregation applied from occurrence 0.
+
+Ingress/egress staging is persistent: packed grids build in a ring of
+reusable host buffers (_StagingPool), ship once, and are DONATED into the
+mesh step; the packed output allocation aliases a recycled egress buffer
+from an earlier dispatch (_take_egress). Steady-state serving therefore
+allocates no fresh host or device staging memory, and the prepare/issue/
+finish runner split double-buffers the ring: pack(N+1) fills one buffer
+while N's transfer drains another.
 """
 
 from __future__ import annotations
 
-from typing import List, NamedTuple, Optional, Sequence
+import threading
+import time
+from typing import Dict, List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -49,9 +70,11 @@ from gubernator_tpu.ops.batch import (
 from gubernator_tpu.ops.kernel2 import (
     FLAG_DROPPED,
     FLAG_HIT,
+    FLAG_MEMBER,
     FLAG_STATUS,
     FLAG_UNPROCESSED,
     decide2_packed_cols_impl,
+    decide2_packed_dedup_impl,
     install2_impl,
 )
 from gubernator_tpu.ops.engine import (
@@ -61,41 +84,83 @@ from gubernator_tpu.ops.engine import (
     default_write_mode,
     ms_now,
 )
-from gubernator_tpu.ops.plan import _subset
+from gubernator_tpu.ops.plan import _subset, plan_passes, single_pass
 from gubernator_tpu.ops.table2 import Table2, new_table2
 from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_map_compat, shard_of
 from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
 
 
-def make_sharded_decide(mesh: Mesh, math: str = "mixed", write: Optional[str] = None):
+def _staging_donate() -> tuple:
+    """donate_argnums for the (table, ingress grid, egress buffer) mesh
+    steps: everything on TPU — the ingress grid's HBM frees at launch, the
+    egress buffer aliases the output allocation — but table-only on CPU,
+    where device_put zero-copies aligned host numpy buffers and donating
+    memory XLA doesn't own corrupts or crashes the process."""
+    return (0, 1, 2) if jax.default_backend() == "tpu" else (0,)
+
+
+def default_shard_route() -> str:
+    """On-device routing (the a2a exchange) on real TPU meshes — zero host
+    routing work per dispatch, ICI does what the host argsort did; the host
+    ownership grid everywhere else (CPU test meshes keep the simple path
+    and the seed tests' exact shapes)."""
+    return "device" if jax.default_backend() == "tpu" else "host"
+
+
+def default_shard_dedup() -> str:
+    """In-trace duplicate aggregation on real TPU meshes — the host group-by
+    (plan_passes' np.unique) leaves the dispatch critical path; host
+    planning elsewhere, preserving exact sequential same-key semantics on
+    the CPU test meshes. Overridable per engine (dedup=) or daemon-wide
+    (GUBER_SHARD_DEDUP) — a TPU deployment that needs per-occurrence
+    sequential responses for duplicate keys within one batch sets "host"."""
+    return "device" if jax.default_backend() == "tpu" else "host"
+
+
+def make_sharded_decide(
+    mesh: Mesh, math: str = "mixed", write: Optional[str] = None,
+    dedup: bool = False,
+):
     """Build the jitted all-shards decision step over the SINGLE-TRANSFER
-    packed layout: (Table2[D,·], (D, 12, b) i64 ingress grid) → (Table2',
-    (D, b+2, 4) i64 packed outputs). Each device unpacks its ingress block
-    in-kernel (kernel2.req_from_arr) and packs responses+stats on-device
-    (kernel2.pack_outputs) — one host→device put and ONE device→host fetch
-    per mesh dispatch, however many shards (the per-column transfer layout
-    cost 12 puts + 6 grid fetches per dispatch). Write mode defaults to the
-    backend's (block-sparse Pallas on TPU with per-shape sweep fallback, XLA
-    scatter on CPU test meshes) and is overridable for parity tests;
-    `math` picks the token-only or mixed decision graph (engine._math_mode)."""
+    packed layout: (Table2[D,·], (D, 12, b) i64 ingress grid, (D, b+2, 4)
+    recycled egress buffer) → (Table2', (D, b+2, 4) i64 packed outputs).
+    Each device unpacks its ingress block in-kernel (kernel2.req_from_arr)
+    and packs responses+stats on-device (kernel2.pack_outputs) — one host→
+    device put and ONE device→host fetch per mesh dispatch, however many
+    shards (the per-column transfer layout cost 12 puts + 6 grid fetches
+    per dispatch). All inputs are DONATED: the ingress grid's HBM frees at
+    launch and the egress buffer (a previous dispatch's fetched output,
+    ShardedEngine._take_egress) aliases this dispatch's output allocation.
+    Write mode defaults to the backend's (block-sparse Pallas on TPU with
+    per-shape sweep fallback, XLA scatter on CPU test meshes) and is
+    overridable for parity tests; `math` picks the token-only or mixed
+    decision graph (engine._math_mode); `dedup` aggregates duplicate keys
+    in-trace (kernel2.decide2_packed_dedup_impl — duplicates share a
+    fingerprint, so the host grid colocates them on the owning device)."""
     write = write or default_write_mode()
 
-    def per_device(table: Table2, arr: jnp.ndarray):
+    def per_device(table: Table2, arr: jnp.ndarray, out_buf: jnp.ndarray):
         table = jax.tree.map(lambda x: x[0], table)
-        table, packed = decide2_packed_cols_impl(
-            table, arr[0], write=write, math=math
-        )
+        impl = decide2_packed_dedup_impl if dedup else decide2_packed_cols_impl
+        table, packed = impl(table, arr[0], write=write, math=math)
         expand = lambda t: jax.tree.map(lambda x: x[None], t)
         return expand(table), packed[None]
 
     spec = P(SHARD_AXIS)
     fn = shard_map_compat(
-        per_device, mesh=mesh, in_specs=(spec, spec),
+        per_device, mesh=mesh, in_specs=(spec, spec, spec),
         # check_vma=False: the Pallas sweep's out_shape carries no vma
         # annotation, which the checker (jax>=0.9) rejects inside shard_map
         out_specs=(spec, spec), check_vma=False
     )
-    return jax.jit(fn, donate_argnums=(0,))
+    # keep_unused: out_buf exists only to donate its allocation into the
+    # same-shape output (XLA aliases donated inputs to matching outputs);
+    # jit would otherwise prune the unused arg and the aliasing with it.
+    # Staging donation is TPU-only: XLA:CPU zero-copies host numpy buffers
+    # into device arrays, and donating memory the process still owns
+    # segfaults / corrupts advanced tables (CPU meshes donate the table
+    # alone, the seed behavior).
+    return jax.jit(fn, donate_argnums=_staging_donate(), keep_unused=True)
 
 
 def make_sharded_install(mesh: Mesh, write: Optional[str] = None):
@@ -118,6 +183,41 @@ def make_sharded_install(mesh: Mesh, write: Optional[str] = None):
         out_specs=(spec, spec), check_vma=False
     )
     return jax.jit(fn, donate_argnums=(0,))
+
+
+class _StagingPool:
+    """Ring of persistent host-side staging buffers, keyed by shape.
+
+    Per-dispatch ingress staging used to allocate (and zero) a fresh
+    (D, 12, b) grid — 12+ MB of alloc + fault-in on every 131K-row mesh
+    dispatch. The pool hands out the same `depth` buffers round-robin per
+    shape instead: pages stay warm, the allocator never churns, and callers
+    only rewrite the bytes the batch actually covers. `depth` must cover
+    the pipeline's in-flight bound (a buffer is only rewritten after the
+    dispatch that device_put it has been issued `depth` dispatches ago —
+    the same staging-lifetime assumption the runner's double-buffered
+    prepare/issue/finish split already makes)."""
+
+    def __init__(self, depth: int = 6):
+        self.depth = depth
+        self._rings: Dict[tuple, list] = {}
+        self._lock = threading.Lock()  # stage_pass runs on concurrent prep threads
+
+    def get(self, shape: tuple, zero: bool = False) -> np.ndarray:
+        with self._lock:
+            ring = self._rings.get(shape)
+            if ring is None:
+                ring = self._rings[shape] = [[], 0]
+            bufs, idx = ring
+            if len(bufs) < self.depth:
+                buf = np.zeros(shape, dtype=np.int64)  # fresh → already zero
+                bufs.append(buf)
+                return buf
+            ring[1] = idx + 1
+            buf = bufs[idx % self.depth]
+        if zero:
+            buf.fill(0)
+        return buf
 
 
 def new_sharded_table(mesh: Mesh, capacity_per_shard: int) -> Table2:
@@ -144,11 +244,16 @@ class ShardedEngine:
         max_exact_passes: int = 8,
         created_at_tolerance_ms=None,
         store=None,
-        route: str = "host",
+        route: Optional[str] = None,
         write_mode: Optional[str] = None,
+        dedup: Optional[str] = None,
     ):
+        route = route or default_shard_route()
         if route not in ("host", "device"):
             raise ValueError(f"route must be 'host' or 'device', got {route!r}")
+        dedup = dedup or default_shard_dedup()
+        if dedup not in ("host", "device"):
+            raise ValueError(f"dedup must be 'host' or 'device', got {dedup!r}")
         self.mesh = mesh
         # per-engine clock-skew bound; None = the ops.batch process default
         self.created_at_tolerance_ms = created_at_tolerance_ms
@@ -157,8 +262,13 @@ class ShardedEngine:
         # routing mode: "host" sorts rows into an ownership grid on the host;
         # "device" ships arrival-order rows and routes on-mesh with an
         # all_to_all exchange (parallel/a2a.py) — zero host routing work,
-        # the multi-host-scale path
+        # the multi-host-scale path (default on TPU backends)
         self.route = route
+        # dedup mode: where the kernel's unique-fingerprint contract is
+        # discharged — "host" = plan_passes group-by (exact sequential
+        # same-key semantics), "device" = in-trace aggregation + O(1) host
+        # planning (module docstring; default on TPU backends)
+        self.dedup = dedup
         # one write mode for every mesh step (decide, install, GLOBAL sync);
         # None = the backend default (kernel2.resolve_write still falls the
         # sparse mode back to the full sweep per dispatch shape)
@@ -169,6 +279,27 @@ class ShardedEngine:
         self.max_exact_passes = max_exact_passes
         self.store = store  # write-through hook (gubernator_tpu.store.Store)
         self.stats = EngineStats()
+        # persistent ingress staging (module docstring). CPU backends MUST
+        # NOT pool: XLA:CPU zero-copies an aligned numpy buffer into the
+        # device array, so with donation the advanced TABLE can end up
+        # aliased into pool memory a later dispatch rewrites (observed as
+        # corrupted remaining counts on the 8-device test mesh). TPU
+        # host→HBM transfers always copy, which is what makes buffer reuse
+        # sound there — exactly where the alloc+zero cost matters.
+        self._pool: Optional[_StagingPool] = (
+            _StagingPool() if jax.default_backend() != "cpu" else None
+        )
+        # recycled egress buffers per output shape: finish hands fetched
+        # output arrays back, _take_egress donates them into the next
+        # same-shape dispatch where XLA aliases the output allocation
+        self._egress: Dict[tuple, list] = {}
+        self._egress_lock = threading.Lock()
+        # host-staging cost accounting (the bench's host-stage/device split
+        # and the shard_* stage_duration series): cumulative ms per stage
+        self.stage_ms = {"route": 0.0, "pack": 0.0, "put": 0.0}
+        self.stage_dispatches = 0
+        self._stage_taken = dict(self.stage_ms)
+        self._stage_lock = threading.Lock()
         # set (with a reason) when a donated collective launch failed after
         # state was popped/donated: the tables may be poisoned, serving must
         # surface unhealthy (daemon health_check reads this)
@@ -214,6 +345,57 @@ class ShardedEngine:
             return vals
 
         return serve_columns(self, cols, now_ms, dispatch)
+
+    def plan(self, hb: HostBatch):
+        """Pass plan for one packed batch (serve_columns/prepare hook):
+        O(1) when duplicates aggregate in-trace, the host group-by planner
+        otherwise (exact sequential same-key semantics — fallback/oracle)."""
+        if self.dedup == "device":
+            return single_pass(hb)
+        return plan_passes(hb, max_exact=self.max_exact_passes)
+
+    # -------------------------------------------- staging cost accounting
+
+    def _stage_time(self, key: str, dt_s: float) -> None:
+        with self._stage_lock:
+            self.stage_ms[key] += dt_s * 1e3
+
+    def take_stage_deltas(self) -> Dict[str, float]:
+        """Host-staging ms per stage since the last take (EngineRunner
+        feeds these into the shard_* stage_duration series)."""
+        with self._stage_lock:
+            d = {
+                k: self.stage_ms[k] - self._stage_taken[k]
+                for k in self.stage_ms
+            }
+            self._stage_taken = dict(self.stage_ms)
+        return d
+
+    # ------------------------------------------------ egress buffer recycling
+
+    def _take_egress(self, shape: tuple):
+        """A donated egress buffer for one mesh dispatch: a previously
+        fetched output array of the same shape when one is banked (its
+        allocation will alias the new output), else a fresh zeroed grid
+        (first dispatches of a shape, before the ring primes)."""
+        with self._egress_lock:
+            bank = self._egress.get(shape)
+            if bank:
+                return bank.pop()
+        return jax.device_put(
+            np.zeros(shape, dtype=np.int64), self._batch_sharding
+        )
+
+    def _recycle_egress(self, out) -> None:
+        """Bank a fetched output array for reuse as a donated egress buffer.
+        Fused multi-pass fetches hand finish_staged a numpy slice instead of
+        the device array (engine._stack_pass_outputs) — nothing to bank."""
+        if isinstance(out, np.ndarray):
+            return
+        with self._egress_lock:
+            bank = self._egress.setdefault(out.shape, [])
+            if len(bank) < 8:
+                bank.append(out)
 
     def install_columns(
         self,
@@ -317,6 +499,7 @@ class ShardedEngine:
         return pass_batch, staged
 
     def _decide(self, table: Table2, staged):
+        dedup = self.dedup == "device"
         if isinstance(staged, _StagedA2A):
             from gubernator_tpu.parallel.a2a import make_a2a_decide
 
@@ -325,16 +508,20 @@ class ShardedEngine:
             if fn is None:
                 fn = self._decide_fns[key] = make_a2a_decide(
                     self.mesh, staged.c, math=staged.math,
-                    write=self.write_mode,
+                    write=self.write_mode, dedup=dedup,
                 )
+            rows = staged.c
         else:
             key = ("host", staged.math)
             fn = self._decide_fns.get(key)
             if fn is None:
                 fn = self._decide_fns[key] = make_sharded_decide(
-                    self.mesh, math=staged.math, write=self.write_mode
+                    self.mesh, math=staged.math, write=self.write_mode,
+                    dedup=dedup,
                 )
-        return fn(table, staged.dev)
+            rows = staged.b_local
+        out_buf = self._take_egress((self.n_shards, rows + 2, 4))
+        return fn(table, staged.dev, out_buf)
 
     def issue_staged(self, staged: "_Staged", batch_rows: int):
         # dispatch count is folded in via the finish delta (engine thread)
@@ -344,12 +531,15 @@ class ShardedEngine:
 
     def finish_staged(self, pending, n: int):
         staged, out = pending
-        s, l, r, t, dropped, hit, unproc, evicted = self._unroute(
-            staged, np.asarray(out), n
+        outh = np.asarray(out)
+        self._recycle_egress(out)
+        s, l, r, t, dropped, hit, unproc, member, evicted = self._unroute(
+            staged, outh, n
         )
         # per-row accounting over the rows the kernel actually processed
-        # (pass rows are all active; a2a capacity drops count at their retry)
-        counted = ~unproc
+        # (pass rows are all active; a2a capacity drops count at their
+        # retry; dedup member rows are represented by their carrier)
+        counted = ~unproc & ~member
         st = (
             int(hit[counted].sum()),
             int((~hit[counted]).sum()),
@@ -375,42 +565,74 @@ class ShardedEngine:
         b_local) ownership grid. route="device": NO routing work — rows ship
         in arrival order and the mesh exchanges them over ICI
         (parallel/a2a.py). Explicit `shard` pins (the GLOBAL replica path)
-        always take the host grid: a2a routes by ownership hash only."""
+        always take the host grid: a2a routes by ownership hash only.
+        Grids build in the persistent staging ring (_StagingPool) and each
+        phase's host cost accumulates into stage_ms (route/pack/put)."""
         if self.route == "device" and shard is None:
             return self._stage_a2a(batch)
         D = self.n_shards
+        t0 = time.perf_counter()
         routed = shard if shard is not None else shard_of(batch.fp, D)
         order, rs, offset, b_local = _route_plan(routed, D)
+        t1 = time.perf_counter()
         packed = pack_host_batch(batch)  # (12, n)
-        grid = np.zeros((D, 12, b_local), dtype=np.int64)
+        shape = (D, 12, b_local)
+        grid = (
+            self._pool.get(shape, zero=True)
+            if self._pool is not None
+            else np.zeros(shape, dtype=np.int64)
+        )
         grid[rs, :, offset] = packed[:, order].T
+        t2 = time.perf_counter()
         dev = jax.device_put(grid, self._batch_sharding)
+        t3 = time.perf_counter()
+        self._stage_time("route", t1 - t0)
+        self._stage_time("pack", t2 - t1)
+        self._stage_time("put", t3 - t2)
+        with self._stage_lock:
+            self.stage_dispatches += 1
         return _Staged(
             order=order, rs=rs, offset=offset, b_local=b_local, dev=dev,
             math=_math_mode(batch),
         )
 
     def _stage_a2a(self, batch: HostBatch) -> "_StagedA2A":
-        """Arrival-order staging: reshape the packed columns into (D, 12, c)
-        — row i lands on device i // c. O(1) routing work on the host."""
+        """Arrival-order staging: pack the columns straight into a pooled
+        (12, D·c) flat buffer and strided-copy it into the pooled (D, 12, c)
+        ingress grid — row i lands on device i // c. O(1) routing work on
+        the host, zero fresh allocations in steady state."""
         D = self.n_shards
         n = batch.fp.shape[0]
         c = _pad_size(max(1, -(-n // D)), floor=8)
-        packed = pack_host_batch(batch)  # (12, n)
-        padded = np.zeros((12, D * c), dtype=np.int64)
-        padded[:, :n] = packed
-        grid = np.ascontiguousarray(
-            padded.reshape(12, D, c).transpose(1, 0, 2)
-        )
+        t0 = time.perf_counter()
+        if self._pool is not None:
+            flat = self._pool.get((12, D * c))
+            flat[:, n:] = 0  # stale tail from the buffer's last use
+            grid = self._pool.get((D, 12, c))
+        else:
+            flat = np.zeros((12, D * c), dtype=np.int64)
+            grid = np.empty((D, 12, c), dtype=np.int64)
+        pack_host_batch(batch, out=flat[:, : n])
+        # one strided copy rearranges (12, D·c) → (D, 12, c); every grid
+        # byte is overwritten, so the pooled buffer needs no zeroing
+        np.copyto(grid, flat.reshape(12, D, c).transpose(1, 0, 2))
+        t1 = time.perf_counter()
         dev = jax.device_put(grid, self._batch_sharding)
+        t2 = time.perf_counter()
+        self._stage_time("pack", t1 - t0)
+        self._stage_time("put", t2 - t1)
+        with self._stage_lock:
+            self.stage_dispatches += 1
         return _StagedA2A(c=c, dev=dev, math=_math_mode(batch))
 
     def _unroute(self, staged, outh: np.ndarray, n: int):
         """Decode the fetched (D, rows+2, 4) packed output grid back to
         pass-row order: per-row responses, the `unprocessed` mask (rows the
-        a2a exchange capacity-dropped before they reached the kernel), and
-        the summed per-device evicted_unexpired (the only stat that cannot
-        be derived per row). Flag bits shared with the single-device decoder
+        a2a exchange capacity-dropped before they reached the kernel), the
+        `member` mask (rows answered from an in-trace dedup carrier —
+        excluded from per-row accounting), and the summed per-device
+        evicted_unexpired (the only stat that cannot be derived per row).
+        Flag bits shared with the single-device decoder
         (kernel2.FLAG_*/unpack_outputs)."""
         if isinstance(staged, _StagedA2A):
             st = outh[:, staged.c, :].sum(axis=0)
@@ -423,9 +645,10 @@ class ShardedEngine:
         hit = (per[:, 3] & FLAG_HIT) != 0
         dropped = (per[:, 3] & FLAG_DROPPED) != 0
         unproc = (per[:, 3] & FLAG_UNPROCESSED) != 0
+        member = (per[:, 3] & FLAG_MEMBER) != 0
         return (
             status, per[:, 0], per[:, 1], per[:, 2], dropped, hit, unproc,
-            int(st[3]),
+            member, int(st[3]),
         )
 
     def _dispatch(
@@ -456,12 +679,14 @@ class ShardedEngine:
         table, out = self._decide(getattr(self, table_attr), staged)
         setattr(self, table_attr, table)
         self.stats.dispatches += 1
-        status, limit, remaining, reset, dropped, hit, unproc, evicted = (
-            self._unroute(staged, np.asarray(out), n)
+        outh = np.asarray(out)
+        self._recycle_egress(out)
+        status, limit, remaining, reset, dropped, hit, unproc, member, evicted = (
+            self._unroute(staged, outh, n)
         )
         if count is None:
             count = np.asarray(batch.active) if depth == 0 else np.zeros(n, bool)
-        counted = count & ~unproc
+        counted = count & ~unproc & ~member
         self.stats.cache_hits += int(hit[counted].sum())
         self.stats.cache_misses += int((~hit[counted]).sum())
         self.stats.over_limit += int((status[counted] == 1).sum())

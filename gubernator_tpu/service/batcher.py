@@ -16,7 +16,8 @@ from __future__ import annotations
 
 import asyncio
 import time
-from typing import List, Optional, Tuple
+from collections import deque
+from typing import Deque, Optional, Tuple
 
 import numpy as np
 
@@ -51,7 +52,11 @@ class Batcher:
         self.batch_wait_s = batch_wait_ms / 1e3
         self.coalesce_limit = coalesce_limit
         self.metrics = metrics
-        self._pending: List[Tuple[RequestColumns, asyncio.Future, float]] = []
+        # deque: _flush pops from the head per coalesced chunk — a list's
+        # pop(0) is O(n) per pop, O(n²) across a backlog drain
+        self._pending: Deque[Tuple[RequestColumns, asyncio.Future, float]] = (
+            deque()
+        )
         self._pending_rows = 0
         self._wake: Optional[asyncio.Event] = None
         self._loop_task: Optional[asyncio.Task] = None
@@ -112,24 +117,26 @@ class Batcher:
             await self._inflight_sem.acquire()
             if not self._pending:  # drained while waiting for the slot
                 self._inflight_sem.release()
-                return
-            chunk = [self._pending.pop(0)]
+                break
+            chunk = [self._pending.popleft()]
             rows = chunk[0][0].fp.shape[0]
             while (
                 self._pending
                 and rows + self._pending[0][0].fp.shape[0] <= self.coalesce_limit
             ):
-                entry = self._pending.pop(0)
+                entry = self._pending.popleft()
                 chunk.append(entry)
                 rows += entry[0].fp.shape[0]
             self._pending_rows -= rows
-            if self.metrics is not None:
-                self.metrics.queue_length.set(max(self._pending_rows, 0))
             task = asyncio.get_running_loop().create_task(
                 self._dispatch_guarded(chunk)
             )
             self._inflight.add(task)
             task.add_done_callback(self._inflight.discard)
+        # one clamped gauge update per flush, after the chunk loop — per-chunk
+        # sets only churned the gauge with intermediate values
+        if self.metrics is not None:
+            self.metrics.queue_length.set(max(self._pending_rows, 0))
 
     async def _dispatch_guarded(self, chunk) -> None:
         try:

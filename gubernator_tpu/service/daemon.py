@@ -118,7 +118,10 @@ class Daemon:
                 capacity_per_shard=max(1, conf.cache_size // n_dev),
                 created_at_tolerance_ms=int(conf.created_at_tolerance_ms),
                 store=store,
-                route=conf.shard_route,
+                # "auto" = the backend default (device routing + in-trace
+                # dedup on TPU meshes, host grid + pass planner elsewhere)
+                route=None if conf.shard_route == "auto" else conf.shard_route,
+                dedup=None if conf.shard_dedup == "auto" else conf.shard_dedup,
             )
         else:
             self.engine = LocalEngine(

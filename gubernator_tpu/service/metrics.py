@@ -118,7 +118,11 @@ class DaemonMetrics:
         self.stage_duration = Summary(
             "gubernator_tpu_stage_duration",
             "Seconds per serving-pipeline stage",
-            ["stage"],  # parse | queue | put | issue | fetch | encode
+            # parse | queue | put | issue | fetch | encode, plus the mesh
+            # ingress host-staging split shard_route | shard_pack |
+            # shard_put (ShardedEngine host work per dispatch — route plan,
+            # grid pack, device transfer; docs/latency.md "mesh ingress")
+            ["stage"],
             registry=r,
         )
         self.dropped_rows = Counter(

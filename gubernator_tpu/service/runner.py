@@ -83,6 +83,7 @@ class EngineRunner:
                 self.metrics.stage_duration.labels(stage="put").observe(
                     time.perf_counter() - t0
                 )
+                self._observe_shard_stages()
             return prepared
 
         def issue(prepared):
@@ -129,6 +130,21 @@ class EngineRunner:
         pending = await loop.run_in_executor(self._exec, lambda: issue(prepared))
         return await loop.run_in_executor(self._fetch, lambda: finish(pending))
 
+    def _observe_shard_stages(self) -> None:
+        """Fold the mesh engine's host-staging split (route/pack/put ms
+        accumulated in ShardedEngine._stage*) into the stage_duration
+        summaries as shard_* labels — the mesh-path mirror of the local
+        pipeline's put/issue/fetch stages, and the series the ingress bench
+        reads to show staging cost ∝ batch rows."""
+        take = getattr(self.engine, "take_stage_deltas", None)
+        if take is None:
+            return
+        for k, ms in take().items():
+            if ms > 0:
+                self.metrics.stage_duration.labels(stage=f"shard_{k}").observe(
+                    ms / 1e3
+                )
+
     async def check_columns(
         self, cols: RequestColumns, now_ms: Optional[int] = None
     ) -> ResponseColumns:
@@ -139,6 +155,7 @@ class EngineRunner:
             rc = self.engine.check_columns(cols, now_ms=now_ms)
             if self.metrics is not None:
                 self.metrics.dispatch_duration.observe(time.perf_counter() - t0)
+                self._observe_shard_stages()
                 self.metrics.observe_engine(self.engine.stats)
                 gs = getattr(self.engine, "global_stats", None)
                 if gs is not None:

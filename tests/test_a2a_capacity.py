@@ -1,0 +1,156 @@
+"""Pins for the a2a exchange-capacity geometry and overflow contract.
+
+pair_capacity is the single source of truth for the (D, C) exchange buffers
+(parallel/a2a.py step 2); the curve is pinned here so tuning the capacity
+factor later (GUBER_A2A_CAPACITY_SIGMA) is a deliberate, test-visible act —
+and the overflow→FLAG_DROPPED|FLAG_UNPROCESSED contract is pinned so a
+capacity change can never silently turn retryable drops into lost requests.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from gubernator_tpu.ops.batch import fingerprint_columns, pack_requests
+from gubernator_tpu.parallel import ShardedEngine, make_mesh
+from gubernator_tpu.parallel.a2a import a2a_capacity_sigma, pair_capacity
+from gubernator_tpu.parallel.mesh import shard_of
+from gubernator_tpu.types import RateLimitRequest, MINUTE
+
+
+def req(key, hits=1, limit=10, created_at=None):
+    return RateLimitRequest(
+        name="cap", unique_key=key, hits=hits, limit=limit, duration=MINUTE,
+        created_at=created_at,
+    )
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "tests require the 8-device CPU mesh"
+    return make_mesh(8)
+
+
+def test_pair_capacity_curve_pinned():
+    """The mean+5σ/pow2 curve at the default sigma. These exact values are
+    what the exchange compiles against; changing GUBER_A2A_CAPACITY_SIGMA
+    (or the +8 slack, or the pow2 floor) must update this table."""
+    assert a2a_capacity_sigma() == 5.0
+    expected = {
+        (8, 8): 16,
+        (16, 8): 32,
+        (64, 8): 32,
+        (256, 8): 128,
+        (1024, 8): 256,
+        (16384, 8): 4096,
+        (8, 1): 32,
+        (1024, 1): 2048,
+    }
+    got = {k: pair_capacity(*k) for k in expected}
+    assert got == expected
+
+
+def test_pair_capacity_properties():
+    for D in (1, 2, 4, 8, 16):
+        prev = 0
+        for c in (8, 16, 64, 256, 1024, 4096, 16384):
+            C = pair_capacity(c, D)
+            # pow2 ≥ 8 (shape reuse), covers the mean (D·C ≥ c), monotone
+            assert C >= 8 and (C & (C - 1)) == 0
+            assert D * C >= c, (c, D, C)
+            assert C >= prev
+            prev = C
+
+
+def test_pair_capacity_sigma_knob(monkeypatch):
+    """The env knob moves the curve (read per trace, host-side) without
+    touching the pow2/slack structure."""
+    base = pair_capacity(1024, 8)
+    monkeypatch.setenv("GUBER_A2A_CAPACITY_SIGMA", "0")
+    low = pair_capacity(1024, 8)
+    monkeypatch.setenv("GUBER_A2A_CAPACITY_SIGMA", "20")
+    high = pair_capacity(1024, 8)
+    assert low <= base <= high
+    assert low == 256   # int(128) + 8 → pow2
+    assert high == 512  # int(128 + 20·11.31…) + 8 → pow2
+
+
+def _same_owner_keys(n_want: int, mesh) -> list:
+    """Keys whose fingerprints all route to one shard (the overflow corpus:
+    every source device's block sends its whole c rows to one destination
+    pair, exceeding C)."""
+    N = 8000
+    names = np.array(["cap"] * N, dtype=object)
+    keys = np.array([f"k{i}" for i in range(N)], dtype=object)
+    fps, _ = fingerprint_columns(names, keys)
+    shards = shard_of(fps, 8)
+    target = int(shards[0])
+    picked = [f"k{i}" for i in range(N) if int(shards[i]) == target][:n_want]
+    assert len(picked) == n_want
+    return picked
+
+
+def test_overflow_drop_contract_matches_pair_capacity(mesh, frozen_now):
+    """Entering the dispatch at terminal depth (no retries, no host
+    fallback) surfaces raw exchange overflow: the number of dropped rows
+    must equal the per-pair excess over pair_capacity exactly, every drop
+    must carry BOTH flags (dropped → not persisted, unprocessed → never
+    probed), and the drops must be observable in the dedicated counter."""
+    from gubernator_tpu.ops.engine import _pad_size
+
+    t = frozen_now
+    eng = ShardedEngine(mesh, capacity_per_shard=4096, route="device",
+                        dedup="host")
+    picked = _same_owner_keys(512, mesh)
+    hb, _errs = pack_requests([req(k, created_at=t) for k in picked], t)
+    n = hb.fp.shape[0]
+    D = 8
+    c = _pad_size(max(1, -(-n // D)), floor=8)
+    C = pair_capacity(c, D)
+    # per-source-device excess: rows n..c of each block overflow their
+    # single destination pair (row i lands on source device i // c)
+    per_src = np.bincount(np.arange(n) // c, minlength=D)
+    expected_drops = int(np.maximum(per_src - C, 0).sum())
+    _, (s, l, r, tt, dropped, h) = eng._dispatch(
+        hb, depth=3, count=np.asarray(hb.active)
+    )
+    assert int(dropped.sum()) == expected_drops
+    assert expected_drops > 0  # the corpus must actually force overflow
+    assert eng.stats.unprocessed_dropped == expected_drops
+    assert eng.stats.dropped == expected_drops
+    # rows that DID fit were persisted exactly once
+    ok = ~dropped
+    assert (r[ok] == 9).all()
+
+
+def test_overflow_retries_recover_and_dedup_relieves_capacity(mesh, frozen_now):
+    """Full-path flood: retries (host-grid fallback at terminal depth) must
+    resolve every row. With in-trace dedup, a hot DUPLICATE flood at the
+    same owner stops pressuring capacity entirely: each source block
+    collapses the duplicates to one carrier (≤ 1 slot per pair), so zero
+    exchange drops — the MoE "token dropping" analog only sees unique keys."""
+    t = frozen_now
+    # distinct-key flood: capacity overflow happens, retries absorb it
+    eng = ShardedEngine(mesh, capacity_per_shard=4096, route="device",
+                        dedup="device")
+    picked = _same_owner_keys(512, mesh)
+    out = eng.check([req(k, created_at=t) for k in picked], now_ms=t)
+    assert all(r.error == "" for r in out)
+    assert all(r.remaining == 9 for r in out)
+
+    # duplicate flood of ONE owned key: per-source dedup leaves ≤ 8 carriers
+    # mesh-wide, far under capacity → no unprocessed drops at depth 0
+    eng2 = ShardedEngine(mesh, capacity_per_shard=4096, route="device",
+                         dedup="device")
+    hot = picked[0]
+    out = eng2.check(
+        [req(hot, hits=1, limit=1 << 20, created_at=t) for _ in range(512)],
+        now_ms=t,
+    )
+    assert all(r.error == "" for r in out)
+    # aggregate semantics: every duplicate shares the post-sum response
+    assert len({r.remaining for r in out}) == 1
+    assert out[0].remaining == (1 << 20) - 512
+    assert eng2.stats.unprocessed_dropped == 0
+    assert eng2.stats.dropped == 0

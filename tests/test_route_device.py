@@ -1,0 +1,243 @@
+"""route="device" / dedup="device" parity suite on the 8-device CPU mesh.
+
+The TPU serving default (arrival-order rows + on-mesh a2a exchange +
+in-trace duplicate aggregation) must be semantically interchangeable with
+the host-planned paths it replaces:
+
+* dedup="device" ≍ the host planner's aggregate-everything plan
+  (plan_passes with max_exact=1 — the reference's GLOBAL hot-key
+  aggregation, global.go:109-123) for responses, live state, and stats;
+* route="device" ≍ route="host" under either dedup mode, including
+  Zipf-skewed batches that force per-pair exchange overflow (retries +
+  terminal host fallback);
+* the GLOBAL owner/replica fork (GlobalShardedEngine) behaves identically
+  whichever side of the mesh does routing and dedup.
+
+Tables are compared CANONICALLY (slots sorted within each bucket): lane
+assignment follows batch row order, and the dedup paths legitimately place
+a key's carrier at a different row position than the host oracle — slot
+order inside a bucket is internal state, not an API surface.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from gubernator_tpu.ops.batch import columns_from_requests
+from gubernator_tpu.parallel import ShardedEngine, make_mesh
+from gubernator_tpu.parallel.global_sync import GlobalShardedEngine
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitRequest, MINUTE
+
+
+def req(key, hits=1, limit=100, duration=MINUTE,
+        algorithm=Algorithm.TOKEN_BUCKET, behavior=Behavior.BATCHING,
+        created_at=None):
+    return RateLimitRequest(
+        name="rd", unique_key=key, hits=hits, limit=limit, duration=duration,
+        algorithm=algorithm, behavior=behavior, created_at=created_at,
+    )
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "tests require the 8-device CPU mesh"
+    return make_mesh(8)
+
+
+def canon(rows: np.ndarray) -> np.ndarray:
+    """Sort each bucket's slots by fingerprint — canonical live state."""
+    from gubernator_tpu.ops.table2 import F, K
+
+    D, NB, _ = rows.shape
+    s = rows.reshape(D, NB, K, F)
+    key = (s[..., 1].astype(np.int64) << 32) | (
+        s[..., 0].astype(np.int64) & 0xFFFFFFFF
+    )
+    order = np.argsort(key, axis=2, kind="stable")
+    return np.take_along_axis(s, order[..., None], axis=2)
+
+
+def assert_resp_equal(want, got, ctx=""):
+    for i, (a, b) in enumerate(zip(want, got)):
+        assert (a.status, a.remaining, a.reset_time, a.error) == (
+            b.status, b.remaining, b.reset_time, b.error,
+        ), f"{ctx} row {i}: {a} != {b}"
+
+
+def mixed_corpus(rng, t, step, n=200, keys=70):
+    """Token/leaky mix with duplicates, varying hits, RESET flags."""
+    ks = rng.integers(0, keys, size=n)
+    return [
+        req(
+            f"m{k}",
+            hits=1 + int(k) % 3,
+            limit=1000,
+            algorithm=(Algorithm.TOKEN_BUCKET if k % 3
+                       else Algorithm.LEAKY_BUCKET),
+            behavior=(Behavior.RESET_REMAINING if k % 11 == 1
+                      else Behavior.BATCHING),
+            created_at=t + step,
+        )
+        for k in ks
+    ]
+
+
+@pytest.mark.parametrize("route", ["host", "device"])
+def test_device_dedup_matches_host_aggregate_oracle(mesh, frozen_now, route):
+    """In-trace dedup vs the host aggregation oracle, per route: responses,
+    stats, and canonical live state all equal across multi-step mixed
+    traffic."""
+    t = frozen_now
+    oracle = ShardedEngine(mesh, capacity_per_shard=2048, route=route,
+                           dedup="host", max_exact_passes=1)
+    dev = ShardedEngine(mesh, capacity_per_shard=2048, route=route,
+                        dedup="device")
+    rng = np.random.default_rng(5)
+    for step in range(3):
+        reqs = mixed_corpus(rng, t, step)
+        want = oracle.check(reqs, now_ms=t + step)
+        got = dev.check(reqs, now_ms=t + step)
+        assert_resp_equal(want, got, f"route={route} step={step}")
+    np.testing.assert_array_equal(canon(oracle.snapshot()),
+                                  canon(dev.snapshot()))
+    assert oracle.stats.cache_hits == dev.stats.cache_hits
+    assert oracle.stats.cache_misses == dev.stats.cache_misses
+    assert oracle.stats.over_limit == dev.stats.over_limit
+    assert oracle.stats.checks == dev.stats.checks
+
+
+def test_route_parity_zipf_overflow(mesh, frozen_now):
+    """Zipf-skewed duplicate-heavy batches through route="device" vs
+    route="host" (both dedup="device"): skew concentrates rows on hot
+    owners and forces per-pair exchange overflow; the retry chain plus the
+    terminal host-grid fallback must make routing invisible — identical
+    responses, zero errors, identical per-key totals."""
+    t = frozen_now
+    host_eng = ShardedEngine(mesh, capacity_per_shard=4096, route="host",
+                             dedup="device")
+    dev_eng = ShardedEngine(mesh, capacity_per_shard=4096, route="device",
+                            dedup="device")
+    rng = np.random.default_rng(13)
+    z = np.minimum(rng.zipf(1.1, size=2048) - 1, 1023)
+    reqs = [req(f"z{k}", hits=1, limit=1 << 20, created_at=t) for k in z]
+    want = host_eng.check(reqs, now_ms=t)
+    got = dev_eng.check(reqs, now_ms=t)
+    assert_resp_equal(want, got, "zipf")
+    assert all(r.error == "" for r in got)
+    # per-key consumption identical on both engines (hits=0 probe)
+    uniq, counts = np.unique(z, return_counts=True)
+    probe = [req(f"z{k}", hits=0, limit=1 << 20, created_at=t) for k in uniq]
+    again_h = host_eng.check(probe, now_ms=t)
+    again_d = dev_eng.check(probe, now_ms=t)
+    assert_resp_equal(again_h, again_d, "zipf probe")
+    for k, c, r in zip(uniq, counts, again_d):
+        assert r.remaining == (1 << 20) - c, f"key z{k}"
+    np.testing.assert_array_equal(canon(host_eng.snapshot()),
+                                  canon(dev_eng.snapshot()))
+
+
+def test_global_fork_parity_device_route_and_dedup(mesh, frozen_now):
+    """The GLOBAL owner/replica fork through the device-routed, in-trace
+    dedup path vs the host-planned aggregate oracle: replica answers, owner
+    applies, queued hits, and the post-sync converged state must all agree
+    (same rotating home sequence — one GLOBAL batch per check call)."""
+    t = frozen_now
+    oracle = GlobalShardedEngine(mesh, capacity_per_shard=2048, route="host",
+                                 dedup="host", max_exact_passes=1,
+                                 sync_out=256)
+    dev = GlobalShardedEngine(mesh, capacity_per_shard=2048, route="device",
+                              dedup="device", sync_out=256)
+    rng = np.random.default_rng(23)
+    for step in range(3):
+        ks = rng.integers(0, 40, size=120)
+        reqs = [
+            req(
+                f"g{k}",
+                hits=1 + int(k) % 2,
+                limit=500,
+                behavior=(Behavior.GLOBAL if k % 2 else Behavior.BATCHING),
+                created_at=t + step,
+            )
+            for k in ks
+        ]
+        cols = columns_from_requests(reqs)
+        want = oracle.check_columns(cols, now_ms=t + step)
+        got = dev.check_columns(cols, now_ms=t + step)
+        np.testing.assert_array_equal(want.status, got.status, f"step {step}")
+        np.testing.assert_array_equal(want.remaining, got.remaining)
+        np.testing.assert_array_equal(want.reset_time, got.reset_time)
+        np.testing.assert_array_equal(want.err, got.err)
+    assert (
+        oracle.global_stats.send_queue_length
+        == dev.global_stats.send_queue_length
+    )
+    oracle.sync(now_ms=t + 3)
+    dev.sync(now_ms=t + 3)
+    # post-sync convergence: the owner-reconciled authoritative tables agree
+    np.testing.assert_array_equal(canon(oracle.snapshot()),
+                                  canon(dev.snapshot()))
+    probe = columns_from_requests(
+        [req(f"g{k}", hits=0, limit=500, behavior=Behavior.GLOBAL,
+             created_at=t + 3) for k in range(0, 40, 2)]
+    )
+    want = oracle.check_columns(probe, now_ms=t + 3)
+    got = dev.check_columns(probe, now_ms=t + 3)
+    np.testing.assert_array_equal(want.remaining, got.remaining)
+
+
+def test_pipelined_dedup_matches_serial(mesh, frozen_now):
+    """The prepare/issue/finish split with in-trace dedup (member rows
+    decoded through finish_staged's FLAG_MEMBER accounting) must equal the
+    serial dedup path — responses, stats, and state."""
+    from gubernator_tpu.ops.engine import (
+        finish_check_columns,
+        issue_check_columns,
+        prepare_check_columns,
+    )
+
+    t = frozen_now
+    rng = np.random.default_rng(31)
+    serial = ShardedEngine(mesh, capacity_per_shard=2048, route="device",
+                           dedup="device")
+    piped = ShardedEngine(mesh, capacity_per_shard=2048, route="device",
+                          dedup="device")
+    for step in range(3):
+        cols = columns_from_requests(mixed_corpus(rng, t, step, n=160))
+        want = serial.check_columns(cols, now_ms=t + step)
+        pending = issue_check_columns(
+            piped, prepare_check_columns(piped, cols, now_ms=t + step)
+        )
+        # in-trace dedup plans exactly ONE pass — the host group-by is gone
+        assert len(pending.passes) == 1
+        got, delta = finish_check_columns(piped, pending, fixup=lambda fn: fn())
+        piped.stats.merge(delta)
+        np.testing.assert_array_equal(got.status, want.status)
+        np.testing.assert_array_equal(got.remaining, want.remaining)
+        np.testing.assert_array_equal(got.err, want.err)
+    assert serial.stats.cache_hits == piped.stats.cache_hits
+    assert serial.stats.cache_misses == piped.stats.cache_misses
+    np.testing.assert_array_equal(canon(serial.snapshot()),
+                                  canon(piped.snapshot()))
+
+
+def test_stage_timing_and_egress_recycling(mesh, frozen_now):
+    """The ingress accounting the bench and shard_* metrics read: staging
+    time accumulates per dispatch, take_stage_deltas drains it, and fetched
+    egress buffers are banked for donation reuse."""
+    t = frozen_now
+    eng = ShardedEngine(mesh, capacity_per_shard=1024, route="device",
+                        dedup="device")
+    reqs = [req(f"s{i}", created_at=t) for i in range(64)]
+    eng.check(reqs, now_ms=t)
+    assert eng.stage_dispatches >= 1
+    d = eng.take_stage_deltas()
+    assert set(d) == {"route", "pack", "put"}
+    assert d["pack"] >= 0 and d["put"] > 0
+    # drained: a second take with no traffic reads zero
+    assert all(v == 0.0 for v in eng.take_stage_deltas().values())
+    # egress bank primed by the fetch; the next same-shape dispatch pops it
+    assert any(len(v) for v in eng._egress.values())
+    banked = {k: len(v) for k, v in eng._egress.items()}
+    eng.check(reqs, now_ms=t)
+    assert {k: len(v) for k, v in eng._egress.items()} == banked
