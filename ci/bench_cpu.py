@@ -110,6 +110,79 @@ def sharded_smoke() -> dict:
     return out
 
 
+def handoff_smoke() -> dict:
+    """Topology-handoff regression gate: extract + conservative-merge of
+    ~100k live rows across an 8-device mesh must be batch-proportional on
+    the host (no full-table host loop — the device does the partition pass)
+    and lose zero rows in the no-fault case (row parity src extract → dst
+    merge). Host cost is measured as wall time of the merge path at 1× vs
+    8× the rows: super-linear growth (a reintroduced per-row Python loop or
+    keyspace-bound staging) blows the bound."""
+    from gubernator_tpu.parallel import make_mesh
+    from gubernator_tpu.parallel.sharded import ShardedEngine
+
+    mesh = make_mesh(8)
+    cap = 1 << 15  # 256K slots across the mesh — rows ≪ table
+    src = ShardedEngine(mesh, capacity_per_shard=cap, write_mode="xla")
+    rng = np.random.default_rng(3)
+    n = 100_000
+    fps_in = np.unique(rng.integers(1, (1 << 63) - 1, size=n + n // 8,
+                                    dtype=np.int64))[:n]
+    ones = np.ones(n, dtype=np.int64)
+    installed = src.install_columns(
+        fp=fps_in,
+        algo=np.zeros(n, dtype=np.int32),
+        status=np.zeros(n, dtype=np.int32),
+        limit=ones * 100,
+        remaining=ones * 37,
+        reset_time=ones * (NOW + 3_600_000),
+        duration=ones * 3_600_000,
+        now_ms=NOW,
+    )
+    # a few installs drop to per-bucket overflow (the claim auction's
+    # documented behavior at this load) — parity is against what LANDED
+    t0 = time.perf_counter()
+    fps, slots = src.extract_live(NOW)
+    t_extract = time.perf_counter() - t0
+    if fps.shape[0] != installed:
+        print(json.dumps({"error": "handoff smoke: extract lost rows",
+                          "extracted": int(fps.shape[0]),
+                          "expected": installed}))
+        sys.exit(1)
+
+    n_live = int(fps.shape[0])
+
+    def merge_time(rows: int) -> float:
+        dst = ShardedEngine(mesh, capacity_per_shard=cap, write_mode="xla")
+        dst.merge_rows(fps[:rows], slots[:rows], now_ms=NOW)  # compile+seed
+        t0 = time.perf_counter()
+        merged = dst.merge_rows(fps[:rows], slots[:rows], now_ms=NOW)
+        dt = time.perf_counter() - t0
+        if merged != rows:  # idempotent replay must re-ack every row
+            print(json.dumps({"error": "handoff smoke: merge lost rows",
+                              "merged": merged, "expected": rows}))
+            sys.exit(1)
+        return dt
+
+    small, big = n_live // 8, n_live
+    small_s = min(merge_time(small) for _ in range(3))
+    big_s = min(merge_time(big) for _ in range(3))
+    SLACK = 4.0
+    ok = big_s <= (big / small) * SLACK * max(small_s, 1e-4)
+    out = {
+        "rows": n,
+        "extract_s": round(t_extract, 4),
+        "merge_small_s": round(small_s, 4),
+        "merge_big_s": round(big_s, 4),
+        "proportional": bool(ok),
+    }
+    if not ok:
+        print(json.dumps({"error": "handoff merge cost is super-linear in "
+                          "rows", **out}))
+        sys.exit(1)
+    return out
+
+
 def main() -> None:
     eng = LocalEngine(capacity=1 << 15, write_mode="xla")
     rng = np.random.default_rng(0)
@@ -129,6 +202,7 @@ def main() -> None:
     print(json.dumps({
         "decisions_per_sec": round(best, 1),
         "sharded_smoke": sharded_smoke(),
+        "handoff_smoke": handoff_smoke(),
     }))
 
 

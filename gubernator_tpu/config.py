@@ -153,6 +153,19 @@ class BehaviorConfig:
     # it, failed batches drop (bounds memory during long partitions)
     global_queue_cap: int = 10_000
 
+    # --- topology-change handoff (docs/robustness.md "Topology change &
+    # drain") -----------------------------------------------------------
+    # move owned live rows to their new ring owners on set_peers rebalance
+    # and on graceful drain (off restores the reference's state-stranding
+    # behavior: moved keys answer fresh at the new owner until TTL)
+    handoff_enabled: bool = True
+    # wall-clock budget for one handoff round (rebalance or drain); chunks
+    # still unacked at the deadline stay in the table (drain snapshots them)
+    handoff_deadline_ms: float = 5_000.0
+    # rows per TransferState chunk (4096 rows ≈ 300 KiB on the wire, under
+    # the 1 MiB peer-channel receive cap with headroom)
+    handoff_chunk_rows: int = 4096
+
 
 @dataclass
 class DaemonConfig:
@@ -376,6 +389,10 @@ class DaemonConfig:
             raise ConfigError("GUBER_GLOBAL_REQUEUE_RETRIES must be >= 0")
         if self.behaviors.global_queue_cap <= 0:
             raise ConfigError("GUBER_GLOBAL_QUEUE_CAP must be positive")
+        if self.behaviors.handoff_deadline_ms <= 0:
+            raise ConfigError("GUBER_HANDOFF_DEADLINE must be positive")
+        if self.behaviors.handoff_chunk_rows <= 0:
+            raise ConfigError("GUBER_HANDOFF_CHUNK_ROWS must be positive")
         if self.tls_client_auth not in ("", "require", "verify"):
             raise ConfigError("GUBER_TLS_CLIENT_AUTH must be require or verify")
         if self.created_at_tolerance_ms <= 0:
@@ -434,6 +451,11 @@ def setup_daemon_config(
                 env, "GUBER_GLOBAL_REQUEUE_RETRIES", 3
             ),
             global_queue_cap=_get_int(env, "GUBER_GLOBAL_QUEUE_CAP", 10_000),
+            handoff_enabled=_get_bool(env, "GUBER_HANDOFF_ENABLED", True),
+            handoff_deadline_ms=_get_float_ms(
+                env, "GUBER_HANDOFF_DEADLINE", 5_000.0
+            ),
+            handoff_chunk_rows=_get_int(env, "GUBER_HANDOFF_CHUNK_ROWS", 4096),
         ),
         peer_discovery_type=_get(env, "GUBER_PEER_DISCOVERY_TYPE", "none"),
         dns_fqdn=_get(env, "GUBER_DNS_FQDN", ""),

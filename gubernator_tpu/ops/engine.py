@@ -72,6 +72,21 @@ def _pad_size(n: int, floor: int = 16) -> int:
     return size
 
 
+def _occurrence_rank(fps: np.ndarray) -> np.ndarray:
+    """Per-row occurrence index of its fingerprint (0 for the first, 1 for
+    the second duplicate, …) — the merge path's host-side analog of the
+    planner's same-key pass split."""
+    n = fps.shape[0]
+    order = np.argsort(fps, kind="stable")
+    sorted_f = fps[order]
+    first = np.concatenate([[True], sorted_f[1:] != sorted_f[:-1]])
+    idx = np.arange(n)
+    start = np.maximum.accumulate(np.where(first, idx, -1))
+    rank = np.empty(n, dtype=np.int64)
+    rank[order] = idx - start
+    return rank
+
+
 def _math_mode(hb: HostBatch) -> str:
     """Static kernel specialization chosen host-side per dispatch: an
     all-token batch (the common case — token is the reference's default
@@ -689,6 +704,85 @@ class LocalEngine:
         self.table, installed = install2(self.table, inst, write=self.write_mode)
         self.stats.dispatches += 1
         return int(np.asarray(installed).sum())
+
+    # ------------------------------------------------------------- handoff
+    # Topology-change survivability (docs/robustness.md): extract packs every
+    # live slot on-device, merge applies transferred slots conservatively
+    # (kernel2.merge2 — a retried/duplicated transfer can never grant extra
+    # capacity), tombstone zeroes acked rows so they are neither re-served
+    # nor re-snapshotted by the source.
+
+    def extract_live(self, now_ms: Optional[int] = None):
+        """All live slots as (fps (N,) i64, slots (N, F) i32) host arrays —
+        the device pays for the full-table filter+pack, the host fetches
+        only the live prefix (ops/table2.extract_live_rows)."""
+        from gubernator_tpu.ops.table2 import extract_live_rows
+
+        now = now_ms if now_ms is not None else ms_now()
+        return extract_live_rows(self.table.rows, now)
+
+    def merge_rows(
+        self, fps: np.ndarray, slots: np.ndarray, now_ms: Optional[int] = None
+    ) -> int:
+        """Conservatively merge transferred slot rows (TransferState receive
+        path): remaining=min, expiry=max, newest config wins. Returns the
+        number of rows merged/installed. Duplicate fingerprints within one
+        call merge as sequential passes — the claim machinery's unique-fp
+        contract, same as the serving planner's (a chunk from one extract is
+        always unique, but crossed transfers may not be)."""
+        import jax.numpy as jnp
+
+        from gubernator_tpu.ops.kernel2 import merge2
+
+        n = fps.shape[0]
+        if n == 0:
+            return 0
+        rank = _occurrence_rank(fps)
+        if rank.max() > 0:
+            return sum(
+                self.merge_rows(fps[rank == r], slots[rank == r], now_ms)
+                for r in range(int(rank.max()) + 1)
+            )
+        now = now_ms if now_ms is not None else ms_now()
+        size = _pad_size(n)
+        fp_p = np.zeros(size, dtype=np.int64)
+        fp_p[:n] = fps
+        slots_p = np.zeros((size, slots.shape[1]), dtype=np.int32)
+        slots_p[:n] = slots
+        active = np.zeros(size, dtype=bool)
+        active[:n] = True
+        self.table, merged = merge2(
+            self.table,
+            jnp.asarray(fp_p),
+            jnp.asarray(slots_p),
+            jnp.asarray(np.full(size, now, dtype=np.int64)),
+            jnp.asarray(active),
+            write=self.write_mode,
+        )
+        self.stats.dispatches += 1
+        return int(np.asarray(merged).sum())
+
+    def tombstone_fps(self, fps: np.ndarray) -> int:
+        """Zero the slots holding `fps` (post-ack handoff cleanup). Missing
+        fingerprints are no-ops; returns the number actually removed."""
+        import jax.numpy as jnp
+
+        from gubernator_tpu.ops.table2 import Table2, tombstone_rows
+
+        n = fps.shape[0]
+        if n == 0:
+            return 0
+        size = _pad_size(n)
+        fp_p = np.zeros(size, dtype=np.int64)
+        fp_p[:n] = fps
+        active = np.zeros(size, dtype=bool)
+        active[:n] = True
+        rows, found = tombstone_rows(
+            self.table.rows, jnp.asarray(fp_p), jnp.asarray(active)
+        )
+        self.table = Table2(rows=rows)
+        self.stats.dispatches += 1
+        return int(np.asarray(found).sum())
 
     # ---------------------------------------------------------- checkpointing
 

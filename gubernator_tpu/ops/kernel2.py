@@ -981,3 +981,112 @@ def install2_impl(
 install2 = functools.partial(
     jax.jit, donate_argnums=(0,), static_argnames=("write",)
 )(install2_impl)
+
+
+# ------------------------------------------------------- conservative merge
+
+
+def merge2_impl(
+    table: Table2, fp, slots, now, active, *, write: str = "xla"
+) -> Tuple[Table2, jnp.ndarray]:
+    """Conservative merge of transferred table slots (the TransferState
+    receive path, docs/robustness.md "Topology change & drain").
+
+    Incoming rows arrive in the table's own slot-field layout ((B, F) i32,
+    the extract_live_rows wire format). Against an existing live entry the
+    merge can only ever TIGHTEN admission — the invariant that makes a
+    retried, duplicated, or crossed transfer unable to grant extra capacity:
+
+      * remaining  = min(stored, incoming)   (integer and leaky-float lanes)
+      * expiry     = max(stored, incoming)   (state lives at least as long)
+      * OVER_LIMIT sticks (status = max)
+      * config (limit/burst/duration/algo) — newest stamp wins
+      * stamp      = max(stored, incoming)
+
+    Absent keys install the incoming slot verbatim (claim/evict machinery
+    shared with install2). Incoming rows already expired at the receiver's
+    clock are dropped — stale state must not resurrect. Returns
+    (table', merged_mask)."""
+    B = fp.shape[0]
+    NB = table.rows.shape[0]
+    write = resolve_write(write, NB, B)
+    if write == "sparse":
+        blk, u, gsteps = sparse_geometry(NB, B)
+    else:
+        blk, u = sweep_geometry(NB, B)
+
+    g_i = lambda f: slots[:, f]
+    i_exp = _join64(g_i(EXP_LO), g_i(EXP_HI))
+    active = active & (i_exp >= now)
+
+    c = _probe_claim2(table.rows, fp, now, active, blk, u)
+    lane16 = jnp.take_along_axis(c.slots, c.chosen[:, None, None], axis=1)[
+        :, 0, :
+    ]
+    g_s = lambda f: lane16[:, f]
+    s_exp = _join64(g_s(EXP_LO), g_s(EXP_HI))
+    exists = c.owns & (s_exp >= now)
+
+    i_stamp = _join64(g_i(STAMP_LO), g_i(STAMP_HI))
+    s_stamp = _join64(g_s(STAMP_LO), g_s(STAMP_HI))
+    i_flags = g_i(FLAGS)
+    s_flags = g_s(FLAGS)
+    # config carrier: the newer stamp's limit/burst/duration/algo
+    keep_stored = exists & (s_stamp > i_stamp)
+    pick32 = lambda i_f, s_f: jnp.where(keep_stored, s_f, i_f)
+    limit = pick32(g_i(LIMIT), g_s(LIMIT))
+    burst = pick32(g_i(BURST), g_s(BURST))
+    algo = pick32(i_flags & 0xFF, s_flags & 0xFF)
+    dur = jnp.where(
+        keep_stored,
+        _join64(g_s(DUR_LO), g_s(DUR_HI)),
+        _join64(g_i(DUR_LO), g_i(DUR_HI)),
+    )
+    status = jnp.where(
+        exists, jnp.maximum(i_flags >> 8, s_flags >> 8), i_flags >> 8
+    )
+    rem_i = jnp.where(exists, jnp.minimum(g_i(REM_I), g_s(REM_I)), g_i(REM_I))
+    to_f64 = lambda g: (
+        jax.lax.bitcast_convert_type(g(REMF_HI), f32).astype(f64)
+        + jax.lax.bitcast_convert_type(g(REMF_LO), f32).astype(f64)
+    )
+    rem_f = jnp.where(exists, jnp.minimum(to_f64(g_i), to_f64(g_s)), to_f64(g_i))
+    exp = jnp.where(exists, jnp.maximum(s_exp, i_exp), i_exp)
+    stamp = jnp.where(exists, jnp.maximum(s_stamp, i_stamp), i_stamp)
+
+    remf_hi = rem_f.astype(f32)
+    remf_lo = (rem_f - remf_hi.astype(f64)).astype(f32)
+    zero = jnp.zeros((B,), dtype=i32)
+    new16 = jnp.stack(
+        [
+            _lo32(fp),
+            _hi32(fp),
+            limit,
+            burst,
+            rem_i,
+            algo | (status << 8),
+            _lo32(dur),
+            _hi32(dur),
+            _lo32(stamp),
+            _hi32(stamp),
+            _lo32(exp),
+            _hi32(exp),
+            jax.lax.bitcast_convert_type(remf_hi, i32),
+            jax.lax.bitcast_convert_type(remf_lo, i32),
+            zero,
+            zero,
+        ],
+        axis=1,
+    )
+    if write == "sweep":
+        rows_out = _write_sweep(table.rows, new16, c, blk, u)
+    elif write == "sparse":
+        rows_out = _write_sparse(table.rows, new16, c, blk, u, gsteps)
+    else:
+        rows_out = _write_xla(table.rows, new16, c)
+    return Table2(rows=rows_out), active & c.written
+
+
+merge2 = functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("write",)
+)(merge2_impl)

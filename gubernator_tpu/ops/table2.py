@@ -29,8 +29,10 @@ lrucache.go:111-149).
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -103,6 +105,89 @@ def decode_live_slots(rows: np.ndarray, now_ms: int):
     )
     live = (fp != 0) & (exp >= now_ms)
     return slots[live], fp[live], exp[live]
+
+
+# ------------------------------------------------------------- handoff ops
+#
+# Topology-change survivability (docs/robustness.md "Topology change &
+# drain"): when ring ownership moves, the owner's live rows must follow.
+# The DEVICE pays for partitioning millions of live slots — a full-table
+# filter+pack runs as one fused program and the host fetches only the live
+# prefix (batch-proportional transfer), mirroring the sparse-write /
+# packed-single-fetch idioms of the serving path.
+
+
+@jax.jit
+def _extract_sorted(rows: jnp.ndarray, now_ms: jnp.ndarray):
+    """Device filter+pack: all live slots sorted to the front. Accepts any
+    (..., 128) rows layout (single-device (NB, 128) or sharded (D, NB, 128) —
+    the flatten makes the shard axis fold in). Returns (slots_packed (N, F),
+    fp_packed (N,), live_count) with live entries occupying the first
+    `live_count` positions."""
+    slots = rows.reshape(-1, F)
+    lo = slots[:, FP_LO].astype(jnp.int64) & 0xFFFFFFFF
+    hi = slots[:, FP_HI].astype(jnp.int64)
+    fp = (hi << 32) | lo
+    exp = (slots[:, EXP_LO].astype(jnp.int64) & 0xFFFFFFFF) | (
+        slots[:, EXP_HI].astype(jnp.int64) << 32
+    )
+    live = (fp != 0) & (exp >= now_ms)
+    order = jnp.argsort(jnp.where(live, 0, 1).astype(jnp.int32))
+    return slots[order], fp[order], live.sum()
+
+
+def extract_live_rows(rows, now_ms: int):
+    """Extract every live slot from a device-resident rows array:
+    (fps (N,) i64, slots (N, F) i32) host copies. The filter + pack runs
+    on-device (_extract_sorted); the host fetches only the live prefix,
+    padded to a power of two so the number of compiled slice shapes stays
+    logarithmic in table capacity."""
+    slots_s, fp_s, cnt = _extract_sorted(rows, np.int64(now_ms))
+    n = int(cnt)
+    if n == 0:
+        return np.empty(0, dtype=np.int64), np.empty((0, F), dtype=np.int32)
+    pad = 256
+    while pad < n:
+        pad *= 2
+    pad = min(pad, int(fp_s.shape[0]))
+    return (
+        np.asarray(fp_s[:pad])[:n].copy(),
+        np.asarray(slots_s[:pad])[:n].copy(),
+    )
+
+
+def tombstone_rows_impl(rows: jnp.ndarray, fp: jnp.ndarray, active: jnp.ndarray):
+    """Zero the slot holding each fingerprint (handoff source side: rows are
+    tombstoned only AFTER the destination acked their transfer). Missing
+    fingerprints are no-ops — a kill mask over matched slots only, so a
+    retried tombstone can never evict an unrelated live entry. Returns
+    (rows', found_mask)."""
+    NB = rows.shape[0]
+    B = fp.shape[0]
+    bucket = (fp % NB).astype(jnp.int32)
+    b_rows = rows[bucket].reshape(B, K, F)
+    my_lo = fp.astype(jnp.int32)
+    my_hi = (fp >> 32).astype(jnp.int32)
+    s_lo = b_rows[:, :, FP_LO]
+    s_hi = b_rows[:, :, FP_HI]
+    empty = (s_lo == 0) & (s_hi == 0)
+    match = (
+        (s_lo == my_lo[:, None]) & (s_hi == my_hi[:, None]) & ~empty
+        & active[:, None]
+    )
+    lane = jnp.argmax(match, axis=1).astype(jnp.int32)
+    found = match.any(axis=1)
+    NBK = NB * K
+    tgt = jnp.where(found, bucket * K + lane, NBK)
+    kill = jnp.zeros(NBK + 1, dtype=bool).at[tgt].set(True)[:NBK]
+    flat = rows.reshape(NBK, F)
+    out = jnp.where(kill[:, None], 0, flat).reshape(NB, ROW)
+    return out, found
+
+
+tombstone_rows = functools.partial(jax.jit, donate_argnums=(0,))(
+    tombstone_rows_impl
+)
 
 
 def rehash_rows(

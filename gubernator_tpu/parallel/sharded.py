@@ -185,6 +185,50 @@ def make_sharded_install(mesh: Mesh, write: Optional[str] = None):
     return jax.jit(fn, donate_argnums=(0,))
 
 
+def make_sharded_merge(mesh: Mesh, write: Optional[str] = None):
+    """All-shards conservative-merge step (kernel2.merge2_impl) — the
+    TransferState receive path on a sharded daemon: transferred slot rows
+    are routed to their owning shard and merged with remaining=min /
+    expiry=max / newest-config-wins semantics per device."""
+    write = write or default_write_mode()
+
+    def per_device(table: Table2, fp, slots, now, active):
+        from gubernator_tpu.ops.kernel2 import merge2_impl
+
+        table = jax.tree.map(lambda x: x[0], table)
+        table, merged = merge2_impl(
+            table, fp[0], slots[0], now[0], active[0], write=write
+        )
+        expand = lambda t: jax.tree.map(lambda x: x[None], t)
+        return expand(table), expand(merged)
+
+    spec = P(SHARD_AXIS)
+    fn = shard_map_compat(
+        per_device, mesh=mesh, in_specs=(spec, spec, spec, spec, spec),
+        out_specs=(spec, spec), check_vma=False
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def make_sharded_tombstone(mesh: Mesh):
+    """All-shards tombstone step (table2.tombstone_rows_impl): zero the
+    slots holding acked handed-off fingerprints, routed per owning shard."""
+
+    def per_device(table: Table2, fp, active):
+        from gubernator_tpu.ops.table2 import tombstone_rows_impl
+
+        rows = table.rows[0]
+        rows, found = tombstone_rows_impl(rows, fp[0], active[0])
+        return Table2(rows=rows[None]), found[None]
+
+    spec = P(SHARD_AXIS)
+    fn = shard_map_compat(
+        per_device, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=(spec, spec), check_vma=False
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
 class _StagingPool:
     """Ring of persistent host-side staging buffers, keyed by shape.
 
@@ -275,6 +319,9 @@ class ShardedEngine:
         self.write_mode = write_mode or default_write_mode()
         self._decide_fns = {}  # (kind, …, math) → jitted mesh step (lazy)
         self._install = make_sharded_install(mesh, write=self.write_mode)
+        # handoff mesh steps, built lazily (most engines never rebalance)
+        self._merge_fn = None
+        self._tombstone_fn = None
         self._batch_sharding = NamedSharding(mesh, P(SHARD_AXIS))
         self.max_exact_passes = max_exact_passes
         self.store = store  # write-through hook (gubernator_tpu.store.Store)
@@ -472,6 +519,66 @@ class ShardedEngine:
 
         # live_count2 reshapes (-1, K, F), so the leading shard axis folds in
         return live_count2(self.table, now_ms if now_ms is not None else ms_now())
+
+    # ----------------------------------------------------------- handoff
+    # Same surface as LocalEngine (extract_live / merge_rows /
+    # tombstone_fps): the mesh pays for the full-table partition pass, the
+    # host stages only the transferred rows — batch-proportional, like the
+    # install path.
+
+    def extract_live(self, now_ms: Optional[int] = None):
+        from gubernator_tpu.ops.table2 import extract_live_rows
+
+        now = now_ms if now_ms is not None else ms_now()
+        return extract_live_rows(self.table.rows, now)
+
+    def merge_rows(
+        self, fps: np.ndarray, slots: np.ndarray, now_ms: Optional[int] = None
+    ) -> int:
+        n = fps.shape[0]
+        if n == 0:
+            return 0
+        from gubernator_tpu.ops.engine import _occurrence_rank
+
+        rank = _occurrence_rank(fps)
+        if rank.max() > 0:  # unique-fp contract (cf. LocalEngine.merge_rows)
+            return sum(
+                self.merge_rows(fps[rank == r], slots[rank == r], now_ms)
+                for r in range(int(rank.max()) + 1)
+            )
+        now = now_ms if now_ms is not None else ms_now()
+        D = self.n_shards
+        routed = shard_of(fps, D)
+        order, rs, offset, b_local = _route_plan(routed, D)
+        fp_g = _to_grid(fps[order].astype(np.int64), rs, offset, D, b_local)
+        now_g = np.full((D, b_local), now, dtype=np.int64)
+        act_g = _to_grid(np.ones(n, dtype=bool), rs, offset, D, b_local)
+        slots_g = np.zeros((D, b_local, slots.shape[1]), dtype=np.int32)
+        slots_g[rs, offset] = slots[order]
+        put = lambda x: jax.device_put(x, self._batch_sharding)
+        if self._merge_fn is None:
+            self._merge_fn = make_sharded_merge(self.mesh, write=self.write_mode)
+        self.table, merged = self._merge_fn(
+            self.table, put(fp_g), put(slots_g), put(now_g), put(act_g)
+        )
+        self.stats.dispatches += 1
+        return int(np.asarray(merged).sum())
+
+    def tombstone_fps(self, fps: np.ndarray) -> int:
+        n = fps.shape[0]
+        if n == 0:
+            return 0
+        D = self.n_shards
+        routed = shard_of(fps, D)
+        order, rs, offset, b_local = _route_plan(routed, D)
+        fp_g = _to_grid(fps[order].astype(np.int64), rs, offset, D, b_local)
+        act_g = _to_grid(np.ones(n, dtype=bool), rs, offset, D, b_local)
+        put = lambda x: jax.device_put(x, self._batch_sharding)
+        if self._tombstone_fn is None:
+            self._tombstone_fn = make_sharded_tombstone(self.mesh)
+        self.table, found = self._tombstone_fn(self.table, put(fp_g), put(act_g))
+        self.stats.dispatches += 1
+        return int(np.asarray(found).sum())
 
     supports_grow = False  # the daemon must not start an auto-grow loop
 

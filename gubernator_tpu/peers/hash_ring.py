@@ -102,24 +102,39 @@ class ReplicatedConsistentHash:
                 break
         raise RuntimeError("unable to pick a peer; all peers excluded")
 
-    def owners_of(self, points) -> List[PeerInfo]:
+    def owners_of(self, points, exclude: frozenset = frozenset()) -> List[PeerInfo]:
         """Vectorized get(): precomputed 32-bit ring points (numpy array) →
         owner per element. Used by the native ingress path, which computes
         fnv1a ring points during wire parsing so no key strings need to be
-        materialized for routing."""
+        materialized for routing. `exclude` (grpc addresses) removes peers'
+        replicas from the ring before the lookup — the vectorized form of
+        get(key, exclude), used by the graceful drain to find every row's
+        ring successor (ownership as if this peer were already gone)."""
         if not self._ring:
             raise RuntimeError("unable to pick a peer; pool is empty")
         import numpy as np
 
-        if getattr(self, "_ring_pts", None) is None or len(self._ring_pts) != len(
-            self._ring
-        ):
-            self._ring_pts = np.fromiter(
-                (p for p, _ in self._ring), np.uint32, len(self._ring)
-            )
-        idx = np.searchsorted(self._ring_pts, points, side="left")
-        idx[idx == len(self._ring)] = 0
-        return [self._ring[i][1] for i in idx]
+        if exclude:
+            ring = [
+                (p, peer)
+                for p, peer in self._ring
+                if peer.grpc_address not in exclude
+            ]
+            if not ring:
+                raise RuntimeError("unable to pick a peer; all peers excluded")
+            pts = np.fromiter((p for p, _ in ring), np.uint32, len(ring))
+        else:
+            ring = self._ring
+            if getattr(self, "_ring_pts", None) is None or len(
+                self._ring_pts
+            ) != len(self._ring):
+                self._ring_pts = np.fromiter(
+                    (p for p, _ in self._ring), np.uint32, len(self._ring)
+                )
+            pts = self._ring_pts
+        idx = np.searchsorted(pts, points, side="left")
+        idx[idx == len(ring)] = 0
+        return [ring[i][1] for i in idx]
 
     def size(self) -> int:
         return len(self._peers)

@@ -14,6 +14,7 @@ if _here not in sys.path:
     sys.path.insert(0, _here)
 
 import gubernator_pb2  # noqa: E402
+import handoff_pb2  # noqa: E402
 import peers_pb2  # noqa: E402
 
-__all__ = ["gubernator_pb2", "peers_pb2"]
+__all__ = ["gubernator_pb2", "handoff_pb2", "peers_pb2"]

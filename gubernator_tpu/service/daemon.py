@@ -20,6 +20,7 @@ Routing per request item (reference GetRateLimits, gubernator.go:186-302):
 from __future__ import annotations
 
 import asyncio
+import collections
 import random
 import time
 from typing import Dict, List, Optional
@@ -31,8 +32,10 @@ from gubernator_tpu.hashing import fingerprint
 from gubernator_tpu.ops.batch import ERROR_STRINGS, RequestColumns
 from gubernator_tpu.ops.engine import LocalEngine, ms_now
 from gubernator_tpu.peers.hash_ring import ReplicatedConsistentHash
+from gubernator_tpu.peers.ownership import OwnershipIndex
 from gubernator_tpu.peers.picker import RegionPicker
 from gubernator_tpu.proto import gubernator_pb2 as pb
+from gubernator_tpu.proto import handoff_pb2 as handoff_pb
 from gubernator_tpu.proto import peers_pb2 as peers_pb
 from gubernator_tpu.service.batcher import Batcher
 from gubernator_tpu.service.global_manager import GlobalManager
@@ -150,6 +153,26 @@ class Daemon:
         self._local_picker = ReplicatedConsistentHash()
         self._region_picker = RegionPicker()
         self._peer_clients: Dict[str, PeerClient] = {}
+        # breakers OUTLIVE their clients, keyed by address: a flapping
+        # discovery backend that drops and re-adds a peer must not reset an
+        # open breaker to closed (the peer is no healthier for having
+        # blinked out of the peer list)
+        self._peer_breakers: Dict[str, CircuitBreaker] = {}
+        # clients dropped by set_peers while no event loop was running —
+        # drained on the next loop entry (or close) instead of leaking
+        self._orphaned_clients: List[PeerClient] = []
+        # topology-change handoff (service/handoff.py): fp→ring-point
+        # sidecar + the transfer manager + idempotency ledger for received
+        # chunks ((transfer_id, chunk) → merged count)
+        from gubernator_tpu.service.handoff import HandoffManager
+
+        self.ownership = OwnershipIndex()
+        self.handoff = HandoffManager(self)
+        self._applied_transfers: "collections.OrderedDict" = (
+            collections.OrderedDict()
+        )
+        self._handoff_tasks: set = set()
+        self._leaving = False  # drain in progress → health shows "leaving"
         self._shutting_down = False
         self._servers = []  # transport handles (service/server.py)
         self._pool = None  # discovery pool
@@ -496,8 +519,12 @@ class Daemon:
 
     def set_peers(self, peers: List[PeerInfo]) -> None:
         """Hot-swap the peer set (reference SetPeers, gubernator.go:694-789):
-        rebuild both pickers from scratch, reuse live PeerClients by address,
-        and drain clients for peers that disappeared."""
+        rebuild both pickers from scratch, reuse live PeerClients by address
+        (and CircuitBreakers across churn — a flapping discovery backend
+        must not reset open breakers), drain clients for peers that
+        disappeared, and launch a device-side ownership handoff for live
+        rows whose ring owner moved (service/handoff.py)."""
+        old_local = self._local_picker
         local = ReplicatedConsistentHash()
         region = RegionPicker()
         keep: Dict[str, PeerClient] = {}
@@ -511,6 +538,15 @@ class Daemon:
                 client = self._peer_clients.get(info.grpc_address)
                 if client is None:
                     b = self.conf.behaviors
+                    breaker = self._peer_breakers.get(info.grpc_address)
+                    if breaker is None:
+                        breaker = CircuitBreaker(
+                            failure_threshold=b.peer_breaker_errors,
+                            backoff_base_ms=b.peer_breaker_backoff_base_ms,
+                            backoff_cap_ms=b.peer_breaker_backoff_cap_ms,
+                            probe_budget=b.peer_breaker_probes,
+                        )
+                        self._peer_breakers[info.grpc_address] = breaker
                     client = PeerClient(
                         info,
                         batch_wait_ms=b.batch_wait_ms,
@@ -518,12 +554,7 @@ class Daemon:
                         batch_timeout_ms=b.batch_timeout_ms,
                         metrics=self.metrics,
                         channel_credentials=self._client_creds,
-                        breaker=CircuitBreaker(
-                            failure_threshold=b.peer_breaker_errors,
-                            backoff_base_ms=b.peer_breaker_backoff_base_ms,
-                            backoff_cap_ms=b.peer_breaker_backoff_cap_ms,
-                            probe_budget=b.peer_breaker_probes,
-                        ),
+                        breaker=breaker,
                     )
                 keep[info.grpc_address] = client
         dropped = [
@@ -532,16 +563,65 @@ class Daemon:
         self._peer_clients = keep
         self._local_picker = local
         self._region_picker = region
-        if dropped:
-            async def drain():
-                await asyncio.gather(
-                    *(c.shutdown() for c in dropped), return_exceptions=True
-                )
-
+        # closed breakers of departed peers carry no state worth keeping;
+        # open/half-open ones persist so a re-added peer resumes its cooldown
+        for addr in list(self._peer_breakers):
+            if (
+                addr not in keep
+                and self._peer_breakers[addr].state is BreakerState.CLOSED
+            ):
+                del self._peer_breakers[addr]
+        self._orphaned_clients.extend(dropped)
+        self._flush_orphans()
+        # ---- topology-change handoff: live rows whose ownership moved away
+        # from this daemon follow it to the new owner (rebalance diff). The
+        # initial set_peers (old ring empty) and no-op swaps (same address
+        # set — e.g. the cert watcher's re-dial, a peer restart) skip it.
+        if (
+            self.conf.behaviors.handoff_enabled
+            and not self._shutting_down
+            and old_local.size() > 0
+            and local.size() > 0
+            and {p.grpc_address for p in old_local.peers()}
+            != {p.grpc_address for p in local.peers()}
+        ):
             try:
-                asyncio.get_running_loop().create_task(drain())
+                loop = asyncio.get_running_loop()
             except RuntimeError:
-                pass  # no loop (tests building daemons synchronously)
+                pass  # no loop (synchronous test wiring): nothing to move yet
+            else:
+                t = loop.create_task(
+                    self._rebalance_handoff(old_local, local),
+                    name="handoff-rebalance",
+                )
+                self._handoff_tasks.add(t)
+                t.add_done_callback(self._handoff_tasks.discard)
+
+    async def _rebalance_handoff(self, old_picker, new_picker) -> None:
+        try:
+            await self.handoff.rebalance(old_picker, new_picker)
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # pragma: no cover - defensive
+            log.exception("ownership rebalance handoff failed")
+
+    def _flush_orphans(self) -> None:
+        """Drain clients dropped by set_peers. With no running loop (tests
+        wiring daemons synchronously) the clients stay queued and close on
+        the next loop entry — previously they leaked their channels."""
+        if not self._orphaned_clients:
+            return
+        clients, self._orphaned_clients = self._orphaned_clients, []
+
+        async def drain():
+            await asyncio.gather(
+                *(c.shutdown() for c in clients), return_exceptions=True
+            )
+
+        try:
+            asyncio.get_running_loop().create_task(drain())
+        except RuntimeError:
+            self._orphaned_clients = clients  # retried on next loop entry
 
     def local_peers(self) -> List[PeerInfo]:
         return self._local_picker.peers()
@@ -630,6 +710,15 @@ class Daemon:
             else:
                 forwards.append((i, hash_keys[i], items[i]))
 
+        if local_rows and not standalone and self.conf.behaviors.handoff_enabled:
+            # sidecar for topology-change handoff: remember each owned row's
+            # ring point (fp and point are not mutually derivable — the
+            # native path records the wire parser's points vectorized)
+            self.ownership.record_keys(
+                (cols.fp[i] for i in local_rows),
+                (hash_keys[i] for i in local_rows),
+                self._local_picker.hash_fn,
+            )
         if owner_global_rows and not standalone:
             # clustered: owner-daemon GLOBAL answers must stay authoritative
             # so the cross-daemon broadcast (queue_update below) carries a
@@ -763,6 +852,10 @@ class Daemon:
         global_rows = np.nonzero(valid & ~mine & is_global)[0]
         fwd_rows = np.nonzero(valid & ~mine & ~is_global)[0]
         if self._local_picker.size() > 0:
+            if self.conf.behaviors.handoff_enabled and local_rows.size:
+                # handoff sidecar: the native parser already computed each
+                # item's ring point — record owned rows vectorized
+                self.ownership.record(cols.fp[local_rows], ring[local_rows])
             # clustered: keep owner-side GLOBAL authoritative (see _route)
             lg = local_rows[is_global[local_rows]]
             if lg.size:
@@ -1010,6 +1103,15 @@ class Daemon:
             if has_behavior(it.behavior, Behavior.GLOBAL):
                 it.behavior |= int(Behavior.DRAIN_OVER_LIMIT)
         cols, hash_keys = columns_from_pb(items)
+        if self._local_picker.size() > 0 and self.conf.behaviors.handoff_enabled:
+            # forwarded batches execute owner-side too: record their ring
+            # points for the handoff sidecar
+            ok = [i for i in range(len(items)) if cols.err[i] == 0]
+            self.ownership.record_keys(
+                (cols.fp[i] for i in ok),
+                (hash_keys[i] for i in ok),
+                self._local_picker.hash_fn,
+            )
         # strip GLOBAL before the local check so the engine path does not
         # depend on it; broadcast queueing happens below
         cols = cols._replace(behavior=cols.behavior & ~np.int32(int(Behavior.GLOBAL)))
@@ -1061,6 +1163,31 @@ class Daemon:
             ).inc()
         return peers_pb.UpdatePeerGlobalsResp()
 
+    async def transfer_state(
+        self, req: "handoff_pb.TransferStateReq"
+    ) -> "handoff_pb.TransferStateResp":
+        """Receive one ownership-handoff chunk (service/handoff.py): merge
+        the rows through the conservative merge kernel (kernel2.merge2 —
+        remaining=min, expiry=max, newest config wins) and remember their
+        ring points so a later rebalance can route them onward. Idempotent:
+        a replayed (transfer_id, chunk) answers from the ledger without
+        re-merging — and the merge semantics make even a ledger miss
+        harmless (min/max can only tighten)."""
+        from gubernator_tpu.service.wire import transfer_chunk_arrays
+
+        key = (req.transfer_id, int(req.chunk))
+        cached = self._applied_transfers.get(key)
+        if cached is not None:
+            return handoff_pb.TransferStateResp(merged=cached, duplicate=True)
+        fps, points, slots = transfer_chunk_arrays(req)
+        merged = await self.runner.merge_rows(fps, slots)
+        self.ownership.record(fps, points)
+        self.metrics.handoff_rows.labels(phase="merged").inc(merged)
+        self._applied_transfers[key] = merged
+        while len(self._applied_transfers) > 4096:
+            self._applied_transfers.popitem(last=False)
+        return handoff_pb.TransferStateResp(merged=merged)
+
     # ----------------------------------------------------------------- health
     async def health_check(self) -> "pb.HealthCheckResp":
         """Aggregate per-peer recent errors + breaker states (reference
@@ -1086,7 +1213,12 @@ class Daemon:
             # device buffers are suspect, so this instance must read
             # unhealthy even though the process is alive
             fatal.append(f"engine poisoned: {poisoned}")
-        if fatal:
+        if self._leaving:
+            # graceful drain in progress: probes and peers must route around
+            # this instance BEFORE it disappears (its owned state is moving
+            # to the ring successors right now)
+            status = "leaving"
+        elif fatal:
             status = "unhealthy"
         elif errs or breaker_alarm:
             status = "degraded"
@@ -1150,11 +1282,23 @@ class Daemon:
             loader.save(self.runner.snapshot_sync())
 
     # ---------------------------------------------------------------- close
-    async def close(self) -> None:
+    async def stop(self, drain: bool = False) -> None:
+        """Graceful shutdown; `drain=True` additionally hands every owned
+        live row to its ring successor before the listeners close (the
+        deployable-under-load path, docs/robustness.md "Topology change &
+        drain")."""
+        await self.close(drain=drain)
+
+    async def close(self, drain: bool = False) -> None:
         """Graceful shutdown (reference daemon.go:388-434): stop intake,
-        drain batches + global queues, checkpoint, stop listeners."""
+        drain batches + global queues, [hand off owned state], checkpoint,
+        stop listeners."""
         if self._shutting_down:
             return
+        if drain:
+            # health flips to "leaving" first so probes/peers route around
+            # this instance while its state moves
+            self._leaving = True
         self._shutting_down = True  # live_check now fails → LBs de-register
         if self.conf.graceful_termination_delay_s > 0:
             # keep serving while load balancers notice the failing liveness
@@ -1180,13 +1324,28 @@ class Daemon:
                 pass
         if self._pool is not None:
             await self._pool.close()
-        await self.global_manager.close()
+        # in-flight rebalance handoffs yield to the final drain pass (or to
+        # plain shutdown — their rows simply stay local)
+        for t in list(self._handoff_tasks):
+            t.cancel()
+        if self._handoff_tasks:
+            await asyncio.gather(*self._handoff_tasks, return_exceptions=True)
+        await self.global_manager.close()  # flushes pending GLOBAL queues
         await self.region_manager.close()
         await self.batcher.drain()
+        if drain and self.conf.behaviors.handoff_enabled:
+            # hand owned live rows to ring successors under the deadline;
+            # whatever stays unacked is snapshotted by maybe_checkpoint below
+            try:
+                await self.handoff.drain()
+            except Exception:  # pragma: no cover - defensive
+                log.exception("graceful drain handoff failed")
         await asyncio.gather(
             *(c.shutdown() for c in self._peer_clients.values()),
+            *(c.shutdown() for c in self._orphaned_clients),
             return_exceptions=True,
         )
+        self._orphaned_clients = []
         for s in self._servers:
             await s.stop()
         if getattr(self.engine, "mesh_global", False) and self.engine.has_pending():

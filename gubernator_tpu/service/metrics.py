@@ -183,6 +183,32 @@ class DaemonMetrics:
             "or hitting the queue cap",
             registry=r,
         )
+        # --- topology-change handoff (service/handoff.py; docs/robustness.md
+        # "Topology change & drain") — the rolling-restart chaos test asserts
+        # row-count parity between phases across daemons, so phase labels are
+        # load-bearing: extracted (rows leaving the source table) ≥
+        # transferred (acked by a destination) = merged (applied by a
+        # destination) + tombstoned (zeroed at the source post-ack);
+        # snapshotted = the unacked remainder left for the shutdown
+        # checkpoint.
+        self.handoff_rows = Counter(
+            "gubernator_handoff_rows",
+            "Live rows moved through each ownership-handoff phase",
+            ["phase"],  # extracted|transferred|merged|tombstoned|snapshotted
+            registry=r,
+        )
+        self.handoff_duration = Histogram(
+            "gubernator_handoff_duration",
+            "Seconds per ownership-handoff round (extract → transfer → "
+            "tombstone)",
+            registry=r,
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+        )
+        self.handoff_chunk_retries = Counter(
+            "gubernator_handoff_chunk_retries",
+            "TransferState chunks re-sent after a peer error",
+            registry=r,
+        )
         # --- GLOBAL behavior (global.go:53-79 analog; names must match, the
         # convergence tests key on them)
         self.global_send_duration = Summary(

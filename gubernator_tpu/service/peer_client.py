@@ -20,12 +20,14 @@ import grpc
 
 from gubernator_tpu import tracing
 from gubernator_tpu.proto import gubernator_pb2 as pb
+from gubernator_tpu.proto import handoff_pb2 as handoff_pb
 from gubernator_tpu.proto import peers_pb2 as peers_pb
 from gubernator_tpu.service.breaker import CircuitBreaker
 from gubernator_tpu.types import Behavior, PeerInfo, has_behavior
 
 GET_PEER_RATE_LIMITS = "/pb.gubernator.PeersV1/GetPeerRateLimits"
 UPDATE_PEER_GLOBALS = "/pb.gubernator.PeersV1/UpdatePeerGlobals"
+TRANSFER_STATE = "/pb.gubernator.PeersV1/TransferState"
 GET_RATE_LIMITS = "/pb.gubernator.V1/GetRateLimits"
 HEALTH_CHECK = "/pb.gubernator.V1/HealthCheck"
 
@@ -154,6 +156,17 @@ class PeerClient:
     ) -> "peers_pb.UpdatePeerGlobalsResp":
         return await self._unary(
             UPDATE_PEER_GLOBALS, req, peers_pb.UpdatePeerGlobalsResp, timeout
+        )
+
+    async def transfer_state(
+        self, req: "handoff_pb.TransferStateReq", timeout: Optional[float] = None
+    ) -> "handoff_pb.TransferStateResp":
+        """One ownership-handoff chunk toward this peer. Breaker-gated like
+        every unary (an open breaker fast-fails so the handoff's deadline is
+        spent on reachable destinations); idempotent on the receiver, so the
+        caller retries failed chunks freely."""
+        return await self._unary(
+            TRANSFER_STATE, req, handoff_pb.TransferStateResp, timeout
         )
 
     # ------------------------------------------------- forwarding (batched)

@@ -203,6 +203,30 @@ class EngineRunner:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(self._exec, self.engine.snapshot)
 
+    # ---------------------------------------------------------- handoff ops
+    # All three mutate (or scan state coherent with) the device table, so
+    # they serialize onto the engine thread like every dispatch.
+
+    async def extract_live(self, now_ms: Optional[int] = None):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._exec, lambda: self.engine.extract_live(now_ms)
+        )
+
+    async def merge_rows(
+        self, fps: np.ndarray, slots: np.ndarray, now_ms: Optional[int] = None
+    ) -> int:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._exec, lambda: self.engine.merge_rows(fps, slots, now_ms)
+        )
+
+    async def tombstone_fps(self, fps: np.ndarray) -> int:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._exec, lambda: self.engine.tombstone_fps(fps)
+        )
+
     async def maybe_grow(self, **kw) -> bool:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(

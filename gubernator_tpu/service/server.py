@@ -17,6 +17,7 @@ from aiohttp import web
 from google.protobuf import json_format
 
 from gubernator_tpu.proto import gubernator_pb2 as pb
+from gubernator_tpu.proto import handoff_pb2 as handoff_pb
 from gubernator_tpu.proto import peers_pb2 as peers_pb
 
 V1 = "pb.gubernator.V1"
@@ -78,6 +79,13 @@ def build_grpc_services(daemon):
     async def update_peer_globals(request: peers_pb.UpdatePeerGlobalsReq, context):
         return await daemon.update_peer_globals(request)
 
+    @_timed(m, "/peers.TransferState")
+    async def transfer_state(request: handoff_pb.TransferStateReq, context):
+        try:
+            return await daemon.transfer_state(request)
+        except ValueError as exc:  # malformed chunk buffers
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+
     def unary(fn, req_cls, resp_cls):
         return grpc.unary_unary_rpc_method_handler(
             fn,
@@ -111,6 +119,11 @@ def build_grpc_services(daemon):
                 update_peer_globals,
                 peers_pb.UpdatePeerGlobalsReq,
                 peers_pb.UpdatePeerGlobalsResp,
+            ),
+            "TransferState": unary(
+                transfer_state,
+                handoff_pb.TransferStateReq,
+                handoff_pb.TransferStateResp,
             ),
         },
     )

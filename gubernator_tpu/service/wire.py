@@ -137,6 +137,54 @@ def peer_req_pb(items: Sequence["pb.RateLimitReq"]) -> "peers_pb.GetPeerRateLimi
     return peers_pb.GetPeerRateLimitsReq(requests=items)
 
 
+# ------------------------------------------------------------ state handoff
+
+
+def transfer_chunk_pb(
+    transfer_id: str,
+    chunk: int,
+    total_chunks: int,
+    source_address: str,
+    now_ms: int,
+    fps: np.ndarray,
+    points: np.ndarray,
+    slots: np.ndarray,
+):
+    """One TransferState chunk from extract arrays (little-endian memory
+    images — no per-row message objects; see proto/handoff_pb2.py)."""
+    from gubernator_tpu.proto import handoff_pb2 as handoff_pb
+
+    return handoff_pb.TransferStateReq(
+        transfer_id=transfer_id,
+        chunk=chunk,
+        total_chunks=total_chunks,
+        source_address=source_address,
+        now_ms=now_ms,
+        count=int(fps.shape[0]),
+        fps=np.ascontiguousarray(fps, dtype=np.int64).tobytes(),
+        points=np.ascontiguousarray(points, dtype=np.uint32).tobytes(),
+        slots=np.ascontiguousarray(slots, dtype=np.int32).tobytes(),
+    )
+
+
+def transfer_chunk_arrays(req):
+    """Decode a TransferStateReq back into (fps, points, slots) arrays,
+    validating the advertised count against every buffer length (a short
+    buffer must fail loudly, not merge garbage rows)."""
+    from gubernator_tpu.ops.table2 import F
+
+    n = int(req.count)
+    fps = np.frombuffer(req.fps, dtype=np.int64)
+    points = np.frombuffer(req.points, dtype=np.uint32)
+    slots = np.frombuffer(req.slots, dtype=np.int32)
+    if fps.shape[0] != n or points.shape[0] != n or slots.shape[0] != n * F:
+        raise ValueError(
+            f"transfer chunk length mismatch: count={n} fps={fps.shape[0]} "
+            f"points={points.shape[0]} slots={slots.shape[0]}"
+        )
+    return fps, points, slots.reshape(n, F)
+
+
 # ----------------------------------------------------------- native ingress
 
 

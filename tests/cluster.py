@@ -113,6 +113,45 @@ class Cluster:
             d.set_peers([PeerInfo(**vars(p)) for p in peers])
         return new
 
+    async def drain_restart(self, i: int, mid_handoff=None) -> Daemon:
+        """Rolling-restart step with graceful state handoff (the reference
+        has no analog — docs/robustness.md "Topology change & drain"):
+
+        1. the surviving daemons drop daemon i from their peer set (the
+           discovery/LB view once its health flips to "leaving");
+        2. daemon i drains — flushes GLOBAL queues, hands every owned live
+           row to its ring successor, snapshots the unacked remainder —
+           then closes;
+        3. a replacement spawns on the same config and every daemon re-adds
+           it: the survivors' rebalance diff hands the moved rows BACK.
+
+        `mid_handoff` (async callable) runs between de-registration and the
+        drain — the hook chaos tests use to inject faults mid-handoff."""
+        old = self.daemons[i]
+        survivors = [d for j, d in enumerate(self.daemons) if j != i]
+        peers_without = [d.peer_info() for d in survivors]
+        for d in survivors:
+            d.set_peers([PeerInfo(**vars(p)) for p in peers_without])
+        if mid_handoff is not None:
+            await mid_handoff()
+        await old.stop(drain=True)
+        new = await Daemon.spawn(old.conf)
+        self.daemons[i] = new
+        peers = [d.peer_info() for d in self.daemons]
+        for d in self.daemons:
+            d.set_peers([PeerInfo(**vars(p)) for p in peers])
+        await self.settle_handoffs()
+        return new
+
+    async def settle_handoffs(self) -> None:
+        """Wait for every daemon's in-flight rebalance handoff tasks (the
+        set_peers diff launches them fire-and-forget)."""
+        for d in self.daemons:
+            while d._handoff_tasks:
+                await asyncio.gather(
+                    *list(d._handoff_tasks), return_exceptions=True
+                )
+
     async def stop(self) -> None:
         await asyncio.gather(*(d.close() for d in self.daemons))
         await asyncio.gather(
