@@ -39,6 +39,7 @@ import jax.numpy as jnp
 from gubernator_tpu.bench_guard import (
     WorkMismatchError,
     check_dropped,
+    check_transport,
     check_work,
     slope,
 )
@@ -469,15 +470,18 @@ def config5_case(rng, now) -> Case:
                 math="token")
 
 
-def _pipelined_checks(eng, cols_iter, now):
+def _pipelined_checks(eng, cols_iter, now, depth=2):
     """Drive check batches through the engine's prepare/issue/finish split
-    with a depth-1 software pipeline — the serving loop the daemon's
-    EngineRunner runs across threads, single-threaded here: issue(N+1)
-    enqueues while N's outputs are still on-device, so the fetch leaves the
-    per-dispatch critical path. The serial check_columns loop paid host
-    stage + launch + fetch back-to-back per dispatch — on an RTT-bound
-    transport that is the whole config3 gap (BENCH_r05: 2412 ms/dispatch
-    vs ~10 ms of device time)."""
+    with a depth-`depth` software pipeline — the serving loop the daemon's
+    EngineRunner runs across threads, single-threaded here. At the default
+    depth 2 the stage/put of dispatch N+1 and the fetch of N−1 both overlap
+    device execution of N (double-buffered transfers: the ingress staging
+    ring holds both in-flight grids, parallel/sharded._StagingPool). The
+    serial check_columns loop paid host stage + launch + fetch back-to-back
+    per dispatch — on an RTT-bound transport that is the whole config3 gap
+    (BENCH_r05: 2412 ms/dispatch vs ~10 ms of device time)."""
+    from collections import deque
+
     from gubernator_tpu.ops.engine import (
         finish_check_columns,
         issue_check_columns,
@@ -485,17 +489,18 @@ def _pipelined_checks(eng, cols_iter, now):
     )
 
     fixup = lambda fn: fn()
-    prev = None
+    pend = deque()
     for cols in cols_iter:
-        pending = issue_check_columns(
-            eng, prepare_check_columns(eng, cols, now_ms=now)
+        pend.append(
+            issue_check_columns(
+                eng, prepare_check_columns(eng, cols, now_ms=now)
+            )
         )
-        if prev is not None:
-            _rc, delta = finish_check_columns(eng, prev, fixup)
+        if len(pend) > depth:
+            _rc, delta = finish_check_columns(eng, pend.popleft(), fixup)
             eng.stats.merge(delta)
-        prev = pending
-    if prev is not None:
-        _rc, delta = finish_check_columns(eng, prev, fixup)
+    while pend:
+        _rc, delta = finish_check_columns(eng, pend.popleft(), fixup)
         eng.stats.merge(delta)
 
 
@@ -561,8 +566,15 @@ def sharded_ingress_case(rng, now, batch=1 << 17) -> dict:
         entry["route"] = sharded.route
         entry["dedup"] = sharded.dedup
         for name, eng in (("sharded", sharded), ("local", local)):
-            for i in range(0, live, batch):  # seed the live keyspace
-                eng.check_columns(cols_for(keyspace[i : i + batch]), now_ms=now)
+            # seed through the SAME double-buffered issue/finish split the
+            # timed loop uses: the serial per-batch round trips were ~80
+            # tunnel RTTs of dead time at 10M keys (ISSUE 5 satellite)
+            _pipelined_checks(
+                eng,
+                (cols_for(keyspace[i : i + batch])
+                 for i in range(0, live, batch)),
+                now,
+            )
             _pipelined_checks(eng, (cols_for(staged[i % len(staged)])
                                     for i in range(2)), now)  # warm
 
@@ -578,6 +590,7 @@ def sharded_ingress_case(rng, now, batch=1 << 17) -> dict:
             n_short, n_long = 2, 2 + n_disp
             if hasattr(eng, "take_stage_deltas"):
                 eng.take_stage_deltas()  # reset the split to the timed window
+                eng.take_wire_deltas()
                 d0 = eng.stage_dispatches
             t_short = min(timed(n_short) for _ in range(3))
             t_long = min(timed(n_long) for _ in range(3))
@@ -590,6 +603,7 @@ def sharded_ingress_case(rng, now, batch=1 << 17) -> dict:
                 rec["invalid"] = s.reason
             if hasattr(eng, "take_stage_deltas"):
                 stage = eng.take_stage_deltas()
+                wire = eng.take_wire_deltas()
                 nd = max(1, eng.stage_dispatches - d0)
                 rec["host_stage_ms"] = {
                     k: round(v / nd, 3) for k, v in stage.items()
@@ -597,6 +611,22 @@ def sharded_ingress_case(rng, now, batch=1 << 17) -> dict:
                 rec["host_stage_total_ms"] = round(
                     sum(stage.values()) / nd, 3
                 )
+                rec["wire"] = eng.wire
+                # denominator = client decisions in the timed window (3
+                # repetitions of each slope point); retry sub-dispatches'
+                # bytes stay in the numerator — this is bytes/DECISION,
+                # the acceptance surface, not bytes/transfer
+                rows_timed = 3 * (n_short + n_long) * batch
+                rec["wire_bytes_per_row"] = {
+                    k: round(v / rows_timed, 2) for k, v in wire.items()
+                }
+                # transport-dominance gate: the timed window's put share
+                # must be accountable against the bytes it shipped
+                bad = check_transport(
+                    stage["put"] / 1e3, wire["put"], label=f"{name}-put"
+                )
+                if bad:
+                    rec["transport_guard"] = bad
             # a drop storm would let a "fast" path publish while shedding
             # work into retries (bench_guard gate, same as config6)
             guard = check_dropped(
@@ -687,9 +717,16 @@ def config3_global_case(rng, now, live=10_000_000, batch=1 << 17,
     for name, eng in engines.items():
         t0 = time.perf_counter()
         # seed the full keyspace through the PLAIN path on both engines
-        # (GLOBAL seeding would queue 10M broadcast markers)
-        for i in range(0, live, batch):
-            eng.check_columns(cols_for(keyspace[i: i + batch], 0), now_ms=now)
+        # (GLOBAL seeding would queue 10M broadcast markers), driven by the
+        # double-buffered issue/finish split: the serial loop paid one
+        # blocking round trip per 131K-row batch — ~80 tunnel RTTs of dead
+        # time per engine at 10M keys (ISSUE 5 satellite)
+        _pipelined_checks(
+            eng,
+            (cols_for(keyspace[i: i + batch], 0)
+             for i in range(0, live, batch)),
+            now,
+        )
         log(f"[config3-global] {name}: seeded {live:,} keys in "
             f"{time.perf_counter() - t0:.0f}s")
 
@@ -745,6 +782,9 @@ def config3_global_case(rng, now, live=10_000_000, batch=1 << 17,
     n_short, n_long = 2, 14
     for name in engines:
         timed(name, 2)  # warm residual shapes
+        # scope the wire-byte and stage-delta windows to the timed phase
+        engines[name].take_wire_deltas()
+        engines[name].take_stage_deltas()
     samples = {name: {"s": [], "l": []} for name in engines}
     for _rep in range(3):
         for name in engines:
@@ -773,6 +813,22 @@ def config3_global_case(rng, now, live=10_000_000, batch=1 << 17,
         }
         out[f"{name}_route"] = eng.route
         out[f"{name}_dedup"] = eng.dedup
+        out[f"{name}_wire"] = eng.wire
+        # bytes/decision over the timed phase (the acceptance surface for
+        # the compact-wire reduction), plus the transport-dominance gate
+        wire = eng.take_wire_deltas()
+        stage_d = eng.take_stage_deltas()
+        # denominator = client decisions in the interleaved timed phase
+        # (bytes/DECISION — retry sub-dispatch bytes stay in the numerator)
+        rows_timed = 3 * (n_short + n_long) * batch
+        out[f"{name}_wire_bytes_per_row"] = {
+            k: round(v / rows_timed, 2) for k, v in wire.items()
+        }
+        bad = check_transport(
+            stage_d["put"] / 1e3, wire["put"], label=f"config3-{name}-put"
+        )
+        if bad:
+            out[f"{name}_transport_guard"] = bad
 
     # (b) collective sync: queue a few batches' worth of hits, then time
     # the FUSED drain (sync() runs R rounds per launch); the first pass is
@@ -938,6 +994,52 @@ def sweep_parity_smoke(rng, now):
         ok = ok and bool(jnp.array_equal(tables[w].rows, tables["xla"].rows))
     log(f"[parity] sweep+sparse vs xla on {jax.default_backend()}: "
         f"responses+tables equal = {ok}")
+    return ok
+
+
+def wire_parity_smoke(rng, now):
+    """Compact-wire vs full-width parity on the real backend: two
+    ShardedEngines at the backend-default route/dedup, one forced
+    wire="compact" and one wire="full" (the oracle), serve identical
+    token/leaky/duplicate-key/flagged batches — responses must match
+    row-for-row. This is the record's proof that the wire win is an
+    encoding, not a semantics change: the RTT-immune timing loops cannot
+    see a decode that reconstructs the wrong request. Returns True/False."""
+    from gubernator_tpu.ops.batch import RequestColumns
+    from gubernator_tpu.parallel import make_mesh
+    from gubernator_tpu.parallel.sharded import ShardedEngine
+
+    mesh = make_mesh()
+    n = 4096
+    kw = dict(capacity_per_shard=1 << 15)
+    ec = ShardedEngine(mesh, wire="compact", **kw)
+    ef = ShardedEngine(mesh, wire="full", **kw)
+    ok = True
+    for step in range(3):
+        fp = rng.integers(1, (1 << 63) - 1, size=n, dtype=np.int64)
+        if step == 1:
+            fp[n // 2 :] = fp[: n - n // 2]  # duplicate keys (dedup path)
+        cols = RequestColumns(
+            fp=fp,
+            algo=rng.integers(0, 2, n).astype(np.int32),
+            behavior=rng.choice([0, 8, 32], size=n).astype(np.int32),
+            hits=rng.integers(0, 4, n).astype(np.int64),
+            limit=np.full(n, 100, dtype=np.int64),
+            burst=np.zeros(n, dtype=np.int64),
+            duration=np.full(n, 60_000, dtype=np.int64),
+            created_at=np.full(n, now, dtype=np.int64),
+            err=np.zeros(n, dtype=np.int8),
+        )
+        rc = ec.check_columns(cols, now_ms=now + step)
+        rf = ef.check_columns(cols, now_ms=now + step)
+        for f in ("status", "limit", "remaining", "reset_time", "err"):
+            ok = ok and bool(np.array_equal(getattr(rc, f), getattr(rf, f)))
+    w, wf = ec.take_wire_deltas(), ef.take_wire_deltas()
+    log(
+        f"[wire-parity] compact vs full on {jax.default_backend()}: "
+        f"equal={ok}; bytes put {w['put']} vs {wf['put']}, "
+        f"fetch {w['fetch']} vs {wf['fetch']}"
+    )
     return ok
 
 
@@ -1173,6 +1275,11 @@ def main() -> None:
         lambda: headline_case(np.random.default_rng(42), now).run(),
     )
     matrix = {"parity_sweep_vs_xla": parity_ok}
+    # compact-wire vs full-width row-for-row parity (acceptance smoke for
+    # the ISSUE 5 wire work; also runs under pytest on the CPU mesh)
+    matrix["parity_wire_compact"] = _attempt(
+        "wire-parity", lambda: wire_parity_smoke(np.random.default_rng(50), now)
+    )
     matrix["e2e-serving"] = _attempt("e2e-serving", e2e_serving_case)
 
     def run_config(builder, name, seed):

@@ -110,6 +110,159 @@ def sharded_smoke() -> dict:
     return out
 
 
+def wire_smoke() -> dict:
+    """Compact-wire regression gate (ISSUE 5): on the 8-device mesh with
+    the TPU serving defaults forced (device route/dedup, compact wire),
+
+      * responses must match the full-width oracle row-for-row over
+        token/leaky/duplicate-key/flagged traffic;
+      * marginal bytes/row across two batch sizes must stay within the
+        wire budget — put ≤ 24 B/row and fetch ≤ 16 B/row (marginal cost
+        is the honest transport-proportionality metric: it cancels the
+        fixed per-dispatch base column and stats rows) — and beat the
+        full-width layout ≥3× on put, ≥2× on fetch;
+      * double-buffered dispatch wall time must stay batch-proportional
+        (the sharded_smoke bound, driven through the depth-2 pipelined
+        issue/finish split this time);
+      * the transport gate must not reject the window for claiming bytes
+        it could not have moved (impossible-bandwidth side only — CI
+        runners are legitimately slow, so the drift side is reported,
+        not fatal).
+    """
+    import time as _time
+
+    from gubernator_tpu.bench_guard import check_transport
+    from gubernator_tpu.ops.engine import (
+        finish_check_columns,
+        issue_check_columns,
+        prepare_check_columns,
+    )
+    from gubernator_tpu.parallel import make_mesh
+    from gubernator_tpu.parallel.sharded import ShardedEngine
+
+    mesh = make_mesh(8)
+    kw = dict(
+        capacity_per_shard=1 << 12, write_mode="xla",
+        route="device", dedup="device",
+    )
+    ec = ShardedEngine(mesh, wire="compact", **kw)
+    ef = ShardedEngine(mesh, wire="full", **kw)
+    rng = np.random.default_rng(7)
+    big, small = 4096, 512
+
+    def mixed_cols(fp):
+        n = fp.shape[0]
+        c = cols(fp)
+        return c._replace(
+            behavior=rng.choice([0, 8, 32], size=n).astype(np.int32),
+            hits=rng.integers(0, 4, n).astype(np.int64),
+        )
+
+    state = rng.bit_generator.state
+    for eng in (ec, ef):
+        rng.bit_generator.state = state
+        for step in range(4):
+            n = big if step % 2 else small
+            fp = rng.integers(1, (1 << 63) - 1, size=n, dtype=np.int64)
+            if step == 3:
+                fp[n // 2 :] = fp[: n - n // 2]  # duplicate keys
+            rc = eng.check_columns(mixed_cols(fp), now_ms=NOW)
+            if eng is ec:
+                saved = getattr(ec, "_smoke", [])
+                saved.append(rc)
+                ec._smoke = saved
+            else:
+                want = ec._smoke[step]
+                for f in ("status", "limit", "remaining", "reset_time", "err"):
+                    if not np.array_equal(getattr(rc, f), getattr(want, f)):
+                        print(json.dumps({
+                            "error": f"wire smoke: compact/full mismatch in "
+                                     f"{f} at step {step}"}))
+                        sys.exit(1)
+
+    def bytes_per_dispatch(eng, n, k=6):
+        eng.take_wire_deltas()
+        fps = rng.integers(1, (1 << 63) - 1, size=(k, n), dtype=np.int64)
+        for i in range(k):
+            eng.check_columns(cols(fps[i]), now_ms=NOW)
+        w = eng.take_wire_deltas()
+        return w["put"] / k, w["fetch"] / k
+
+    marg = {}
+    for label, eng in (("compact", ec), ("full", ef)):
+        put_s, fetch_s = bytes_per_dispatch(eng, small)
+        put_b, fetch_b = bytes_per_dispatch(eng, big)
+        marg[label] = (
+            (put_b - put_s) / (big - small),
+            (fetch_b - fetch_s) / (big - small),
+        )
+    put_row, fetch_row = marg["compact"]
+    put_ratio = marg["full"][0] / max(put_row, 1e-9)
+    fetch_ratio = marg["full"][1] / max(fetch_row, 1e-9)
+    out = {
+        "put_bytes_per_row": round(put_row, 2),
+        "fetch_bytes_per_row": round(fetch_row, 2),
+        "put_reduction_vs_full": round(put_ratio, 2),
+        "fetch_reduction_vs_full": round(fetch_ratio, 2),
+    }
+    if put_row > 24 or fetch_row > 16:
+        print(json.dumps({"error": "compact wire over budget (put ≤ 24, "
+                          "fetch ≤ 16 B/row)", **out}))
+        sys.exit(1)
+    if put_ratio < 3.0 or fetch_ratio < 2.0:
+        print(json.dumps({"error": "compact wire reduction under the "
+                          "acceptance floor (≥3x put, ≥2x fetch)", **out}))
+        sys.exit(1)
+
+    # double-buffered wall-time proportionality through the depth-2 split
+    def piped_wall(n, k=12):
+        fps = rng.integers(1, (1 << 63) - 1, size=(k, n), dtype=np.int64)
+        fixup = lambda fn: fn()
+        t0 = _time.perf_counter()
+        pend = []
+        for i in range(k):
+            pend.append(issue_check_columns(
+                ec, prepare_check_columns(ec, cols(fps[i]), now_ms=NOW)
+            ))
+            if len(pend) > 2:
+                _rc, delta = finish_check_columns(ec, pend.pop(0), fixup)
+                ec.stats.merge(delta)
+        while pend:
+            _rc, delta = finish_check_columns(ec, pend.pop(0), fixup)
+            ec.stats.merge(delta)
+        return _time.perf_counter() - t0
+
+    piped_wall(small, k=2)  # warm
+    piped_wall(big, k=2)
+    small_s = min(piped_wall(small) for _ in range(3))
+    big_s = min(piped_wall(big) for _ in range(3))
+    SLACK = 4.0
+    ok = big_s <= (big / small) * SLACK * max(small_s, 1e-4)
+    out["piped_small_s"] = round(small_s, 4)
+    out["piped_big_s"] = round(big_s, 4)
+    out["piped_proportional"] = bool(ok)
+    if not ok:
+        print(json.dumps({"error": "double-buffered sharded dispatch wall "
+                          "time is super-linear in batch rows", **out}))
+        sys.exit(1)
+
+    # transport gate: only the impossible-bandwidth side is fatal on CI
+    ec.take_wire_deltas()
+    ec.take_stage_deltas()
+    for i in range(6):
+        ec.check_columns(cols(rng.integers(1, (1 << 63) - 1, size=big,
+                                           dtype=np.int64)), now_ms=NOW)
+    w = ec.take_wire_deltas()
+    put_ms = ec.take_stage_deltas()["put"]
+    guard = check_transport(put_ms / 1e3, w["put"], min_bandwidth=0.0)
+    out["transport_guard"] = guard or "ok"
+    if guard:
+        print(json.dumps({"error": f"wire smoke transport gate: {guard}",
+                          **out}))
+        sys.exit(1)
+    return out
+
+
 def handoff_smoke() -> dict:
     """Topology-handoff regression gate: extract + conservative-merge of
     ~100k live rows across an 8-device mesh must be batch-proportional on
@@ -202,6 +355,7 @@ def main() -> None:
     print(json.dumps({
         "decisions_per_sec": round(best, 1),
         "sharded_smoke": sharded_smoke(),
+        "wire_smoke": wire_smoke(),
         "handoff_smoke": handoff_smoke(),
     }))
 

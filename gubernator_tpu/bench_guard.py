@@ -109,6 +109,64 @@ def check_work(
     return None
 
 
+# Transfer-bandwidth plausibility band for the transport-dominance gate.
+# Upper bound: no host<->device link this code runs over beats PCIe gen5
+# x16-class speed; a timed window whose bytes/second exceed it did NOT move
+# the bytes it reports (the win is timing drift, not wire engineering).
+# Lower bound: a "transfer" phase moving under ~1 MB/s isn't transfer at
+# all — the window's transport share is dominated by something the byte
+# count can't account for (RTT weather, a stall), so attributing a wire win
+# to it would publish drift as engineering.
+MAX_SANE_BANDWIDTH = 64e9  # bytes/s
+MIN_SANE_BANDWIDTH = 1e6  # bytes/s
+
+
+def check_transport(
+    transfer_s: float,
+    bytes_on_wire: int,
+    *,
+    min_bandwidth: float = MIN_SANE_BANDWIDTH,
+    max_bandwidth: float = MAX_SANE_BANDWIDTH,
+    label: str = "window",
+) -> Optional[str]:
+    """Transport-dominance gate: a timed window's transfer share must be
+    accountable against its reported bytes at a physically plausible
+    bandwidth. `transfer_s` is the wall time the window attributes to
+    host<->device transfers; `bytes_on_wire` the bytes its wire counters
+    say crossed the boundary in that time (ShardedEngine.take_wire_deltas).
+
+    The compact-wire work makes dispatch claims byte-denominated, which
+    cuts both ways: a 'win' can be faked by a window whose timing happens
+    to shrink for reasons unrelated to bytes. The implied bandwidth
+    (bytes / transfer_s) exposes both failure modes — too fast means the
+    bytes were never moved in the measured time, too slow means the
+    measured time wasn't transfer. Returns a refusal reason, or None."""
+    if bytes_on_wire < 0:
+        return f"{label}: negative byte count {bytes_on_wire}"
+    if bytes_on_wire == 0:
+        return None  # nothing claimed against the wire
+    if transfer_s <= 0:
+        return (
+            f"{label}: {bytes_on_wire} bytes claimed against a "
+            f"{transfer_s * 1e3:.3f}ms transfer share — no time in which "
+            "to move them"
+        )
+    implied = bytes_on_wire / transfer_s
+    if implied > max_bandwidth:
+        return (
+            f"{label}: implied transfer bandwidth {implied:.3e} B/s exceeds "
+            f"the physical ceiling {max_bandwidth:.0e} B/s — the window did "
+            "not move the bytes its rate claims"
+        )
+    if implied < min_bandwidth:
+        return (
+            f"{label}: implied transfer bandwidth {implied:.3e} B/s is under "
+            f"{min_bandwidth:.0e} B/s — the transfer share is not explained "
+            "by bytes on the wire (measurement drift, not transport)"
+        )
+    return None
+
+
 def check_dropped(
     dropped: int,
     decisions: int,

@@ -360,6 +360,13 @@ def _stack_pass_outputs(outs):
     shape = getattr(outs[0], "shape", None)
     if shape is None or any(getattr(o, "shape", None) != shape for o in outs[1:]):
         return None
+    # dtype must match too: a batch can mix compact-wire (int32) and
+    # full-width (int64) passes when one pass isn't wire-encodable, and
+    # stacking would silently promote the int32 outputs to int64 —
+    # destroying the dtype tag the host decoder dispatches on
+    dtype = outs[0].dtype
+    if any(o.dtype != dtype for o in outs[1:]):
+        return None
     return _stack_outs(tuple(outs))
 
 
@@ -460,8 +467,18 @@ class LocalEngine:
         table=None,
         created_at_tolerance_ms: Optional[int] = None,
         store=None,
+        wire: Optional[str] = None,
     ):
+        from gubernator_tpu.ops.wire import default_wire_mode
+
         self.table = table if table is not None else new_table2(capacity)
+        # host↔device wire format: "compact" ships 5-lane int32 ingress +
+        # int32 egress (ops/wire.py, the TPU default — GUBER_WIRE_COMPACT),
+        # "full" the 12-lane int64 grids (the parity oracle). Per-dispatch
+        # encodability still falls compact batches back to full-width.
+        if wire is not None and wire not in ("compact", "full"):
+            raise ValueError(f"wire must be 'compact' or 'full', got {wire!r}")
+        self.wire = wire or default_wire_mode()
         # one write mode for every dispatch: the block-sparse Pallas write
         # on TPU (kernel2.resolve_write falls big-batch shapes back to the
         # full sweep), XLA scatter on CPU meshes. A batch-size crossover to
@@ -492,26 +509,52 @@ class LocalEngine:
         self.poisoned: Optional[str] = None
 
     def _decide_packed(self, hb: HostBatch) -> np.ndarray:
-        """One dispatch → ONE host transfer each way: packed (12, B) ingress
-        array in (batch.pack_host_batch), packed (B+2, 4) i64 output fetched
-        (kernel2.pack_outputs). Updates self.table; returns the host array."""
-        import jax
-
+        """One dispatch → ONE host transfer each way: compact 5-lane int32
+        wire block (or full packed (12, B) ingress) in, compact int32 (or
+        packed (B+2, 4) i64) output fetched. Updates self.table; returns
+        the host array (unpack_outputs dispatches on its dtype)."""
         if self._decide_fn is not None:
             # oracle engines return unpacked outputs; pack on device for the
             # same downstream shape
             self.table, resp, stats = self._decide_fn(self.table, to_device(hb))
             return np.asarray(pack_outputs(resp, stats))
-        dev = jax.device_put(pack_host_batch(hb))
-        self.table, packed = decide2_packed_cols(
-            self.table, dev, write=self.write_mode, math=_math_mode(hb)
+        dev, wired = self._stage_ingress(hb)
+        return np.asarray(
+            self._issue_from_dev(dev, int(hb.fp.shape[0]), _math_mode(hb), wired)
         )
-        return np.asarray(packed)
 
-    def _issue_from_dev(self, dev_arr, batch_rows: int, math: str) -> "jax.Array":
+    def _stage_ingress(self, batch: HostBatch):
+        """Stage ONE ingress array for a padded batch: the compact wire
+        block when the engine is in compact mode and the batch is
+        representable (ops/wire.wire_encodable — Gregorian rows, oversize
+        hits/durations, skewed created_at fall back), else the full-width
+        grid. Returns (device array, compact?)."""
+        import jax
+
+        if self.wire == "compact":
+            from gubernator_tpu.ops import wire as wire_mod
+
+            base = wire_mod.pick_base(batch)
+            if wire_mod.wire_encodable(batch, base):
+                return (
+                    jax.device_put(wire_mod.pack_wire_full(batch, base)),
+                    True,
+                )
+        return jax.device_put(pack_host_batch(batch)), False
+
+    def _issue_from_dev(
+        self, dev_arr, batch_rows: int, math: str, wired: bool = False
+    ) -> "jax.Array":
         """Issue one dispatch from a staged ingress array WITHOUT fetching:
         the table advances immediately; the packed output is fetched later
         on a fetch thread while this thread launches the next dispatch."""
+        if wired:
+            from gubernator_tpu.ops.wire import decide2_wire_cols
+
+            self.table, packed = decide2_wire_cols(
+                self.table, dev_arr, write=self.write_mode, math=math
+            )
+            return packed
         self.table, packed = decide2_packed_cols(
             self.table, dev_arr, write=self.write_mode, math=math
         )
@@ -523,17 +566,16 @@ class LocalEngine:
     # engine so mesh engines can substitute routed grids (parallel/sharded.py).
 
     def stage_pass(self, pass_batch: HostBatch, n: int):
-        """(padded batch, staged ingress array + static math mode) for one
-        unique-fp pass."""
-        import jax
-
+        """(padded batch, staged ingress array + static math/wire modes)
+        for one unique-fp pass."""
         batch = pad_batch(pass_batch, _pad_size(n))
-        return batch, (jax.device_put(pack_host_batch(batch)), _math_mode(batch))
+        dev, wired = self._stage_ingress(batch)
+        return batch, (dev, _math_mode(batch), wired)
 
     def issue_staged(self, staged, batch_rows: int):
-        dev, math = staged
+        dev, math, wired = staged
         self._seen_pad_sizes.add(batch_rows)
-        return self._issue_from_dev(dev, batch_rows, math)
+        return self._issue_from_dev(dev, batch_rows, math, wired)
 
     def finish_staged(self, pending, n: int):
         """Materialize one pass's packed output → ((s, l, r, t, dropped,

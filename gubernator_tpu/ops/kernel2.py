@@ -754,7 +754,13 @@ def unpack_outputs(arr, n: int):
     """Decode a fetched pack_outputs array (host-side): (B+2, 4) i64 →
     ((status, limit, remaining, reset_time, dropped, hit), (cache_hits,
     cache_misses, over_limit, evicted_unexpired)). Response arrays are
-    writable copies (retry fix-ups mutate them in place)."""
+    writable copies (retry fix-ups mutate them in place). Compact-wire
+    outputs (int32, base-relative reset — ops/wire.py) are self-describing
+    by dtype and decode through the wire module's twin."""
+    if arr.dtype == np.int32:
+        from gubernator_tpu.ops.wire import unpack_wire_out
+
+        return unpack_wire_out(arr, n)
     st = (int(arr[-2, 0]), int(arr[-2, 1]), int(arr[-2, 2]), int(arr[-2, 3]))
     limit = arr[:n, 0].copy()
     remaining = arr[:n, 1].copy()

@@ -1,0 +1,343 @@
+"""Compact host↔device wire codec for the serving hot path.
+
+BENCH_r05 attributed the whole remaining sharded-dispatch gap (~2.3 s wall
+vs ~10 ms device) to host↔device transport: every dispatch ships a
+(12, B) int64 ingress grid (96 B/row) and fetches a (B+2, 4) int64 output
+(32 B/row) over a link where bytes are the budget. This module shrinks both
+directions with an in-trace-decoded packed layout:
+
+**Ingress — 5 int32 lanes (20 B/row) + one trailing base column:**
+
+  lane 0  fp_lo          low 32 bits of the fingerprint
+  lane 1  fp_hi          high 32 bits (fp == 0 ⇒ inactive row — the packing
+                         invariant every serving path already maintains)
+  lane 2  limit          full int32 (front-door validated to int32)
+  lane 3  duration[0:30] | algo << 30
+  lane 4  hits[0:18] | (created_delta + 2048) << 18 | RESET << 30 | DRAIN << 31
+
+  column B (the +1): cells [0, B], [1, B] carry the batch's created_at BASE
+  (lo/hi int32) — every other per-row timestamp decodes as base-relative.
+
+The decode (decode_wire_block) reconstructs the full 12-column int64 ingress
+array INSIDE the kernel's jit, where the redundant fields are recomputed
+instead of shipped: created_at = base + delta, expire_new = created +
+duration, duration_eff = duration, greg_interval = 0, burst = limit for
+leaky rows (the burst==0→limit defaulting every leaky client config hits),
+0 for token rows (token math never reads burst — ops/math.py). Behavior
+ships as exactly the two bits the decision math consumes (RESET_REMAINING,
+DRAIN_OVER_LIMIT); kernel-inert bits (NO_BATCHING, GLOBAL, MULTI_REGION)
+are dropped on the wire.
+
+**Egress — (B+2, 4) int32 (16 B/row), same row layout as kernel2.pack_outputs:**
+
+  row i < B   [limit, remaining (saturating i32), reset_delta, flags]
+  row B       [cache_hits, cache_misses, over_limit, evicted]  (counts ≤ B)
+  row B+1     [dropped, base_lo, base_hi, 0]
+
+reset_delta = reset_time - base, with -2^31 reserved as the "reset==0"
+sentinel so inactive/removed rows round-trip exactly; the base rides in the
+spare stats cells, making the fetched array self-describing (unpack_outputs
+dispatches on dtype alone). Host-side decode is vectorized numpy.
+
+**Fallback contract.** Not every batch is representable (Gregorian
+durations, hits ≥ 2^18, durations ≥ 2^30 ms, created_at skew beyond
+±2047 ms of the batch base, negative limits, explicit leaky bursts).
+`wire_encodable` checks a batch host-side in a handful of vectorized
+passes; non-encodable dispatches take the full-width path — identical
+semantics, more bytes — and `GUBER_WIRE_COMPACT=0` forces full-width
+everywhere, which is the parity oracle every compact test and bench smoke
+compares against row-for-row.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gubernator_tpu.ops.batch import HostBatch
+from gubernator_tpu.ops.kernel2 import (
+    FLAG_DROPPED,
+    FLAG_HIT,
+    FLAG_STATUS,
+    _hi32,
+    _join64,
+    _lo32,
+    decide2_packed_cols_impl,
+    decide2_packed_dedup_impl,
+)
+
+i32 = jnp.int32
+i64 = jnp.int64
+
+WIRE_LANES = 5  # ingress int32 lanes per row (20 B) — + 1 base column/grid
+WIRE_EGRESS_ROW_BYTES = 16  # (·, 4) int32 egress rows
+DUR_BITS = 30  # duration < 2^30 ms (~12.4 days); beyond → full-width
+HITS_BITS = 18  # hits in [0, 2^18) — covers host-aggregated 131K-row carriers
+DELTA_BITS = 12  # created_at - base in [-2048, 2047] ms
+DELTA_BIAS = 1 << (DELTA_BITS - 1)
+_DUR_MASK = (1 << DUR_BITS) - 1
+_HITS_MASK = (1 << HITS_BITS) - 1
+_DELTA_MASK = (1 << DELTA_BITS) - 1
+RESET_SENTINEL = -(2**31)  # egress reset_delta value for reset_time == 0
+
+# Behavior bits (gubernator_tpu.types.Behavior values, frozen by the proto)
+_RESET = 8  # RESET_REMAINING — consumed by the decision math
+_DRAIN = 32  # DRAIN_OVER_LIMIT — consumed by the decision math
+_GREG = 4  # DURATION_IS_GREGORIAN — host-resolved; forces full-width
+# bits the kernel never reads (ops/math.py) — safe to drop on the wire
+_INERT = 1 | 2 | 16  # NO_BATCHING | GLOBAL | MULTI_REGION
+_ENCODABLE_BEHAVIOR = _RESET | _DRAIN | _INERT
+
+I32_MAX = 2**31 - 1
+
+
+def default_wire_mode() -> str:
+    """Compact wire grids on real TPU (where host↔device bytes are the
+    serving bottleneck), full-width elsewhere (CPU test meshes keep the
+    seed suite's exact transfer shapes). GUBER_WIRE_COMPACT=1/0 forces
+    either mode; per-engine `wire=` overrides both."""
+    env = os.environ.get("GUBER_WIRE_COMPACT")
+    if env is not None:
+        return "compact" if env not in ("0", "false", "off") else "full"
+    return "compact" if jax.default_backend() == "tpu" else "full"
+
+
+# ------------------------------------------------------------- host encode
+
+
+def pick_base(b: HostBatch) -> int:
+    """The batch's created_at base: the first active row's stamp. Serving
+    batches stamp every unset created_at with one ingress `now`
+    (ops/batch.pack_columns), so per-row deltas are 0; rows skewed beyond
+    the delta budget fail wire_encodable and take the full-width path."""
+    act = np.asarray(b.active)
+    if not act.any():
+        return 0
+    return int(b.created_at[int(np.argmax(act))])
+
+
+def wire_encodable(b: HostBatch, base: int) -> bool:
+    """Can this batch ride the compact wire exactly? A handful of
+    vectorized passes over the active rows — cheap against the pack it
+    gates. Every check guards a field the compact layout narrows or
+    recomputes; failing any one falls the dispatch back to full-width
+    (same semantics, more bytes), so this is a perf decision, never a
+    correctness one."""
+    act = np.asarray(b.active)
+    if not act.any():
+        return True
+    fp = b.fp[act]
+    if (fp == 0).any():
+        return False  # active ⟺ fp != 0 is the decode's activity rule
+    beh = b.behavior[act]
+    if (beh & ~np.int32(_ENCODABLE_BEHAVIOR)).any():
+        return False  # Gregorian (host-resolved calendar fields) or unknown
+    if (b.greg_interval[act] != 0).any():
+        return False
+    dur = b.duration[act]
+    if ((dur < 0) | (dur > _DUR_MASK)).any():
+        return False
+    if (b.duration_eff[act] != dur).any():
+        return False
+    created = b.created_at[act]
+    if (b.expire_new[act] != created + dur).any():
+        return False  # expire recomputes in-trace only for the linear rule
+    delta = created - base
+    if ((delta < -DELTA_BIAS) | (delta > DELTA_BIAS - 1)).any():
+        return False
+    hits = b.hits[act]
+    if ((hits < 0) | (hits > _HITS_MASK)).any():
+        return False
+    limit = b.limit[act]
+    if ((limit < 0) | (limit > I32_MAX)).any():
+        return False  # negative limits keep the full-width path's exact
+        # (pathological) arithmetic; positive is the serving domain
+    algo = b.algo[act]
+    if ((algo < 0) | (algo > 1)).any():
+        return False
+    leaky = algo == 1
+    if leaky.any() and (b.burst[act][leaky] != limit[leaky]).any():
+        return False  # leaky burst defaults to limit (pack rule); explicit
+        # bursts are rare enough to ship full-width
+    return True
+
+
+def pack_wire_rows(
+    b: HostBatch, base: int, out: "np.ndarray | None" = None
+) -> np.ndarray:
+    """Pack a (wire_encodable) HostBatch into (5, n) int32 data lanes.
+    Inactive rows encode as all-zero columns (fp == 0 ⇒ inactive on
+    decode). `out` packs straight into pooled staging memory."""
+    n = b.fp.shape[0]
+    if out is None:
+        arr = np.empty((WIRE_LANES, n), dtype=np.int32)
+    else:
+        assert out.shape == (WIRE_LANES, n) and out.dtype == np.int32
+        arr = out
+    act = b.active
+    fp = np.where(act, b.fp, 0)
+    arr[0] = fp.astype(np.int64).astype(np.int32)  # low 32, wrap cast
+    arr[1] = (fp >> 32).astype(np.int32)
+    arr[2] = np.where(act, b.limit, 0).astype(np.int32)
+    l3 = (b.duration & _DUR_MASK) | (b.algo.astype(np.int64) << DUR_BITS)
+    arr[3] = np.where(act, l3, 0).astype(np.int64).astype(np.int32)
+    reset = (b.behavior & _RESET) != 0
+    drain = (b.behavior & _DRAIN) != 0
+    l4 = (
+        (b.hits & _HITS_MASK)
+        | (((b.created_at - base + DELTA_BIAS) & _DELTA_MASK) << HITS_BITS)
+        | (reset.astype(np.int64) << 30)
+        | (drain.astype(np.int64) << 31)
+    )
+    arr[4] = np.where(act, l4, 0).astype(np.int64).astype(np.int32)
+    return arr
+
+
+def pack_wire_full(
+    b: HostBatch, base: int, out: "np.ndarray | None" = None
+) -> np.ndarray:
+    """(5, n+1) int32: data lanes plus the trailing base column — the
+    single-device / single-block ingress form (mesh engines scatter
+    pack_wire_rows into their own grids and stamp the base per block)."""
+    n = b.fp.shape[0]
+    if out is None:
+        arr = np.zeros((WIRE_LANES, n + 1), dtype=np.int32)
+    else:
+        assert out.shape == (WIRE_LANES, n + 1) and out.dtype == np.int32
+        arr = out
+        arr[:, n] = 0
+    pack_wire_rows(b, base, out=arr[:, :n])
+    stamp_base(arr, base)
+    return arr
+
+
+def stamp_base(block: np.ndarray, base: int) -> None:
+    """Write the base into a wire block's trailing column (cells [0, -1]
+    and [1, -1]) — shared by every grid builder so the cell assignment can
+    never diverge from decode_wire_block's."""
+    block[0, -1] = np.int64(base).astype(np.int32)
+    block[1, -1] = np.int64(base >> 32).astype(np.int32)
+
+
+# ------------------------------------------------------------ trace decode
+
+
+def decode_wire_block(blk: jnp.ndarray):
+    """In-trace decode of one (5, W+1) int32 wire block back to the full
+    (12, W) int64 ingress array (kernel2.req_from_arr layout) plus the
+    base scalar. Pure casts/shifts — fuses into the decision kernel, so
+    the narrow wire costs a few vector ops instead of 76 B/row of PCIe/
+    tunnel traffic."""
+    W = blk.shape[1] - 1
+    base = _join64(blk[0, W], blk[1, W])
+    l0, l1, l2, l3, l4 = (blk[i, :W] for i in range(WIRE_LANES))
+    fp = _join64(l0, l1)
+    limit = l2.astype(i64)
+    dur = (l3 & _DUR_MASK).astype(i64)
+    algo = (l3 >> DUR_BITS) & 3
+    hits = (l4 & _HITS_MASK).astype(i64)
+    delta = (((l4 >> HITS_BITS) & _DELTA_MASK) - DELTA_BIAS).astype(i64)
+    behavior = ((l4 >> 30) & 1) * _RESET | ((l4 >> 31) & 1) * _DRAIN
+    created = base + delta
+    active = fp != 0
+    burst = jnp.where(algo == 1, limit, i64(0))
+    arr12 = jnp.stack(
+        [
+            fp,
+            algo.astype(i64),
+            behavior.astype(i64),
+            hits,
+            limit,
+            burst,
+            dur,
+            created,
+            created + dur,  # expire_new (non-Gregorian by encodability)
+            jnp.zeros_like(fp),  # greg_interval
+            dur,  # duration_eff
+            active.astype(i64),
+        ]
+    )
+    return arr12, base
+
+
+def encode_wire_out(packed: jnp.ndarray, base) -> jnp.ndarray:
+    """In-trace egress narrowing: the (B+2, 4) int64 pack_outputs array →
+    int32, reset as a base-relative delta (RESET_SENTINEL preserves
+    reset==0 exactly), remaining/limit saturating-clamped to int32 (both
+    are int32-bounded for every validated config — the clamp only moves
+    values pathological configs could not re-read anyway), and the base
+    stamped into the spare stats cells so the fetched array is
+    self-describing."""
+    B = packed.shape[0] - 2
+    rows = packed[:B]
+    sat = lambda x: jnp.clip(x, -(2**31), 2**31 - 1).astype(i32)
+    reset = rows[:, 2]
+    enc = jnp.where(
+        reset == 0,
+        jnp.int32(RESET_SENTINEL),
+        jnp.clip(reset - base, -(2**31) + 1, 2**31 - 1).astype(i32),
+    )
+    body = jnp.stack([sat(rows[:, 0]), sat(rows[:, 1]), enc, sat(rows[:, 3])], axis=1)
+    stats = jnp.clip(packed[B:], -(2**31), 2**31 - 1).astype(i32)
+    stats = stats.at[1, 1].set(_lo32(base)).at[1, 2].set(_hi32(base))
+    return jnp.concatenate([body, stats], axis=0)
+
+
+# -------------------------------------------------------------- host decode
+
+
+def wire_out_base(arr: np.ndarray) -> int:
+    """The base stamped into a fetched compact egress array."""
+    return (int(arr[-1, 1]) & 0xFFFFFFFF) | (int(arr[-1, 2]) << 32)
+
+
+def decode_wire_rows(per: np.ndarray, base: int) -> np.ndarray:
+    """Vectorized host decode of compact egress response rows ((n, 4)
+    int32 → int64, absolute reset_time). Returns a fresh writable array
+    (retry fix-ups mutate responses in place)."""
+    out = per.astype(np.int64)
+    d = out[:, 2]
+    out[:, 2] = np.where(d == RESET_SENTINEL, 0, base + d)
+    return out
+
+
+def unpack_wire_out(arr: np.ndarray, n: int):
+    """Compact counterpart of kernel2.unpack_outputs (same return shape);
+    kernel2.unpack_outputs dispatches here on dtype, so every caller
+    decodes both wire formats through one entry."""
+    base = wire_out_base(arr)
+    st = (int(arr[-2, 0]), int(arr[-2, 1]), int(arr[-2, 2]), int(arr[-2, 3]))
+    per = decode_wire_rows(arr[:n], base)
+    status = (per[:, 3] & FLAG_STATUS).astype(np.int32)
+    hit = (per[:, 3] & FLAG_HIT) != 0
+    dropped = (per[:, 3] & FLAG_DROPPED) != 0
+    return (status, per[:, 0], per[:, 1], per[:, 2], dropped, hit), st
+
+
+# --------------------------------------------------- single-device entries
+
+
+def decide2_wire_cols_impl(table, carr, *, write="sweep", math="mixed"):
+    """Compact single-transfer serving entry: (5, B+1) int32 wire block in,
+    (B+2, 4) int32 compact outputs out — the narrow-wire twin of
+    kernel2.decide2_packed_cols_impl."""
+    arr12, base = decode_wire_block(carr)
+    table, packed = decide2_packed_cols_impl(table, arr12, write=write, math=math)
+    return table, encode_wire_out(packed, base)
+
+
+def decide2_wire_dedup_impl(table, carr, *, write="sweep", math="mixed"):
+    """Compact entry with in-trace duplicate aggregation (the mesh
+    engines' dedup="device" program built on the narrow wire)."""
+    arr12, base = decode_wire_block(carr)
+    table, packed = decide2_packed_dedup_impl(table, arr12, write=write, math=math)
+    return table, encode_wire_out(packed, base)
+
+
+decide2_wire_cols = functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("write", "math")
+)(decide2_wire_cols_impl)

@@ -82,7 +82,8 @@ def pair_capacity(c: int, D: int) -> int:
 
 
 def make_a2a_decide(
-    mesh: Mesh, c: int, math: str = "mixed", write=None, dedup: bool = False
+    mesh: Mesh, c: int, math: str = "mixed", write=None, dedup: bool = False,
+    wire: bool = False,
 ):
     """Jitted all-shards decide with ON-DEVICE routing: (Table2[D,·],
     (D, 12, c) arrival-order grid, (D, c+2, 4) recycled egress buffer) →
@@ -103,14 +104,26 @@ def make_a2a_decide(
     Zipf-hot key costs ≤ 1 slot of each pair's capacity instead of flooding
     its owner's — and once on the owner over the received rows, merging the
     ≤ D cross-source carriers. Member rows answer from their carrier with
-    FLAG_MEMBER, exactly like the host-grid dedup program."""
+    FLAG_MEMBER, exactly like the host-grid dedup program.
+
+    `wire=True` takes the compact 5-lane int32 ingress grid (trailing base
+    column per device, ops/wire.py) and returns int32 compact outputs; the
+    HOST boundary is what the narrow layout shrinks — the decode runs
+    before the exchange, so the ICI legs still move the full 12-lane rows
+    (ICI bandwidth is not the bottleneck the wire budget targets) and the
+    exchange/dedup machinery below is shared byte-for-byte."""
     write = write or default_write_mode()
     D = int(mesh.devices.size)
     C = pair_capacity(c, D)
 
     def per_device(table: Table2, arr: jnp.ndarray, out_buf: jnp.ndarray):
+        from gubernator_tpu.ops.wire import decode_wire_block, encode_wire_out
+
         table = jax.tree.map(lambda x: x[0], table)
-        a = arr[0]  # (12, c) i64, arrival order
+        if wire:
+            a, wire_base = decode_wire_block(arr[0])  # (12, c) i64
+        else:
+            a = arr[0]  # (12, c) i64, arrival order
         if dedup:
             # source-local merge: duplicate keys within this device's block
             # collapse onto their carrier; members deactivate (not sent)
@@ -189,8 +202,11 @@ def make_a2a_decide(
             fan = fan.at[:, 3].set(fan[:, 3] | i64(FLAG_MEMBER))
             out = jnp.where(member0[:, None], fan, out)
 
+        packed_out = jnp.concatenate([out, stats_rows], axis=0)
+        if wire:
+            packed_out = encode_wire_out(packed_out, wire_base)
         expand = lambda t: jax.tree.map(lambda x: x[None], t)
-        return expand(table), jnp.concatenate([out, stats_rows], axis=0)[None]
+        return expand(table), packed_out[None]
 
     spec = P(SHARD_AXIS)
     fn = shard_map_compat(

@@ -267,15 +267,30 @@ def _sync_core(primary, replica, outbox: ReqBatch, me, D: int, write: str):
     return primary, replica, counters, bc
 
 
-def _mk_sync_step(mesh, n_shards: int, out_size: int, write: Optional[str] = None):
-    """Build the jitted single-round collective sync step."""
+def _mk_sync_step(
+    mesh, n_shards: int, out_size: int, write: Optional[str] = None,
+    wire: bool = False,
+):
+    """Build the jitted single-round collective sync step. `wire=True`
+    takes the outbox as ONE compact (D, 5, OUT+1) int32 wire grid
+    (ops/wire.py) decoded in-trace instead of a 12-leaf HostBatch pytree —
+    one device put per round instead of twelve, at 20 B/entry instead of
+    96 (PendingHits rounds were put-bound: BENCH_r05 measured 110 ms per
+    16K-entry round against ~16 ms of compute)."""
     D = n_shards
     write = write or default_write_mode()
 
-    def per_device(primary, replica, outbox: ReqBatch):
+    def per_device(primary, replica, outbox):
         primary = jax.tree.map(lambda x: x[0], primary)
         replica = jax.tree.map(lambda x: x[0], replica)
-        outbox = jax.tree.map(lambda x: x[0], outbox)
+        if wire:
+            from gubernator_tpu.ops.kernel2 import req_from_arr
+            from gubernator_tpu.ops.wire import decode_wire_block
+
+            arr12, _base = decode_wire_block(outbox[0])
+            outbox = req_from_arr(arr12)
+        else:
+            outbox = jax.tree.map(lambda x: x[0], outbox)
         me = jax.lax.axis_index(SHARD_AXIS)
         primary, replica, counters, bc = _sync_core(
             primary, replica, outbox, me, D, write
@@ -300,7 +315,8 @@ def _mk_sync_step(mesh, n_shards: int, out_size: int, write: Optional[str] = Non
 
 
 def _mk_sync_step_multi(
-    mesh, n_shards: int, rounds: int, write: Optional[str] = None
+    mesh, n_shards: int, rounds: int, write: Optional[str] = None,
+    wire: bool = False,
 ):
     """Fused R-round sync step: a fori_loop over R stacked outboxes inside
     ONE launch. A deep drain (sync() after a burst) otherwise pays the
@@ -314,10 +330,11 @@ def _mk_sync_step_multi(
     D = n_shards
     write = write or default_write_mode()
 
-    def per_device(primary, replica, outboxes: ReqBatch):
+    def per_device(primary, replica, outboxes):
         primary = jax.tree.map(lambda x: x[0], primary)
         replica = jax.tree.map(lambda x: x[0], replica)
-        outboxes = jax.tree.map(lambda x: x[0], outboxes)  # leaves (R, OUT)
+        # pytree: leaves (R, OUT); wire: ONE (R, 5, OUT+1) int32 grid
+        outboxes = jax.tree.map(lambda x: x[0], outboxes)
         me = jax.lax.axis_index(SHARD_AXIS)
 
         def body(i, carry):
@@ -326,6 +343,12 @@ def _mk_sync_step_multi(
                 lambda x: jax.lax.dynamic_index_in_dim(x, i, keepdims=False),
                 outboxes,
             )
+            if wire:
+                from gubernator_tpu.ops.kernel2 import req_from_arr
+                from gubernator_tpu.ops.wire import decode_wire_block
+
+                arr12, _base = decode_wire_block(outbox)
+                outbox = req_from_arr(arr12)
             primary, replica, c, _bc = _sync_core(
                 primary, replica, outbox, me, D, write
             )
@@ -377,6 +400,7 @@ class GlobalShardedEngine(ShardedEngine):
         route: Optional[str] = None,
         write_mode: Optional[str] = None,
         dedup: Optional[str] = None,
+        wire: Optional[str] = None,
     ):
         super().__init__(
             mesh,
@@ -387,6 +411,7 @@ class GlobalShardedEngine(ShardedEngine):
             route=route,
             write_mode=write_mode,
             dedup=dedup,
+            wire=wire,
         )
         # the replica table + collective step materialize on first GLOBAL
         # use: clustered daemons route GLOBAL over the host peer plane and
@@ -394,7 +419,8 @@ class GlobalShardedEngine(ShardedEngine):
         self._capacity_per_shard = capacity_per_shard
         self.replica: Optional[Table2] = None
         self._sync_step = None
-        self._sync_multi = {}  # fused-drain steps, keyed by round count R
+        self._sync_step_wire = None  # compact-outbox single-round step
+        self._sync_multi = {}  # fused-drain steps, keyed by (rounds R, wire)
         self.sync_out = sync_out
         self.pending: List[PendingHits] = [
             PendingHits() for _ in range(self.n_shards)
@@ -852,6 +878,17 @@ class GlobalShardedEngine(ShardedEngine):
             box.hits[:k] = hits
             box.behavior[:k] |= reset
             box.created_at[:k] = now
+            # re-anchor non-Gregorian expiries to the applied-at stamp the
+            # rows were just given (created + duration — the linear rule the
+            # compact wire decode reconstructs in-trace; Gregorian rows keep
+            # their host-resolved calendar expiry and force the full-width
+            # outbox). Under frozen-clock tests created == now already, so
+            # this is identity there; live, it anchors a new item's expiry
+            # at apply time instead of up to one sync cadence earlier.
+            ng = box.greg_interval[:k] == 0
+            box.expire_new[:k] = np.where(
+                ng, now + box.duration[:k], box.expire_new[:k]
+            )
         else:
             popped = None
             box = pad_batch(
@@ -878,6 +915,17 @@ class GlobalShardedEngine(ShardedEngine):
             )
         self.global_stats.send_queue_length = sum(len(p) for p in self.pending)
         self.poisoned = f"GLOBAL collective sync launch failed: {exc}"
+
+    def _wire_boxes(self, boxes, now: int) -> bool:
+        """Can this round's outboxes ride the compact wire? All-or-nothing
+        per launch: one grid dtype/shape per compiled step. Accumulated
+        hot-key hits ≥ 2^18 or Gregorian configs fall the round back to the
+        full-width pytree put (same semantics, 12 puts instead of one)."""
+        if self.wire != "compact":
+            return False
+        from gubernator_tpu.ops.wire import wire_encodable
+
+        return all(wire_encodable(b, now) for b in boxes)
 
     def _sync_rounds_fused(self, rounds_needed: int, now_ms: Optional[int]) -> None:
         """Drain up to R rounds in ONE launch: stack R outboxes per device,
@@ -906,27 +954,49 @@ class GlobalShardedEngine(ShardedEngine):
             return b
 
         boxes = [[box(d) for d in range(self.n_shards)] for _r in range(R)]
-        stacked = HostBatch(
-            *[
-                np.stack(
-                    [
-                        np.stack([boxes[r][d][k] for r in range(R)])
-                        for d in range(self.n_shards)
-                    ]
-                )
-                for k in range(len(boxes[0][0]))
-            ]
-        )  # leaves (D, R, OUT)
-        step = self._sync_multi.get(R)
+        wire = self._wire_boxes(
+            [boxes[r][d] for r in range(R) for d in range(self.n_shards)], now
+        )
+        step = self._sync_multi.get((R, wire))
         if step is None:
-            step = self._sync_multi[R] = _mk_sync_step_multi(
-                self.mesh, self.n_shards, R, write=self.write_mode
+            step = self._sync_multi[(R, wire)] = _mk_sync_step_multi(
+                self.mesh, self.n_shards, R, write=self.write_mode, wire=wire
             )
         try:
-            dev = jax.tree.map(
-                lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding),
-                stacked,
-            )
+            if wire:
+                from gubernator_tpu.ops import wire as wire_mod
+
+                OUT = self.sync_out
+                grid = np.zeros(
+                    (self.n_shards, R, wire_mod.WIRE_LANES, OUT + 1),
+                    dtype=np.int32,
+                )
+                for r in range(R):
+                    for d in range(self.n_shards):
+                        b = boxes[r][d]
+                        if b is empty_box:  # zeros already; base only
+                            wire_mod.stamp_base(grid[d, r], now)
+                        else:
+                            wire_mod.pack_wire_full(b, now, out=grid[d, r])
+                dev = jax.device_put(grid, self._batch_sharding)
+            else:
+                stacked = HostBatch(
+                    *[
+                        np.stack(
+                            [
+                                np.stack([boxes[r][d][k] for r in range(R)])
+                                for d in range(self.n_shards)
+                            ]
+                        )
+                        for k in range(len(boxes[0][0]))
+                    ]
+                )  # leaves (D, R, OUT)
+                dev = jax.tree.map(
+                    lambda x: jax.device_put(
+                        jnp.asarray(x), self._batch_sharding
+                    ),
+                    stacked,
+                )
             self.table, self.replica, counters = step(
                 self.table, self.replica, dev
             )
@@ -950,30 +1020,64 @@ class GlobalShardedEngine(ShardedEngine):
         mid-tick, stalling all serving behind a cold XLA compile. Engine
         thread only (mutates the donated tables through no-op steps). The
         caller should reset global_stats afterwards — warm rounds are not
-        traffic."""
+        traffic. Compact-wire engines warm BOTH outbox formats: a round
+        whose accumulated hits overflow the narrow layout falls back to
+        the pytree step, and that compile must not land mid-tick either."""
         self._ensure_global_plane()
-        self._sync_round(now_ms)
-        R = 2
-        while R <= self._SYNC_FUSE_CAP:
-            self._sync_rounds_fused(R, now_ms)
-            R *= 2
+        modes = ("compact", "full") if self.wire == "compact" else (self.wire,)
+        saved = self.wire
+        try:
+            for mode in modes:
+                self.wire = mode
+                self._sync_round(now_ms)
+                R = 2
+                while R <= self._SYNC_FUSE_CAP:
+                    self._sync_rounds_fused(R, now_ms)
+                    R *= 2
+        finally:
+            self.wire = saved
 
     def _sync_round(self, now_ms: Optional[int] = None) -> None:
-        """One collective hit-sync + broadcast round."""
+        """One collective hit-sync + broadcast round. The outbox ships as
+        ONE compact int32 wire grid when every box is representable
+        (ops/wire.py — one put instead of twelve at ~a fifth the bytes),
+        falling back to the HostBatch pytree put otherwise."""
         self._ensure_global_plane()
         now = now_ms if now_ms is not None else ms_now()
         built = [self._build_box(d, now) for d in range(self.n_shards)]
         boxes = [b for b, _p in built]
         popped = [(d, p) for d, (_b, p) in enumerate(built) if p is not None]
-        stacked = HostBatch(*[np.stack([b[k] for b in boxes]) for k in range(len(boxes[0]))])
+        wire = self._wire_boxes(boxes, now)
+        if wire and self._sync_step_wire is None:
+            self._sync_step_wire = _mk_sync_step(
+                self.mesh, self.n_shards, self.sync_out,
+                write=self.write_mode, wire=True,
+            )
         try:
-            dev_box = jax.tree.map(
-                lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding),
-                stacked,
-            )
-            self.table, self.replica, counters, bc = self._sync_step(
-                self.table, self.replica, dev_box
-            )
+            if wire:
+                from gubernator_tpu.ops import wire as wire_mod
+
+                grid = np.zeros(
+                    (self.n_shards, wire_mod.WIRE_LANES, self.sync_out + 1),
+                    dtype=np.int32,
+                )
+                for d, b in enumerate(boxes):
+                    wire_mod.pack_wire_full(b, now, out=grid[d])
+                dev_box = jax.device_put(grid, self._batch_sharding)
+                self.table, self.replica, counters, bc = self._sync_step_wire(
+                    self.table, self.replica, dev_box
+                )
+            else:
+                stacked = HostBatch(
+                    *[np.stack([b[k] for b in boxes]) for k in range(len(boxes[0]))]
+                )
+                dev_box = jax.tree.map(
+                    lambda x: jax.device_put(jnp.asarray(x), self._batch_sharding),
+                    stacked,
+                )
+                self.table, self.replica, counters, bc = self._sync_step(
+                    self.table, self.replica, dev_box
+                )
         except Exception as exc:
             # the popped hit boxes must survive a failed launch (ADVICE r5):
             # re-merge them and mark the engine unhealthy — the donated

@@ -50,8 +50,10 @@ while N's transfer drains another.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, NamedTuple, Optional, Sequence
 
 import jax
@@ -119,7 +121,7 @@ def default_shard_dedup() -> str:
 
 def make_sharded_decide(
     mesh: Mesh, math: str = "mixed", write: Optional[str] = None,
-    dedup: bool = False,
+    dedup: bool = False, wire: bool = False,
 ):
     """Build the jitted all-shards decision step over the SINGLE-TRANSFER
     packed layout: (Table2[D,·], (D, 12, b) i64 ingress grid, (D, b+2, 4)
@@ -136,13 +138,24 @@ def make_sharded_decide(
     overridable for parity tests; `math` picks the token-only or mixed
     decision graph (engine._math_mode); `dedup` aggregates duplicate keys
     in-trace (kernel2.decide2_packed_dedup_impl — duplicates share a
-    fingerprint, so the host grid colocates them on the owning device)."""
+    fingerprint, so the host grid colocates them on the owning device);
+    `wire` takes the compact 5-lane int32 ingress grid (trailing base
+    column per device block) and returns int32 compact outputs — the
+    decode/encode fuse into the kernel (ops/wire.py), so the narrow wire
+    costs vector ops instead of 76 B/row of transport."""
     write = write or default_write_mode()
 
     def per_device(table: Table2, arr: jnp.ndarray, out_buf: jnp.ndarray):
+        from gubernator_tpu.ops.wire import decode_wire_block, encode_wire_out
+
         table = jax.tree.map(lambda x: x[0], table)
         impl = decide2_packed_dedup_impl if dedup else decide2_packed_cols_impl
-        table, packed = impl(table, arr[0], write=write, math=math)
+        if wire:
+            arr12, base = decode_wire_block(arr[0])
+            table, packed = impl(table, arr12, write=write, math=math)
+            packed = encode_wire_out(packed, base)
+        else:
+            table, packed = impl(table, arr[0], write=write, math=math)
         expand = lambda t: jax.tree.map(lambda x: x[None], t)
         return expand(table), packed[None]
 
@@ -247,14 +260,15 @@ class _StagingPool:
         self._rings: Dict[tuple, list] = {}
         self._lock = threading.Lock()  # stage_pass runs on concurrent prep threads
 
-    def get(self, shape: tuple, zero: bool = False) -> np.ndarray:
+    def get(self, shape: tuple, zero: bool = False, dtype=np.int64) -> np.ndarray:
+        key = (shape, np.dtype(dtype).str)
         with self._lock:
-            ring = self._rings.get(shape)
+            ring = self._rings.get(key)
             if ring is None:
-                ring = self._rings[shape] = [[], 0]
+                ring = self._rings[key] = [[], 0]
             bufs, idx = ring
             if len(bufs) < self.depth:
-                buf = np.zeros(shape, dtype=np.int64)  # fresh → already zero
+                buf = np.zeros(shape, dtype=dtype)  # fresh → already zero
                 bufs.append(buf)
                 return buf
             ring[1] = idx + 1
@@ -291,13 +305,18 @@ class ShardedEngine:
         route: Optional[str] = None,
         write_mode: Optional[str] = None,
         dedup: Optional[str] = None,
+        wire: Optional[str] = None,
     ):
+        from gubernator_tpu.ops.wire import default_wire_mode
+
         route = route or default_shard_route()
         if route not in ("host", "device"):
             raise ValueError(f"route must be 'host' or 'device', got {route!r}")
         dedup = dedup or default_shard_dedup()
         if dedup not in ("host", "device"):
             raise ValueError(f"dedup must be 'host' or 'device', got {dedup!r}")
+        if wire is not None and wire not in ("compact", "full"):
+            raise ValueError(f"wire must be 'compact' or 'full', got {wire!r}")
         self.mesh = mesh
         # per-engine clock-skew bound; None = the ops.batch process default
         self.created_at_tolerance_ms = created_at_tolerance_ms
@@ -317,6 +336,12 @@ class ShardedEngine:
         # None = the backend default (kernel2.resolve_write still falls the
         # sparse mode back to the full sweep per dispatch shape)
         self.write_mode = write_mode or default_write_mode()
+        # host↔device wire format for decide dispatches and the GLOBAL sync
+        # outbox: "compact" ships 5-lane int32 ingress grids + int32 egress
+        # (ops/wire.py — the TPU default, GUBER_WIRE_COMPACT), "full" the
+        # 12-lane int64 grids (the parity oracle). Per-dispatch
+        # encodability still falls compact batches back to full-width.
+        self.wire = wire or default_wire_mode()
         self._decide_fns = {}  # (kind, …, math) → jitted mesh step (lazy)
         self._install = make_sharded_install(mesh, write=self.write_mode)
         # handoff mesh steps, built lazily (most engines never rebalance)
@@ -342,11 +367,38 @@ class ShardedEngine:
         self._egress: Dict[tuple, list] = {}
         self._egress_lock = threading.Lock()
         # host-staging cost accounting (the bench's host-stage/device split
-        # and the shard_* stage_duration series): cumulative ms per stage
-        self.stage_ms = {"route": 0.0, "pack": 0.0, "put": 0.0}
+        # and the shard_*/wire_* stage_duration series): cumulative ms per
+        # stage — wire_pack is the compact encode, wire_decode the compact
+        # egress decode (both 0 on full-width dispatches)
+        self.stage_ms = {
+            "route": 0.0, "pack": 0.0, "put": 0.0,
+            "wire_pack": 0.0, "wire_decode": 0.0,
+        }
         self.stage_dispatches = 0
         self._stage_taken = dict(self.stage_ms)
         self._stage_lock = threading.Lock()
+        # bytes actually crossing the host↔device boundary on the decide
+        # path (the gubernator_tpu_wire_bytes_total series): ingress grid
+        # nbytes at stage time, fetched output nbytes at finish time —
+        # counted whichever wire format ran, so bytes/decision is
+        # scrapeable rather than bench-computed
+        self.wire_bytes = {"put": 0, "fetch": 0}
+        self._wire_taken = dict(self.wire_bytes)
+        # per-shard ingress transfers issued concurrently (TPU: each
+        # device_put is a serialized round trip on tunneled transports;
+        # overlapping them makes the put cost max-of-shards, not
+        # sum-of-shards). CPU keeps the single zero-copy put.
+        self._put_pool: Optional[ThreadPoolExecutor] = None
+        put_env = os.environ.get("GUBER_SHARD_PUT", "auto")
+        if put_env not in ("auto", "single", "concurrent"):
+            raise ValueError(
+                f"GUBER_SHARD_PUT must be auto, single or concurrent, "
+                f"got {put_env!r}"
+            )
+        self._put_concurrent = (
+            put_env == "concurrent"
+            or (put_env == "auto" and jax.default_backend() == "tpu")
+        ) and self.n_shards > 1
         # set (with a reason) when a donated collective launch failed after
         # state was popped/donated: the tables may be poisoned, serving must
         # surface unhealthy (daemon health_check reads this)
@@ -409,7 +461,7 @@ class ShardedEngine:
 
     def take_stage_deltas(self) -> Dict[str, float]:
         """Host-staging ms per stage since the last take (EngineRunner
-        feeds these into the shard_* stage_duration series)."""
+        feeds these into the shard_*/wire_* stage_duration series)."""
         with self._stage_lock:
             d = {
                 k: self.stage_ms[k] - self._stage_taken[k]
@@ -418,19 +470,37 @@ class ShardedEngine:
             self._stage_taken = dict(self.stage_ms)
         return d
 
+    def _wire_count(self, direction: str, nbytes: int) -> None:
+        with self._stage_lock:
+            self.wire_bytes[direction] += int(nbytes)
+
+    def take_wire_deltas(self) -> Dict[str, int]:
+        """Bytes over the host↔device boundary per direction since the
+        last take (EngineRunner feeds the wire_bytes_total counter)."""
+        with self._stage_lock:
+            d = {
+                k: self.wire_bytes[k] - self._wire_taken[k]
+                for k in self.wire_bytes
+            }
+            self._wire_taken = dict(self.wire_bytes)
+        return d
+
     # ------------------------------------------------ egress buffer recycling
 
-    def _take_egress(self, shape: tuple):
+    def _take_egress(self, shape: tuple, dtype=np.int64):
         """A donated egress buffer for one mesh dispatch: a previously
-        fetched output array of the same shape when one is banked (its
-        allocation will alias the new output), else a fresh zeroed grid
-        (first dispatches of a shape, before the ring primes)."""
+        fetched output array of the same shape/dtype when one is banked
+        (its allocation will alias the new output), else a fresh zeroed
+        grid (first dispatches of a shape, before the ring primes). Keyed
+        by dtype too: compact-wire dispatches fetch int32 grids and full-
+        width ones int64, and XLA only aliases exact matches."""
+        key = (shape, np.dtype(dtype).str)
         with self._egress_lock:
-            bank = self._egress.get(shape)
+            bank = self._egress.get(key)
             if bank:
                 return bank.pop()
         return jax.device_put(
-            np.zeros(shape, dtype=np.int64), self._batch_sharding
+            np.zeros(shape, dtype=dtype), self._batch_sharding
         )
 
     def _recycle_egress(self, out) -> None:
@@ -440,7 +510,7 @@ class ShardedEngine:
         if isinstance(out, np.ndarray):
             return
         with self._egress_lock:
-            bank = self._egress.setdefault(out.shape, [])
+            bank = self._egress.setdefault((out.shape, out.dtype.str), [])
             if len(bank) < 8:
                 bank.append(out)
 
@@ -610,24 +680,27 @@ class ShardedEngine:
         if isinstance(staged, _StagedA2A):
             from gubernator_tpu.parallel.a2a import make_a2a_decide
 
-            key = ("a2a", staged.c, staged.math)
+            key = ("a2a", staged.c, staged.math, staged.wire)
             fn = self._decide_fns.get(key)
             if fn is None:
                 fn = self._decide_fns[key] = make_a2a_decide(
                     self.mesh, staged.c, math=staged.math,
-                    write=self.write_mode, dedup=dedup,
+                    write=self.write_mode, dedup=dedup, wire=staged.wire,
                 )
             rows = staged.c
         else:
-            key = ("host", staged.math)
+            key = ("host", staged.math, staged.wire)
             fn = self._decide_fns.get(key)
             if fn is None:
                 fn = self._decide_fns[key] = make_sharded_decide(
                     self.mesh, math=staged.math, write=self.write_mode,
-                    dedup=dedup,
+                    dedup=dedup, wire=staged.wire,
                 )
             rows = staged.b_local
-        out_buf = self._take_egress((self.n_shards, rows + 2, 4))
+        out_buf = self._take_egress(
+            (self.n_shards, rows + 2, 4),
+            np.int32 if staged.wire else np.int64,
+        )
         return fn(table, staged.dev, out_buf)
 
     def issue_staged(self, staged: "_Staged", batch_rows: int):
@@ -682,55 +755,136 @@ class ShardedEngine:
         routed = shard if shard is not None else shard_of(batch.fp, D)
         order, rs, offset, b_local = _route_plan(routed, D)
         t1 = time.perf_counter()
-        packed = pack_host_batch(batch)  # (12, n)
-        shape = (D, 12, b_local)
-        grid = (
-            self._pool.get(shape, zero=True)
-            if self._pool is not None
-            else np.zeros(shape, dtype=np.int64)
-        )
-        grid[rs, :, offset] = packed[:, order].T
+        wired, base = self._wire_plan(batch)
+        if wired:
+            from gubernator_tpu.ops import wire as wire_mod
+
+            # compact grid: one trailing column per device block carries
+            # the base (decode_wire_block reads cells [0, -1], [1, -1])
+            shape = (D, wire_mod.WIRE_LANES, b_local + 1)
+            grid = (
+                self._pool.get(shape, zero=True, dtype=np.int32)
+                if self._pool is not None
+                else np.zeros(shape, dtype=np.int32)
+            )
+            packed = wire_mod.pack_wire_rows(batch, base)
+            grid[rs, :, offset] = packed[:, order].T
+            for d in range(D):
+                wire_mod.stamp_base(grid[d], base)
+            stage = "wire_pack"
+        else:
+            packed = pack_host_batch(batch)  # (12, n)
+            shape = (D, 12, b_local)
+            grid = (
+                self._pool.get(shape, zero=True)
+                if self._pool is not None
+                else np.zeros(shape, dtype=np.int64)
+            )
+            grid[rs, :, offset] = packed[:, order].T
+            stage = "pack"
         t2 = time.perf_counter()
-        dev = jax.device_put(grid, self._batch_sharding)
+        dev = self._put_grid(grid)
         t3 = time.perf_counter()
         self._stage_time("route", t1 - t0)
-        self._stage_time("pack", t2 - t1)
+        self._stage_time(stage, t2 - t1)
         self._stage_time("put", t3 - t2)
+        self._wire_count("put", grid.nbytes)
         with self._stage_lock:
             self.stage_dispatches += 1
         return _Staged(
             order=order, rs=rs, offset=offset, b_local=b_local, dev=dev,
-            math=_math_mode(batch),
+            math=_math_mode(batch), wire=wired, base=base,
+        )
+
+    def _wire_plan(self, batch: HostBatch) -> "tuple[bool, int]":
+        """Per-dispatch wire decision: (compact?, base). Compact only when
+        the engine is in compact mode AND the batch is representable in the
+        narrow layout (ops/wire.wire_encodable) — otherwise the dispatch
+        ships full-width with identical semantics."""
+        if self.wire != "compact":
+            return False, 0
+        from gubernator_tpu.ops import wire as wire_mod
+
+        base = wire_mod.pick_base(batch)
+        return wire_mod.wire_encodable(batch, base), base
+
+    def _put_grid(self, grid: np.ndarray):
+        """One staged ingress grid → sharded device array. On meshes where
+        each device transfer is a serialized round trip (the tunneled TPU
+        transport), per-shard puts issue CONCURRENTLY and assemble with
+        make_array_from_single_device_arrays — put cost becomes
+        max-of-shards instead of sum-of-shards. CPU meshes keep the single
+        zero-copy put (GUBER_SHARD_PUT overrides either way)."""
+        if not self._put_concurrent:
+            return jax.device_put(grid, self._batch_sharding)
+        if self._put_pool is None:
+            self._put_pool = ThreadPoolExecutor(
+                max_workers=min(self.n_shards, 8), thread_name_prefix="put"
+            )
+        devs = list(self.mesh.devices.flat)
+        futs = [
+            self._put_pool.submit(jax.device_put, grid[d : d + 1], devs[d])
+            for d in range(self.n_shards)
+        ]
+        return jax.make_array_from_single_device_arrays(
+            grid.shape, self._batch_sharding, [f.result() for f in futs]
         )
 
     def _stage_a2a(self, batch: HostBatch) -> "_StagedA2A":
         """Arrival-order staging: pack the columns straight into a pooled
-        (12, D·c) flat buffer and strided-copy it into the pooled (D, 12, c)
-        ingress grid — row i lands on device i // c. O(1) routing work on
-        the host, zero fresh allocations in steady state."""
+        flat buffer and strided-copy it into the pooled ingress grid — row
+        i lands on device i // c. O(1) routing work on the host, zero
+        fresh allocations in steady state. Compact-wire dispatches build
+        the 5-lane int32 grid with one trailing base column per device
+        (20 B/row on the put vs the full layout's 96)."""
         D = self.n_shards
         n = batch.fp.shape[0]
         c = _pad_size(max(1, -(-n // D)), floor=8)
         t0 = time.perf_counter()
-        if self._pool is not None:
-            flat = self._pool.get((12, D * c))
-            flat[:, n:] = 0  # stale tail from the buffer's last use
-            grid = self._pool.get((D, 12, c))
+        wired, base = self._wire_plan(batch)
+        if wired:
+            from gubernator_tpu.ops import wire as wire_mod
+
+            L = wire_mod.WIRE_LANES
+            if self._pool is not None:
+                flat = self._pool.get((L, D * c), dtype=np.int32)
+                flat[:, n:] = 0  # stale tail from the buffer's last use
+                grid = self._pool.get((D, L, c + 1), dtype=np.int32)
+            else:
+                flat = np.zeros((L, D * c), dtype=np.int32)
+                grid = np.empty((D, L, c + 1), dtype=np.int32)
+            wire_mod.pack_wire_rows(batch, base, out=flat[:, :n])
+            np.copyto(
+                grid[:, :, :c], flat.reshape(L, D, c).transpose(1, 0, 2)
+            )
+            grid[:, :, c] = 0
+            for d in range(D):
+                wire_mod.stamp_base(grid[d], base)
+            stage = "wire_pack"
         else:
-            flat = np.zeros((12, D * c), dtype=np.int64)
-            grid = np.empty((D, 12, c), dtype=np.int64)
-        pack_host_batch(batch, out=flat[:, : n])
-        # one strided copy rearranges (12, D·c) → (D, 12, c); every grid
-        # byte is overwritten, so the pooled buffer needs no zeroing
-        np.copyto(grid, flat.reshape(12, D, c).transpose(1, 0, 2))
+            if self._pool is not None:
+                flat = self._pool.get((12, D * c))
+                flat[:, n:] = 0  # stale tail from the buffer's last use
+                grid = self._pool.get((D, 12, c))
+            else:
+                flat = np.zeros((12, D * c), dtype=np.int64)
+                grid = np.empty((D, 12, c), dtype=np.int64)
+            pack_host_batch(batch, out=flat[:, : n])
+            # one strided copy rearranges (12, D·c) → (D, 12, c); every grid
+            # byte is overwritten, so the pooled buffer needs no zeroing
+            np.copyto(grid, flat.reshape(12, D, c).transpose(1, 0, 2))
+            stage = "pack"
         t1 = time.perf_counter()
-        dev = jax.device_put(grid, self._batch_sharding)
+        dev = self._put_grid(grid)
         t2 = time.perf_counter()
-        self._stage_time("pack", t1 - t0)
+        self._stage_time(stage, t1 - t0)
         self._stage_time("put", t2 - t1)
+        self._wire_count("put", grid.nbytes)
         with self._stage_lock:
             self.stage_dispatches += 1
-        return _StagedA2A(c=c, dev=dev, math=_math_mode(batch))
+        return _StagedA2A(
+            c=c, dev=dev, math=_math_mode(batch), wire=wired, base=base
+        )
 
     def _unroute(self, staged, outh: np.ndarray, n: int):
         """Decode the fetched (D, rows+2, 4) packed output grid back to
@@ -740,14 +894,24 @@ class ShardedEngine:
         excluded from per-row accounting), and the summed per-device
         evicted_unexpired (the only stat that cannot be derived per row).
         Flag bits shared with the single-device decoder
-        (kernel2.FLAG_*/unpack_outputs)."""
+        (kernel2.FLAG_*/unpack_outputs). Compact-wire outputs (int32 —
+        ops/wire.py) decode here with vectorized numpy: the reset lane is
+        base-relative, everything else widens to int64."""
+        self._wire_count("fetch", outh.nbytes)
         if isinstance(staged, _StagedA2A):
-            st = outh[:, staged.c, :].sum(axis=0)
-            per = outh[:, : staged.c, :].reshape(-1, 4)[:n].copy()
+            st = outh[:, staged.c, :].astype(np.int64).sum(axis=0)
+            per = outh[:, : staged.c, :].reshape(-1, 4)[:n]
+            per = per.copy() if per.dtype == np.int64 else per
         else:
-            st = outh[:, staged.b_local, :].sum(axis=0)  # hits/misses/over/…
-            per = np.empty((n, 4), dtype=np.int64)
+            st = outh[:, staged.b_local, :].astype(np.int64).sum(axis=0)
+            per = np.empty((n, 4), dtype=outh.dtype)
             per[staged.order] = outh[staged.rs, staged.offset]
+        if staged.wire:
+            from gubernator_tpu.ops.wire import decode_wire_rows
+
+            t0 = time.perf_counter()
+            per = decode_wire_rows(per, staged.base)
+            self._stage_time("wire_decode", time.perf_counter() - t0)
         status = (per[:, 3] & FLAG_STATUS).astype(np.int32)
         hit = (per[:, 3] & FLAG_HIT) != 0
         dropped = (per[:, 3] & FLAG_DROPPED) != 0
@@ -842,8 +1006,10 @@ class _Staged(NamedTuple):
     rs: np.ndarray  # (n,) owning shard, sorted
     offset: np.ndarray  # (n,) position within the shard's grid row
     b_local: int  # padded per-shard width
-    dev: object  # (D, 12, b_local) i64 device grid, shard-per-device
+    dev: object  # (D, 12, b_local) i64 — or compact (D, 5, b_local+1) i32
     math: str  # static decision-graph mode ("token" | "mixed")
+    wire: bool = False  # compact 5-lane int32 wire grids (ops/wire.py)
+    base: int = 0  # created_at base of the compact encoding
 
 
 class _StagedA2A(NamedTuple):
@@ -852,8 +1018,10 @@ class _StagedA2A(NamedTuple):
     the mesh size inside make_a2a_decide)."""
 
     c: int  # rows per device (pow2)
-    dev: object  # (D, 12, c) i64 device grid, arrival order
+    dev: object  # (D, 12, c) i64 — or compact (D, 5, c+1) i32, arrival order
     math: str  # static decision-graph mode ("token" | "mixed")
+    wire: bool = False  # compact 5-lane int32 wire grids (ops/wire.py)
+    base: int = 0  # created_at base of the compact encoding
 
 
 def _route_plan(routed: np.ndarray, D: int):

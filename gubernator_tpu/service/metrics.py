@@ -122,7 +122,19 @@ class DaemonMetrics:
             # ingress host-staging split shard_route | shard_pack |
             # shard_put (ShardedEngine host work per dispatch — route plan,
             # grid pack, device transfer; docs/latency.md "mesh ingress")
+            # and the compact-wire codec stages wire_pack | wire_decode
+            # (host encode of the 5-lane ingress grid / decode of the int32
+            # egress; docs/latency.md "wire budget")
             ["stage"],
+            registry=r,
+        )
+        self.wire_bytes = Counter(
+            # renders as gubernator_tpu_wire_bytes_total
+            "gubernator_tpu_wire_bytes",
+            "Bytes crossing the host-device boundary on the serving decide "
+            "path (ingress grids and fetched outputs, whichever wire format "
+            "ran) — bytes/decision is this over the dispatch row count",
+            ["direction"],  # put | fetch
             registry=r,
         )
         self.dropped_rows = Counter(

@@ -135,15 +135,26 @@ class EngineRunner:
         accumulated in ShardedEngine._stage*) into the stage_duration
         summaries as shard_* labels — the mesh-path mirror of the local
         pipeline's put/issue/fetch stages, and the series the ingress bench
-        reads to show staging cost ∝ batch rows."""
+        reads to show staging cost ∝ batch rows. The compact-wire codec
+        stages keep their own wire_pack/wire_decode labels, and the bytes
+        the engine moved across the boundary feed the
+        gubernator_tpu_wire_bytes_total counter so bytes/decision is
+        scrapeable rather than bench-computed."""
         take = getattr(self.engine, "take_stage_deltas", None)
-        if take is None:
-            return
-        for k, ms in take().items():
-            if ms > 0:
-                self.metrics.stage_duration.labels(stage=f"shard_{k}").observe(
-                    ms / 1e3
-                )
+        if take is not None:
+            for k, ms in take().items():
+                if ms > 0:
+                    label = k if k.startswith("wire_") else f"shard_{k}"
+                    self.metrics.stage_duration.labels(stage=label).observe(
+                        ms / 1e3
+                    )
+        wtake = getattr(self.engine, "take_wire_deltas", None)
+        if wtake is not None:
+            for direction, nbytes in wtake().items():
+                if nbytes > 0:
+                    self.metrics.wire_bytes.labels(direction=direction).inc(
+                        nbytes
+                    )
 
     async def check_columns(
         self, cols: RequestColumns, now_ms: Optional[int] = None

@@ -117,6 +117,34 @@ def test_check_dropped():
     assert check_dropped(0, 0) is None
 
 
+def test_check_transport():
+    """Transport-dominance gate (ISSUE 5): a window's transfer share must
+    be accountable against its reported bytes at a plausible bandwidth —
+    so a compact-wire 'win' can't be faked by timing drift in either
+    direction."""
+    from gubernator_tpu.bench_guard import check_transport
+
+    # 10 MB in 10 ms → 1 GB/s: a sane PCIe/tunnel window
+    assert check_transport(0.010, 10_000_000) is None
+    # nothing claimed against the wire → nothing to gate
+    assert check_transport(0.0, 0) is None
+    assert check_transport(5.0, 0) is None
+    # impossible-fast: 10 GB in 1 ms → 1e13 B/s — the bytes were never
+    # moved in the measured time
+    r = check_transport(0.001, 10_000_000_000)
+    assert r is not None and "ceiling" in r
+    # drift: 1 KB 'transfer' taking 5 s — the time is not transport
+    r = check_transport(5.0, 1024)
+    assert r is not None and "drift" in r
+    # bytes claimed against a zero-length window
+    r = check_transport(0.0, 1024)
+    assert r is not None and "no time" in r
+    # band edges are knobs (CI disables the drift side on slow runners)
+    assert check_transport(5.0, 1024, min_bandwidth=0.0) is None
+    # negative byte counts are accounting bugs, not windows
+    assert check_transport(0.1, -5) is not None
+
+
 # ------------------------------------------------- on-device loop harness
 
 

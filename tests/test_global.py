@@ -295,7 +295,10 @@ def test_warm_sync_steps_pretraces_fused_variants(mesh, frozen_now):
 
     eng = GlobalShardedEngine(mesh, capacity_per_shard=1024, sync_out=16)
     eng.warm_sync_steps(now_ms=frozen_now)
-    assert sorted(eng._sync_multi) == [2, 4, 8, 16, 32, 64]
+    # fused steps key by (rounds, compact-wire?); compact engines warm BOTH
+    # outbox formats per R, full-width ones just their own — either way
+    # every R variant must be pre-traced
+    assert sorted({r for r, _w in eng._sync_multi}) == [2, 4, 8, 16, 32, 64]
     eng.global_stats = GlobalStats()
 
     # a warm engine still reconciles correctly (state untouched by no-ops)
@@ -377,7 +380,10 @@ def test_sync_launch_failure_requeues_hits_and_poisons(mesh, frozen_now):
     def dead_step(*_a, **_k):
         raise Boom("donated launch died")
 
+    # stub BOTH outbox formats: which one the round takes depends on the
+    # engine's wire mode (compact ships the int32 grid step)
     eng._sync_step = dead_step
+    eng._sync_step_wire = dead_step
     with pytest.raises(Boom):
         eng._sync_round(now_ms=t)
 
@@ -394,6 +400,7 @@ def test_sync_launch_failure_requeues_hits_and_poisons(mesh, frozen_now):
     # a healthy step afterwards drains the re-merged hits (fresh engine
     # state validates the re-merge kept well-formed columns)
     eng._sync_step = None
+    eng._sync_step_wire = None
     eng._ensure_global_plane()
     eng.sync(now_ms=t)
     assert eng.global_stats.send_queue_length == 0

@@ -232,8 +232,8 @@ def test_stage_timing_and_egress_recycling(mesh, frozen_now):
     eng.check(reqs, now_ms=t)
     assert eng.stage_dispatches >= 1
     d = eng.take_stage_deltas()
-    assert set(d) == {"route", "pack", "put"}
-    assert d["pack"] >= 0 and d["put"] > 0
+    assert set(d) == {"route", "pack", "put", "wire_pack", "wire_decode"}
+    assert d["pack"] + d["wire_pack"] >= 0 and d["put"] > 0
     # drained: a second take with no traffic reads zero
     assert all(v == 0.0 for v in eng.take_stage_deltas().values())
     # egress bank primed by the fetch; the next same-shape dispatch pops it
