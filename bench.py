@@ -1043,6 +1043,39 @@ def wire_parity_smoke(rng, now):
     return ok
 
 
+def _stage_p99_ms(scraped: dict, stages, q: float = 0.99) -> dict:
+    """Per-stage tail estimate from the gubernator_tpu_stage_duration
+    HISTOGRAM buckets (linear interpolation within the straddling bucket —
+    the standard histogram_quantile estimate). The Summary-era bench could
+    only report stage MEANS, which hid exactly the tail behavior the
+    serving plane is judged on."""
+    buckets = scraped.get("gubernator_tpu_stage_duration_bucket", {})
+    counts = scraped.get("gubernator_tpu_stage_duration_count", {})
+    out = {}
+    for st in stages:
+        total = counts.get((("stage", st),))
+        if not total:
+            continue
+        bs = sorted(
+            (float(dict(k)["le"]), v)
+            for k, v in buckets.items()
+            if dict(k).get("stage") == st and dict(k)["le"] != "+Inf"
+        )
+        target = q * total
+        prev_le, prev_cum = 0.0, 0.0
+        est = None
+        for le, cum in bs:
+            if cum >= target:
+                frac = (target - prev_cum) / max(cum - prev_cum, 1e-12)
+                est = prev_le + frac * (le - prev_le)
+                break
+            prev_le, prev_cum = le, cum
+        if est is None:
+            est = bs[-1][0] if bs else 0.0  # tail above the last bucket
+        out[st] = round(est * 1e3, 3)
+    return out
+
+
 def e2e_serving_case() -> dict:
     """End-to-end serving: a real daemon (gRPC listener, pipelined batching
     front door, engine on this device) driven by the async client over
@@ -1084,18 +1117,27 @@ def e2e_serving_case() -> dict:
     # 32K coalesce × 8 inflight = 69K checks/s vs this config's 80K at
     # ~100 ms RTT weather). Env-overridable for tuning runs.
     CLIENTS = int(os.environ.get("E2E_CLIENTS", 64))
-    BATCH = 1000  # the wire cap (MAX_BATCH_SIZE)
+    # items per RPC; above 1000 the daemon's GUBER_MAX_BATCH_SIZE is raised
+    # to match (the configurable wire cap — fewer RPCs of proto framing for
+    # the same offered rows)
+    BATCH = int(os.environ.get("E2E_BATCH", 1000))
     SECONDS = float(os.environ.get("E2E_SECONDS", 12.0))
+    # gRPC channels the PUBLIC client fans requests over: one channel
+    # serializes every response onto a single TCP stream, which caps the
+    # measured number at the client, not the server
+    CHANNELS = int(os.environ.get("E2E_CHANNELS", 4))
 
     async def run() -> dict:
         conf = DaemonConfig(
             grpc_address="127.0.0.1:0",
             http_address="",
             cache_size=1 << 20,
+            max_batch_size=max(1000, BATCH),
             behaviors=BehaviorConfig(
                 batch_wait_ms=2.0,
                 pipeline_inflight=int(os.environ.get("E2E_INFLIGHT", 6)),
                 coalesce_limit=int(os.environ.get("E2E_COALESCE", 16384)),
+                front_workers=int(os.environ.get("E2E_FRONT_WORKERS", 0)),
             ),
         )
         d = await Daemon.spawn(conf)
@@ -1123,7 +1165,7 @@ def e2e_serving_case() -> dict:
             await d.runner.check(warm)
             size *= 2
         log(f"[e2e-serving] shape pre-warm: {time.perf_counter() - t0:.1f}s")
-        client = V1Client(d.conf.grpc_address, timeout_s=120.0)
+        client = V1Client(d.conf.grpc_address, timeout_s=120.0, channels=CHANNELS)
         rng = np.random.default_rng(9)
         reqs = [
             [
@@ -1154,17 +1196,14 @@ def e2e_serving_case() -> dict:
         lat: list = []
         counts = [0]
 
-        call = client._channel.unary_unary(
-            "/pb.gubernator.V1/GetRateLimits",
-            request_serializer=lambda m: m.SerializeToString(),
-            response_deserializer=pb.GetRateLimitsResp.FromString,
-        )
-
+        # the PUBLIC client path — request build + serialize per call, multi-
+        # channel round-robin — so the measured number is what users get,
+        # not a hand-rolled stub's
         async def worker(c, corpus):
-            my = pb.GetRateLimitsReq(requests=corpus[c])
+            my = corpus[c]
             while time.perf_counter() < deadline:
                 t0 = time.perf_counter()
-                resp = await call(my, timeout=120.0)
+                resp = await client.get_rate_limits(my, timeout_s=120.0)
                 lat.append(time.perf_counter() - t0)
                 counts[0] += len(resp.responses)
 
@@ -1198,15 +1237,18 @@ def e2e_serving_case() -> dict:
         await asyncio.gather(*(worker(c, hot_reqs) for c in range(CLIENTS)))
         hot_elapsed = time.perf_counter() - t0
         hot_count = counts[0]
-        # per-stage pipeline breakdown (mean ms) from the distinct-phase
-        # scrape — where a request's time actually goes
+        # per-stage pipeline breakdown from the distinct-phase scrape —
+        # where a request's time actually goes; means AND p99 (histogram
+        # buckets) so BENCH_r06+ can track per-stage tail behavior
+        STAGES = ("parse", "queue", "put", "issue", "fetch", "encode")
         stages = {}
-        for st in ("parse", "queue", "put", "issue", "fetch", "encode"):
+        for st in STAGES:
             key = (("stage", st),)
             cnt = scraped.get("gubernator_tpu_stage_duration_count", {}).get(key)
             tot = scraped.get("gubernator_tpu_stage_duration_sum", {}).get(key)
             if cnt:
                 stages[st] = round(tot / cnt * 1e3, 3)
+        stage_p99 = _stage_p99_ms(scraped, STAGES)
         await client.close()
         await d.close()
         arr = np.asarray(sorted(distinct_lat)) * 1e3
@@ -1215,10 +1257,18 @@ def e2e_serving_case() -> dict:
         return {
             "checks_per_sec": dis_cps,
             "clients": CLIENTS,
+            "channels": CHANNELS,
             "batch": BATCH,
             "request_p50_ms": round(float(np.percentile(arr, 50)), 2),
             "request_p99_ms": round(float(np.percentile(arr, 99)), 2),
             "stage_mean_ms": stages,
+            "stage_p99_ms": stage_p99,
+            # front-door path accounting: fused = wire bytes staged straight
+            # into the dispatch grid (parse once, stage once)
+            "fused_dispatches": d.batcher.fused_dispatches,
+            "column_dispatches": d.batcher.column_dispatches,
+            "adaptive_closes": d.batcher.adaptive_closes,
+            "window_expires": d.batcher.window_expires,
             # thundering herd: one key, CLIENTS-way closed loop; the ratio
             # is the planner's hot-key cost (max_exact sequential passes +
             # aggregate tail per dispatch vs 1 pass for distinct keys)

@@ -336,6 +336,142 @@ def handoff_smoke() -> dict:
     return out
 
 
+def serving_smoke() -> dict:
+    """Serving-plane regression gate (loopback daemon, CPU backend):
+
+    (a) **parse once, stage once** — an encodable distinct-key corpus must
+        ride the fused wire→grid path (no column re-pack on any dispatch),
+        and the native parse must stay ∝ bytes (a reintroduced per-item
+        Python stage shows up as a super-linear ratio);
+    (b) **front-door workers** — serving the same concurrent load with 4
+        flush workers must not be slower than with 1 (the multi-worker door
+        exists to overlap chunk form/dispatch/fan-out; losing that overlap
+        is the regression this gates);
+    (c) **adaptive window** — under synthetic backlog the coalesce window
+        must close on accumulated ROWS, not ride out a (deliberately huge)
+        wall-clock window.
+    """
+    import asyncio
+
+    from gubernator_tpu.config import BehaviorConfig, DaemonConfig
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.service.daemon import Daemon
+    from gubernator_tpu.service.wire import wire_batch_from_wire
+
+    os.environ["GUBER_WIRE_COMPACT"] = "1"  # fused path needs compact wire
+
+    def corpus(reqs: int, rows: int, tag: str):
+        return [
+            pb.GetRateLimitsReq(
+                requests=[
+                    pb.RateLimitReq(
+                        name="smoke", unique_key=f"{tag}r{r}i{i}", hits=1,
+                        limit=1 << 20, duration=3_600_000, created_at=NOW,
+                    )
+                    for i in range(rows)
+                ]
+            ).SerializeToString()
+            for r in range(reqs)
+        ]
+
+    # ---- (a) parse cost ∝ bytes (native parser, one traversal)
+    small = corpus(1, 250, "s")[0]
+    big = corpus(1, 1000, "b")[0]
+    wire_batch_from_wire(small), wire_batch_from_wire(big)  # warm
+    K = 50
+
+    def parse_ms(data: bytes) -> float:
+        t0 = time.perf_counter()
+        for _ in range(K):
+            wire_batch_from_wire(data)
+        return (time.perf_counter() - t0) / K * 1e3
+
+    p_small, p_big = parse_ms(small), parse_ms(big)
+    bytes_ratio = len(big) / len(small)
+    parse_ratio = p_big / max(p_small, 1e-9)
+    out: dict = {
+        "parse_ms_250": round(p_small, 4),
+        "parse_ms_1000": round(p_big, 4),
+        "parse_bytes_ratio": round(bytes_ratio, 2),
+        "parse_time_ratio": round(parse_ratio, 2),
+    }
+    if parse_ratio > bytes_ratio * 2.5:
+        print(json.dumps({"error": "serving smoke: parse cost super-linear "
+                          "in bytes", **out}))
+        sys.exit(1)
+
+    def conf(**beh) -> DaemonConfig:
+        beh.setdefault("batch_wait_ms", 1.0)
+        return DaemonConfig(
+            grpc_address="127.0.0.1:0", http_address="",
+            cache_size=1 << 15,
+            behaviors=BehaviorConfig(**beh),
+        )
+
+    async def drive(d: Daemon, datas) -> float:
+        t0 = time.perf_counter()
+        await asyncio.gather(*(d.get_rate_limits_raw(x) for x in datas))
+        return time.perf_counter() - t0
+
+    # ---- (a) fused path engaged, zero re-packs; (b) worker scaling
+    async def fused_and_workers():
+        res = {}
+        for label, workers in (("w1", 1), ("w4", 4)):
+            d = await Daemon.spawn(conf(front_workers=workers))
+            datas = corpus(64, 64, label)
+            await drive(d, datas)  # shape warm
+            best = min([await drive(d, datas) for _ in range(3)])
+            res[label] = best
+            if label == "w4":
+                res["fused"] = d.batcher.fused_dispatches
+                res["fallbacks"] = d.batcher.wire_fallbacks
+                res["columns"] = d.batcher.column_dispatches
+            await d.close()
+        return res
+
+    r = asyncio.run(fused_and_workers())
+    out["serve_s_workers1"] = round(r["w1"], 4)
+    out["serve_s_workers4"] = round(r["w4"], 4)
+    out["worker_speedup"] = round(r["w1"] / max(r["w4"], 1e-9), 3)
+    out["fused_dispatches"] = r["fused"]
+    out["wire_fallbacks"] = r["fallbacks"]
+    if r["fused"] == 0 or r["fallbacks"] > 0:
+        print(json.dumps({"error": "serving smoke: encodable corpus did not "
+                          "ride the fused parse path", **out}))
+        sys.exit(1)
+    # CI machines are noisy: gate on "multi-worker must not LOSE the
+    # overlap", not on a specific speedup
+    if r["w4"] > r["w1"] * 1.5:
+        print(json.dumps({"error": "serving smoke: 4 front-door workers "
+                          "slower than 1", **out}))
+        sys.exit(1)
+
+    # ---- (c) adaptive window closes on rows under backlog
+    async def adaptive():
+        d = await Daemon.spawn(conf(
+            front_workers=2, batch_wait_ms=300.0, adaptive_batch=True,
+            batch_close_rows=2048,
+        ))
+        datas = corpus(64, 64, "a")
+        await drive(d, datas)  # shape warm
+        wall = await drive(d, corpus(64, 64, "a2"))
+        closes, expires = d.batcher.adaptive_closes, d.batcher.window_expires
+        await d.close()
+        return wall, closes, expires
+
+    wall, closes, expires = asyncio.run(adaptive())
+    out["adaptive_wall_s"] = round(wall, 4)
+    out["adaptive_closes"] = closes
+    out["window_expires"] = expires
+    # riding the 300 ms wall-clock window even once per flush cycle would
+    # put the wall well past a second for this backlog
+    if closes < 1 or wall > 2.0:
+        print(json.dumps({"error": "serving smoke: adaptive window did not "
+                          "close on rows under backlog", **out}))
+        sys.exit(1)
+    return out
+
+
 def main() -> None:
     eng = LocalEngine(capacity=1 << 15, write_mode="xla")
     rng = np.random.default_rng(0)
@@ -357,6 +493,7 @@ def main() -> None:
         "sharded_smoke": sharded_smoke(),
         "wire_smoke": wire_smoke(),
         "handoff_smoke": handoff_smoke(),
+        "serving_smoke": serving_smoke(),
     }))
 
 

@@ -44,33 +44,63 @@ def to_pb(r: Union[RateLimitRequest, Dict, "pb.RateLimitReq"]) -> "pb.RateLimitR
 
 
 class V1Client:
-    """Async client for one daemon (DialV1Server analog, client.go:44-66)."""
+    """Async client for one daemon (DialV1Server analog, client.go:44-66).
+
+    `channels` > 1 opens that many HTTP/2 connections and round-robins
+    GetRateLimits across them — one gRPC channel serializes every response
+    onto a single TCP stream, which caps a hot client well below what the
+    server can produce (HTTP/2 flow control + head-of-line blocking on the
+    shared connection). The per-method callables are built once per channel,
+    not per call."""
 
     def __init__(
         self,
         address: str,
         credentials: Optional[grpc.ChannelCredentials] = None,
         timeout_s: float = 5.0,
+        channels: int = 1,
     ):
+        if channels < 1:
+            raise ValueError("channels must be >= 1")
         self.address = address
         self.timeout_s = timeout_s
-        if credentials is not None:
-            self._channel = grpc.aio.secure_channel(address, credentials)
-        else:
-            self._channel = grpc.aio.insecure_channel(address)
+
+        def dial(i: int):
+            # distinct channel args defeat grpc's global subchannel sharing
+            # — with identical args every "channel" can ride one TCP
+            # connection and the fan-out buys nothing
+            opts = [("gubernator.client_channel", i)]
+            if credentials is not None:
+                return grpc.aio.secure_channel(address, credentials, options=opts)
+            return grpc.aio.insecure_channel(address, options=opts)
+
+        self._channels = [dial(i) for i in range(channels)]
+        self._calls = [
+            ch.unary_unary(
+                GET_RATE_LIMITS,
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.GetRateLimitsResp.FromString,
+            )
+            for ch in self._channels
+        ]
+        self._rr = 0
+
+    @property
+    def _channel(self):
+        """First channel (back-compat for callers poking the raw channel)."""
+        return self._channels[0]
+
+    def _next_call(self):
+        self._rr = (self._rr + 1) % len(self._calls)
+        return self._calls[self._rr]
 
     async def get_rate_limits(
         self,
         requests: Sequence[Union[RateLimitRequest, Dict, "pb.RateLimitReq"]],
         timeout_s: Optional[float] = None,
     ) -> "pb.GetRateLimitsResp":
-        call = self._channel.unary_unary(
-            GET_RATE_LIMITS,
-            request_serializer=lambda m: m.SerializeToString(),
-            response_deserializer=pb.GetRateLimitsResp.FromString,
-        )
         req = pb.GetRateLimitsReq(requests=[to_pb(r) for r in requests])
-        return await call(req, timeout=timeout_s or self.timeout_s)
+        return await self._next_call()(req, timeout=timeout_s or self.timeout_s)
 
     async def health_check(self, timeout_s: Optional[float] = None) -> "pb.HealthCheckResp":
         call = self._channel.unary_unary(
@@ -89,7 +119,8 @@ class V1Client:
         return await call(pb.LiveCheckReq(), timeout=timeout_s or self.timeout_s)
 
     async def close(self) -> None:
-        await self._channel.close()
+        for ch in self._channels:
+            await ch.close()
 
 
 def random_peer(peers: List[str]) -> str:

@@ -120,6 +120,24 @@ class BehaviorConfig:
     # concurrent device dispatches the front door keeps in flight (issue of
     # N+1 overlaps compute of N and fetch of N-1); 1 = the serial door
     pipeline_inflight: int = 4
+    # --- serving plane (docs/latency.md "Serving plane") ------------------
+    # parser/responder flush workers on the front door: each forms a chunk,
+    # dispatches it, and slices its coalesced response back in parallel
+    # with the others; 0 = one per pipeline_inflight slot
+    front_workers: int = 0
+    # adaptive batch window: close the coalesce window on accumulated
+    # rows/bytes (or an idle engine) instead of always sleeping
+    # batch_wait_ms; the wall clock remains the ceiling. false restores the
+    # fixed-tick window
+    adaptive_batch: bool = True
+    # rows that close the adaptive window early (0 = coalesce_limit)
+    batch_close_rows: int = 0
+    # accumulated request wire bytes that close the adaptive window early
+    batch_close_bytes: int = 1 << 20
+    # bounded front-door ring: enqueues past this many pending rows wait
+    # for drain progress (backpressure) instead of growing the queue
+    # without limit (0 = 8 × coalesce_limit)
+    batch_queue_rows: int = 0
     # warm-up breadth: "" compiles only the 1-row shapes (fast spawn);
     # "pow2" additionally compiles every pow2 coalesce shape up to
     # coalesce_limit (token graph), "pow2-mixed" both math graphs — without
@@ -183,6 +201,12 @@ class DaemonConfig:
     data_center: str = ""
     instance_id: str = ""
 
+    # per-RPC item cap on the V1 wire surface (reference hard-codes 1000,
+    # gubernator.go:41-42 — the wire-compatible default; raising it lets a
+    # client ship engine-sized batches in one RPC instead of paying proto
+    # framing per 1000 rows). The rejection string keeps the reference's
+    # exact wording either way.
+    max_batch_size: int = 1000
     cache_size: int = 50_000  # CacheSize (config.go:151) → table capacity
     # auto-grow: double the device table when live keys pass 60% of capacity
     # (0 = fixed size like the reference's LRU; >0 = growth ceiling in slots)
@@ -364,6 +388,16 @@ class DaemonConfig:
             raise ConfigError("GUBER_PIPELINE_INFLIGHT must be >= 1")
         if self.behaviors.coalesce_limit <= 0:
             raise ConfigError("GUBER_BATCH_COALESCE_LIMIT must be positive")
+        if self.max_batch_size <= 0:
+            raise ConfigError("GUBER_MAX_BATCH_SIZE must be positive")
+        if self.behaviors.front_workers < 0:
+            raise ConfigError("GUBER_FRONT_WORKERS must be >= 0 (0 = auto)")
+        if self.behaviors.batch_close_rows < 0:
+            raise ConfigError("GUBER_BATCH_CLOSE_ROWS must be >= 0 (0 = auto)")
+        if self.behaviors.batch_close_bytes <= 0:
+            raise ConfigError("GUBER_BATCH_CLOSE_BYTES must be positive")
+        if self.behaviors.batch_queue_rows < 0:
+            raise ConfigError("GUBER_BATCH_QUEUE_ROWS must be >= 0 (0 = auto)")
         if self.behaviors.peer_breaker_errors <= 0:
             raise ConfigError("GUBER_PEER_BREAKER_ERRORS must be >= 1")
         if self.behaviors.peer_breaker_probes <= 0:
@@ -416,6 +450,7 @@ def setup_daemon_config(
         advertise_address=_get(env, "GUBER_ADVERTISE_ADDRESS", ""),
         data_center=_get(env, "GUBER_DATA_CENTER", ""),
         instance_id=_get(env, "GUBER_INSTANCE_ID", ""),
+        max_batch_size=_get_int(env, "GUBER_MAX_BATCH_SIZE", 1000),
         cache_size=_get_int(env, "GUBER_CACHE_SIZE", 50_000),
         cache_max_size=_get_int(env, "GUBER_CACHE_MAX_SIZE", 0),
         engine=_get(env, "GUBER_ENGINE", "local"),
@@ -428,6 +463,13 @@ def setup_daemon_config(
             batch_limit=_get_int(env, "GUBER_BATCH_LIMIT", 1000),
             coalesce_limit=_get_int(env, "GUBER_BATCH_COALESCE_LIMIT", 16384),
             pipeline_inflight=_get_int(env, "GUBER_PIPELINE_INFLIGHT", 4),
+            front_workers=_get_int(env, "GUBER_FRONT_WORKERS", 0),
+            adaptive_batch=_get_bool(env, "GUBER_ADAPTIVE_BATCH", True),
+            batch_close_rows=_get_int(env, "GUBER_BATCH_CLOSE_ROWS", 0),
+            batch_close_bytes=_get_int(
+                env, "GUBER_BATCH_CLOSE_BYTES", 1 << 20
+            ),
+            batch_queue_rows=_get_int(env, "GUBER_BATCH_QUEUE_ROWS", 0),
             warm_shapes=_get(env, "GUBER_WARM_SHAPES", ""),
             global_timeout_ms=_get_float_ms(env, "GUBER_GLOBAL_TIMEOUT", 500.0),
             global_sync_wait_ms=_get_float_ms(env, "GUBER_GLOBAL_SYNC_WAIT", 100.0),

@@ -153,6 +153,19 @@ static const uint64_t MASK63 = (1ULL << 63) - 1;
 // err codes — ops/batch.py ERR_*
 enum { ERR_OK = 0, ERR_EMPTY_KEY = 1, ERR_EMPTY_NAME = 2 };
 
+// Compact-wire layout constants — MUST mirror ops/wire.py (DUR_BITS,
+// HITS_BITS, behavior bit budget). The parser pre-packs each item into the
+// 5-lane int32 ingress row IN THE SAME PASS so the serving path can stage a
+// dispatch grid without ever materializing per-column int64 arrays; the
+// created_at delta (lane 4 bits 18-29) is left zero — the flush loop ORs it
+// in once the batch base is known.
+static const int64_t WIRE_DUR_MASK = (1LL << 30) - 1;   // ops/wire.DUR_BITS
+static const int64_t WIRE_HITS_MASK = (1LL << 18) - 1;  // ops/wire.HITS_BITS
+static const int64_t WIRE_I32_MAX = 2147483647LL;
+// RESET_REMAINING | DRAIN_OVER_LIMIT | kernel-inert bits (ops/wire.py
+// _ENCODABLE_BEHAVIOR); anything else (Gregorian, unknown) → full-width
+static const int32_t WIRE_ENC_BEHAVIOR = 8 | 32 | 1 | 2 | 16;
+
 struct Item {
   const uint8_t* name = nullptr; size_t name_len = 0;
   const uint8_t* key = nullptr; size_t key_len = 0;
@@ -227,18 +240,24 @@ static bool parse_item(Cursor& c, Item& it) {
 
 // parse_get_rate_limits(data: bytes)
 //   -> (n, fp, algo, behavior, hits, limit, burst, duration, created_at,
-//       err, ring_hash, spans)
+//       err, ring_hash, spans, traceparent, lanes, enc)
 // Buffer layouts (np.frombuffer): fp/hits/limit/burst/duration/created_at
 // int64; algo/behavior int32; err int8; ring_hash uint32; spans int64 pairs
-// (start, len) of each item's bytes for lazy pb materialization.
+// (start, len) of each item's bytes for lazy pb materialization; lanes a
+// (5, n) row-major int32 pre-packed compact-wire image (ops/wire.py lanes,
+// created-delta field zero); enc int8 per-item compact-wire encodability.
+// The scan + fill loops run with the GIL RELEASED — N front-door workers
+// parse concurrently (service/daemon.py door pool).
 static PyObject* parse_get_rate_limits(PyObject*, PyObject* args) {
   Py_buffer buf;
   if (!PyArg_ParseTuple(args, "y*", &buf)) return nullptr;
   const uint8_t* data = (const uint8_t*)buf.buf;
-  Cursor top{data, data + buf.len};
 
   std::vector<Item> items;
   items.reserve(64);
+  bool ok = true;
+  Py_BEGIN_ALLOW_THREADS;
+  Cursor top{data, data + buf.len};
   while (top.p < top.end && top.ok) {
     uint64_t tag = top.varint();
     if (!top.ok) break;
@@ -257,7 +276,9 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* args) {
       break;
     }
   }
-  if (!top.ok) {
+  ok = top.ok;
+  Py_END_ALLOW_THREADS;
+  if (!ok) {
     PyBuffer_Release(&buf);
     PyErr_SetString(PyExc_ValueError, "malformed GetRateLimitsReq");
     return nullptr;
@@ -279,7 +300,7 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* args) {
     tp = Py_None;
     Py_INCREF(Py_None);
   }
-  PyObject* out = PyTuple_New(13);
+  PyObject* out = PyTuple_New(15);
   PyObject* fp_b = PyBytes_FromStringAndSize(nullptr, n * 8);
   PyObject* algo_b = PyBytes_FromStringAndSize(nullptr, n * 4);
   PyObject* beh_b = PyBytes_FromStringAndSize(nullptr, n * 4);
@@ -291,8 +312,10 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* args) {
   PyObject* err_b = PyBytes_FromStringAndSize(nullptr, n);
   PyObject* ring_b = PyBytes_FromStringAndSize(nullptr, n * 4);
   PyObject* span_b = PyBytes_FromStringAndSize(nullptr, n * 16);
+  PyObject* lanes_b = PyBytes_FromStringAndSize(nullptr, n * 5 * 4);
+  PyObject* enc_b = PyBytes_FromStringAndSize(nullptr, n);
   if (!out || !fp_b || !algo_b || !beh_b || !hits_b || !lim_b || !burst_b ||
-      !dur_b || !ca_b || !err_b || !ring_b || !span_b) {
+      !dur_b || !ca_b || !err_b || !ring_b || !span_b || !lanes_b || !enc_b) {
     PyBuffer_Release(&buf);
     Py_XDECREF(out);
     return nullptr;
@@ -308,7 +331,10 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* args) {
   int8_t* err = (int8_t*)PyBytes_AS_STRING(err_b);
   uint32_t* ring = (uint32_t*)PyBytes_AS_STRING(ring_b);
   int64_t* span = (int64_t*)PyBytes_AS_STRING(span_b);
+  int32_t* lanes = (int32_t*)PyBytes_AS_STRING(lanes_b);
+  int8_t* enc = (int8_t*)PyBytes_AS_STRING(enc_b);
 
+  Py_BEGIN_ALLOW_THREADS;
   std::string hk;
   for (size_t i = 0; i < n; i++) {
     const Item& it = items[i];
@@ -323,8 +349,10 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* args) {
     span[2 * i + 1] = (int64_t)it.len;
     fp[i] = 0;
     ring[i] = 0;
-    if (it.key_len == 0) { err[i] = ERR_EMPTY_KEY; continue; }
-    if (it.name_len == 0) { err[i] = ERR_EMPTY_NAME; continue; }
+    lanes[i] = lanes[n + i] = lanes[2 * n + i] = lanes[3 * n + i] =
+        lanes[4 * n + i] = 0;
+    if (it.key_len == 0) { err[i] = ERR_EMPTY_KEY; enc[i] = 1; continue; }
+    if (it.name_len == 0) { err[i] = ERR_EMPTY_NAME; enc[i] = 1; continue; }
     err[i] = ERR_OK;
     hk.clear();
     hk.append((const char*)it.name, it.name_len);
@@ -334,7 +362,34 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* args) {
         xxh64((const uint8_t*)hk.data(), hk.size(), FP_SEED) & MASK63;
     fp[i] = (int64_t)(h ? h : 1);
     ring[i] = fnv1a_32((const uint8_t*)hk.data(), hk.size());
+    // compact-wire encodability, the ops/wire.wire_encodable checks the
+    // parser can settle per-item (created_at skew is batch-relative — the
+    // flush loop checks it). Validation-error fields (|limit|/|burst|
+    // beyond int32) ALSO fall back: the full path turns them into
+    // per-item errors the fused path has no pack stage to produce.
+    bool e = (it.behavior & ~WIRE_ENC_BEHAVIOR) == 0 &&
+             it.duration >= 0 && it.duration <= WIRE_DUR_MASK &&
+             it.hits >= 0 && it.hits <= WIRE_HITS_MASK &&
+             it.limit >= 0 && it.limit <= WIRE_I32_MAX &&
+             it.burst >= -WIRE_I32_MAX && it.burst <= WIRE_I32_MAX &&
+             (it.algorithm == 0 || it.algorithm == 1) &&
+             (it.algorithm == 0 || it.burst == 0);
+    enc[i] = e ? 1 : 0;
+    // pre-packed 5-lane int32 row (ops/wire.pack_wire_rows layout);
+    // lane 4's created-delta bits stay 0 until the flush stamps them
+    uint64_t ufp = (uint64_t)fp[i];
+    lanes[i] = (int32_t)(uint32_t)(ufp & 0xFFFFFFFFu);
+    lanes[n + i] = (int32_t)(uint32_t)(ufp >> 32);
+    lanes[2 * n + i] = (int32_t)it.limit;
+    lanes[3 * n + i] = (int32_t)(uint32_t)(
+        ((uint64_t)(it.duration & WIRE_DUR_MASK)) |
+        ((uint64_t)(uint32_t)it.algorithm << 30));
+    uint32_t l4 = (uint32_t)(it.hits & WIRE_HITS_MASK);
+    if (it.behavior & 8) l4 |= 1u << 30;   // RESET_REMAINING
+    if (it.behavior & 32) l4 |= 1u << 31;  // DRAIN_OVER_LIMIT
+    lanes[4 * n + i] = (int32_t)l4;
   }
+  Py_END_ALLOW_THREADS;
   PyBuffer_Release(&buf);
 
   PyTuple_SET_ITEM(out, 0, PyLong_FromSize_t(n));
@@ -350,6 +405,8 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* args) {
   PyTuple_SET_ITEM(out, 10, ring_b);
   PyTuple_SET_ITEM(out, 11, span_b);
   PyTuple_SET_ITEM(out, 12, tp);
+  PyTuple_SET_ITEM(out, 13, lanes_b);
+  PyTuple_SET_ITEM(out, 14, enc_b);
   return out;
 }
 
@@ -368,7 +425,11 @@ static inline void put_tag(std::string& out, uint32_t field, uint32_t wt) {
 
 // encode_responses(status_i64, limit_i64, remaining_i64, reset_i64,
 //                  errors: dict[int, str]) -> bytes(GetRateLimitsResp)
-// The column buffers are raw little-endian int64 (e.g. arr.tobytes()).
+// The column buffers are raw little-endian int64 — any buffer-protocol
+// object works (contiguous numpy int64 arrays pass ZERO-COPY; no .tobytes()
+// round trip). Error strings are gathered under the GIL up front; the
+// varint/field assembly then runs with the GIL RELEASED so N responder
+// workers encode concurrently.
 static PyObject* encode_responses(PyObject*, PyObject* args) {
   Py_buffer sb, lb, rb, tb;
   PyObject* errs;
@@ -380,7 +441,32 @@ static PyObject* encode_responses(PyObject*, PyObject* args) {
   const int64_t* re = (const int64_t*)rb.buf;
   const int64_t* rt = (const int64_t*)tb.buf;
 
+  // sparse {row: message} dict → C-side (row, utf8) list, GIL held
+  std::vector<std::pair<size_t, std::string>> errv;
+  bool bad = false;
+  if (errs != Py_None) {
+    PyObject *key, *val;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(errs, &pos, &key, &val)) {
+      size_t row = (size_t)PyLong_AsSize_t(key);
+      if (row == (size_t)-1 && PyErr_Occurred()) { bad = true; break; }
+      Py_ssize_t elen;
+      const char* ep = PyUnicode_AsUTF8AndSize(val, &elen);
+      if (!ep) { bad = true; break; }
+      if (elen) errv.emplace_back(row, std::string(ep, (size_t)elen));
+    }
+  }
+  if (bad) {
+    PyBuffer_Release(&sb); PyBuffer_Release(&lb);
+    PyBuffer_Release(&rb); PyBuffer_Release(&tb);
+    return nullptr;
+  }
+  std::vector<const std::string*> err_at(errv.empty() ? 0 : n, nullptr);
+  for (const auto& kv : errv)
+    if (kv.first < n) err_at[kv.first] = &kv.second;
+
   std::string out;
+  Py_BEGIN_ALLOW_THREADS;
   out.reserve(n * 24);
   std::string item;
   for (size_t i = 0; i < n; i++) {
@@ -389,27 +475,16 @@ static PyObject* encode_responses(PyObject*, PyObject* args) {
     if (li[i]) { put_tag(item, 2, 0); put_varint(item, (uint64_t)li[i]); }
     if (re[i]) { put_tag(item, 3, 0); put_varint(item, (uint64_t)re[i]); }
     if (rt[i]) { put_tag(item, 4, 0); put_varint(item, (uint64_t)rt[i]); }
-    PyObject* key = PyLong_FromSize_t(i);
-    PyObject* es = PyDict_GetItem(errs, key);  // borrowed
-    Py_DECREF(key);
-    if (es) {
-      Py_ssize_t elen;
-      const char* ep = PyUnicode_AsUTF8AndSize(es, &elen);
-      if (!ep) {
-        PyBuffer_Release(&sb); PyBuffer_Release(&lb);
-        PyBuffer_Release(&rb); PyBuffer_Release(&tb);
-        return nullptr;
-      }
-      if (elen) {
-        put_tag(item, 5, 2);
-        put_varint(item, (uint64_t)elen);
-        item.append(ep, (size_t)elen);
-      }
+    if (!err_at.empty() && err_at[i]) {
+      put_tag(item, 5, 2);
+      put_varint(item, err_at[i]->size());
+      item += *err_at[i];
     }
     put_tag(out, 1, 2);
     put_varint(out, item.size());
     out += item;
   }
+  Py_END_ALLOW_THREADS;
   PyBuffer_Release(&sb);
   PyBuffer_Release(&lb);
   PyBuffer_Release(&rb);

@@ -39,7 +39,7 @@ from gubernator_tpu.ops.kernel2 import (
     pack_outputs,
     unpack_outputs,
 )
-from gubernator_tpu.ops.plan import plan_passes
+from gubernator_tpu.ops.plan import Pass, plan_passes
 from gubernator_tpu.ops.table2 import Table2, new_table2
 from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
 
@@ -276,15 +276,130 @@ class PendingCheck:
     fetch thread by `finish_check_columns` — the split that lets host pack +
     transfer of dispatch N+1 overlap device execution and fetch of N."""
 
-    __slots__ = ("hb", "err", "now", "passes", "clamped", "stacked")
+    __slots__ = ("hb", "err", "now", "passes", "clamped", "stacked", "rows")
 
-    def __init__(self, hb, err, now, passes, clamped):
+    def __init__(self, hb, err, now, passes, clamped, rows=None):
         self.stacked = None  # same-shape pass outputs fused for ONE fetch
         self.hb = hb
         self.err = err
         self.now = now
         self.passes = passes  # [(Pass, n_rows, padded HostBatch, dev arr)]
         self.clamped = clamped
+        # total request rows (fused wire batches carry no eager HostBatch)
+        self.rows = rows if rows is not None else int(hb.fp.shape[0])
+
+
+class _LazyWireBatch:
+    """Padded HostBatch materialized ONLY if the rare dropped-claim retry
+    needs it — the fused wire path stages pre-packed lanes directly and
+    skips pack_columns entirely on the common path. Duck-types the two
+    HostBatch uses inside the pipelined retry: field iteration
+    (`HostBatch(*[f[rows] for f in batch])`) and the padded row count."""
+
+    __slots__ = ("_parts", "_now", "_tol", "rows", "_hb")
+
+    def __init__(self, parts, now, tol, rows):
+        self._parts = parts  # RequestColumns pieces, concat on demand
+        self._now = now
+        self._tol = tol
+        self.rows = rows  # padded dispatch rows
+        self._hb = None
+
+    def _materialize(self) -> HostBatch:
+        if self._hb is None:
+            if len(self._parts) == 1:
+                cols = self._parts[0]
+            else:
+                cols = RequestColumns(
+                    *[
+                        np.concatenate([p[k] for p in self._parts])
+                        for k in range(len(self._parts[0]))
+                    ]
+                )
+            hb, _ = pack_columns(cols, self._now, tolerance_ms=self._tol)
+            self._hb = pad_batch(hb, self.rows)
+        return self._hb
+
+    def __iter__(self):
+        return iter(self._materialize())
+
+
+def _padded_rows(batch) -> int:
+    """Padded dispatch rows of a pass batch (HostBatch or lazy wire batch)."""
+    if isinstance(batch, HostBatch):
+        return int(batch.fp.shape[0])
+    return batch.rows
+
+
+def prepare_check_wire(engine, parts, now_ms=None) -> "PendingCheck | None":
+    """Fused front-door preparation: pre-packed native wire lanes
+    (service/wire.WireBatch pieces) are scattered into ONE staged compact
+    ingress grid — the request bytes were traversed once by the parser and
+    this scatter is the only further touch. Returns a PendingCheck for the
+    standard issue/finish halves, or None when the batch needs the general
+    columns path (engine not wire-capable, non-encodable rows, duplicate
+    fingerprints, created_at skew beyond the ±2047 ms delta budget, Store
+    attached) — the fallback is semantically identical, it just pays the
+    full pack."""
+    if not getattr(engine, "supports_wire_ingress", False):
+        return None
+    if engine.store is not None or not engine.supports_pipeline:
+        return None
+    if not all(bool(p.encodable.all()) for p in parts):
+        return None
+    cols_list = [p.cols for p in parts]
+    n = sum(c.fp.shape[0] for c in cols_list)
+    if n == 0:
+        return None
+    one = len(cols_list) == 1
+    fp = cols_list[0].fp if one else np.concatenate([c.fp for c in cols_list])
+    err = (
+        cols_list[0].err.copy()
+        if one
+        else np.concatenate([c.err for c in cols_list])
+    )
+    active = err == 0
+    n_act = int(active.sum())
+    if n_act == 0:
+        return None  # all-error batch: let the columns path produce it
+    act_fp = fp[active]
+    # unique-fingerprint kernel contract: duplicate keys need the host pass
+    # planner (sequential same-key semantics) — general path
+    if np.unique(act_fp).size != n_act:
+        return None
+    from gubernator_tpu.ops import wire as wire_mod
+    from gubernator_tpu.ops.batch import created_at_tolerance_ms
+
+    now = now_ms if now_ms is not None else ms_now()
+    created = (
+        cols_list[0].created_at
+        if one
+        else np.concatenate([c.created_at for c in cols_list])
+    )
+    tol = engine.created_at_tolerance_ms
+    if tol is None:
+        tol = created_at_tolerance_ms()
+    stamped = np.where(created == 0, now, created)
+    clipped = np.clip(stamped, now - tol, now + tol)
+    clamped = int((clipped != stamped).sum())
+    base = int(clipped[int(np.argmax(active))])
+    delta = clipped - base
+    if (
+        (delta[active] < -wire_mod.DELTA_BIAS)
+        | (delta[active] > wire_mod.DELTA_BIAS - 1)
+    ).any():
+        return None
+    pad = _pad_size(n)
+    grid = wire_mod.assemble_wire_grid(
+        [p.lanes for p in parts], clipped, base, pad, active
+    )
+    staged = engine.stage_wire(grid, wire_mod.grid_math_mode(grid, n))
+    lazy = _LazyWireBatch(cols_list, now, tol, pad)
+    p = Pass(rows=np.arange(n), batch=lazy, member_rows=[])
+    return PendingCheck(
+        hb=lazy, err=err, now=now, passes=[[p, n, lazy, staged]],
+        clamped=clamped, rows=n,
+    )
 
 
 def prepare_check_columns(engine, cols, now_ms=None) -> PendingCheck:
@@ -325,7 +440,7 @@ def issue_check_columns(engine, pending: PendingCheck) -> PendingCheck:
         return engine.issue_pending(pending)
     for entry in pending.passes:
         _p, _n, batch, staged = entry
-        entry[3] = engine.issue_staged(staged, int(batch.fp.shape[0]))
+        entry[3] = engine.issue_staged(staged, _padded_rows(batch))
     pending.stacked = _stack_pass_outputs(
         [_pending_out(entry[3]) for entry in pending.passes]
     )
@@ -390,8 +505,8 @@ def finish_check_columns(
         fetched = np.asarray(pending.stacked)
         for i, entry in enumerate(pending.passes):
             entry[3] = _pending_with_out(entry[3], fetched[i])
-    hb, err, now = pending.hb, pending.err, pending.now
-    n = hb.fp.shape[0]
+    err, now = pending.err, pending.now
+    n = pending.rows
     status = np.zeros(n, dtype=np.int32)
     limit_o = np.zeros(n, dtype=np.int64)
     remaining = np.zeros(n, dtype=np.int64)
@@ -571,6 +686,23 @@ class LocalEngine:
         batch = pad_batch(pass_batch, _pad_size(n))
         dev, wired = self._stage_ingress(batch)
         return batch, (dev, _math_mode(batch), wired)
+
+    @property
+    def supports_wire_ingress(self) -> bool:
+        """Whether the fused front-door path (prepare_check_wire: native
+        parser lanes staged straight into a compact grid) may target this
+        engine. Compact-wire single-device engines only — full-width mode
+        stays the byte-for-byte parity oracle, and mesh engines stage routed
+        per-shard grids the front door cannot pre-assemble."""
+        return self.wire == "compact" and self._decide_fn is None
+
+    def stage_wire(self, grid: np.ndarray, math: str):
+        """Stage a fused front-door grid (ops/wire.assemble_wire_grid
+        output) — same staged triple as stage_pass's, issued by
+        issue_staged unchanged."""
+        import jax
+
+        return jax.device_put(grid), math, True
 
     def issue_staged(self, staged, batch_rows: int):
         dev, math, wired = staged

@@ -215,6 +215,43 @@ def pack_wire_full(
     return arr
 
 
+def assemble_wire_grid(
+    lane_parts: "list[np.ndarray]",
+    created: np.ndarray,
+    base: int,
+    pad: int,
+    active: np.ndarray,
+) -> np.ndarray:
+    """Fused front-door staging: scatter pre-packed per-request lane blocks
+    (the native parser's (5, n_i) int32 images, created-delta bits zero)
+    into ONE padded (5, pad+1) ingress grid, OR the batch-relative created
+    deltas into lane 4, and stamp the base column. This single scatter IS
+    the staging — no RequestColumns concat, no 12-column HostBatch pack, no
+    second wire pack; the request bytes were traversed exactly once, by the
+    parser. `created` holds the stamped absolute created_at over the
+    concatenated rows; callers verify the delta budget (±2047 ms of `base`)
+    before assembling."""
+    grid = np.zeros((WIRE_LANES, pad + 1), dtype=np.int32)
+    off = 0
+    for lanes in lane_parts:
+        w = lanes.shape[1]
+        grid[:, off : off + w] = lanes
+        off += w
+    delta32 = (
+        ((created - base + DELTA_BIAS) & _DELTA_MASK) << HITS_BITS
+    ).astype(np.int32)
+    grid[4, :off] |= np.where(active, delta32, np.int32(0))
+    stamp_base(grid, base)
+    return grid
+
+
+def grid_math_mode(grid: np.ndarray, n: int) -> str:
+    """Static kernel math variant for an assembled wire grid: any leaky row
+    (algo bit in lane 3) compiles the mixed graph — the lane-level twin of
+    engine._math_mode."""
+    return "mixed" if ((grid[3, :n] >> DUR_BITS) != 0).any() else "token"
+
+
 def stamp_base(block: np.ndarray, base: int) -> None:
     """Write the base into a wire block's trailing column (cells [0, -1]
     and [1, -1]) — shared by every grid builder so the cell assignment can

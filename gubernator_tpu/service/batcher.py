@@ -1,12 +1,28 @@
-"""Front-door request coalescing — the BatchWait tick.
+"""Front-door request coalescing — the multi-worker adaptive batch window.
 
 The reference's defining serving mechanic: requests arriving within a 500 µs
 window (up to a batch limit) coalesce into one batch (reference
 peer_client.go:289-344 does this toward peers; config.go:138-140 sets the
 window). Here the same window feeds the DEVICE: concurrent GetRateLimits
-handlers enqueue column slices, and a dedicated flush loop concatenates them
-into a single kernel dispatch — one TPU batch instead of one channel message
-per item.
+handlers enqueue column slices (or pre-parsed wire batches), and N flush
+workers pull coalesced chunks off a bounded ring into the single engine
+thread's prepare/issue/finish pipeline — one TPU batch instead of one
+channel message per item.
+
+Three serving-plane mechanics live here (docs/latency.md "Serving plane"):
+
+* **Bounded ring.** Enqueues append to a deque capped at `max_queue_rows`;
+  past the cap, callers await drain progress (backpressure) instead of
+  growing an unbounded queue whose tail latency nobody sees until OOM.
+* **N workers.** Each worker forms a chunk, dispatches it, and slices the
+  coalesced response back onto its callers' futures — so chunk formation
+  and response fan-out for dispatch K run in parallel with dispatch K+1's,
+  keeping the engine's depth-N pipeline saturated instead of starving it
+  behind one event-loop task.
+* **Adaptive window.** Under load the window closes on accumulated
+  rows/bytes (engine-sized dispatches), not a wall-clock tick; when the
+  engine is idle the window closes immediately (light load pays no
+  batching latency). `batch_wait_ms` remains the hard ceiling.
 
 NO_BATCHING items bypass the window (reference peer_client.go:126-162's fast
 path) by calling the runner directly.
@@ -17,28 +33,41 @@ from __future__ import annotations
 import asyncio
 import time
 from collections import deque
-from typing import Deque, Optional, Tuple
+from typing import Deque, List, Optional, Tuple
 
 import numpy as np
 
 from gubernator_tpu.ops.batch import RequestColumns, ResponseColumns
 from gubernator_tpu.ops.engine import ms_now
-from gubernator_tpu.service.wire import concat_columns
+from gubernator_tpu.service.wire import WireBatch, concat_columns
 
 # device batches coalesce far beyond the reference's 1000-item RPC cap — the
 # kernel's throughput comes from large batches; this caps one dispatch.
 DEFAULT_COALESCE_LIMIT = 16384
 
 
-class Batcher:
-    """Coalesce concurrent column batches into single engine dispatches.
+def _payload_rows(payload) -> int:
+    return (
+        payload.rows
+        if isinstance(payload, WireBatch)
+        else payload.fp.shape[0]
+    )
 
-    One long-lived flush loop (the runBatch goroutine analog,
-    peer_client.go:289-344) wakes on enqueue, waits out the batch window
-    unless the coalesce limit is already met, and flushes. Items enqueued
-    while a flush's dispatch is in flight are picked up by the next loop
-    iteration — nothing can strand in the queue.
-    """
+
+def _payload_cols(payload) -> RequestColumns:
+    return payload.cols if isinstance(payload, WireBatch) else payload
+
+
+class Batcher:
+    """Coalesce concurrent column/wire batches into single engine dispatches.
+
+    `workers` long-lived flush tasks (the runBatch goroutine analog,
+    peer_client.go:289-344, N-way) wake on enqueue, wait out the adaptive
+    batch window, and each flushes + fans out one chunk at a time. Items
+    enqueued while every worker's dispatch is in flight keep coalescing —
+    backpressure produces FEWER, LARGER dispatches instead of a queue of
+    tiny ones. FIFO chunk formation preserves each request's contiguous
+    slice of the coalesced response."""
 
     def __init__(
         self,
@@ -47,123 +76,228 @@ class Batcher:
         coalesce_limit: int = DEFAULT_COALESCE_LIMIT,
         metrics=None,
         max_inflight: int = 4,
+        workers: int = 0,
+        adaptive: bool = True,
+        close_rows: int = 0,
+        close_bytes: int = 1 << 20,
+        max_queue_rows: int = 0,
     ):
         self.runner = runner
         self.batch_wait_s = batch_wait_ms / 1e3
         self.coalesce_limit = coalesce_limit
         self.metrics = metrics
-        # deque: _flush pops from the head per coalesced chunk — a list's
-        # pop(0) is O(n) per pop, O(n²) across a backlog drain
-        self._pending: Deque[Tuple[RequestColumns, asyncio.Future, float]] = (
-            deque()
+        # worker count IS the dispatch concurrency cap: each worker runs one
+        # dispatch at a time, so `workers` replaces the old in-flight
+        # semaphore. Sized to the engine pipeline depth unless overridden.
+        self.workers = workers if workers > 0 else max(1, max_inflight)
+        self.adaptive = adaptive
+        # adaptive close thresholds: rows defaults to one engine-sized
+        # dispatch, bytes bounds parse-heavy wire traffic
+        self.close_rows = close_rows if close_rows > 0 else coalesce_limit
+        self.close_bytes = close_bytes
+        self.max_queue_rows = (
+            max_queue_rows if max_queue_rows > 0 else coalesce_limit * 8
         )
+        # deque: workers pop from the head per coalesced chunk — a list's
+        # pop(0) is O(n) per pop, O(n²) across a backlog drain
+        self._pending: Deque[Tuple[object, asyncio.Future, float]] = deque()
         self._pending_rows = 0
+        self._pending_bytes = 0
         self._wake: Optional[asyncio.Event] = None
-        self._loop_task: Optional[asyncio.Task] = None
+        self._full: Optional[asyncio.Event] = None  # adaptive early close
+        self._space: Optional[asyncio.Event] = None  # backpressure release
+        self._worker_tasks: List[asyncio.Task] = []
         self._closed = False
-        # pipelining: up to `max_inflight` dispatches run concurrently — the
-        # engine thread issues N+1 while N executes on-device and N-1's
-        # fetch streams back (host pack, device compute, fetch overlap)
-        self._inflight_sem = asyncio.Semaphore(max_inflight)
-        self._inflight: set = set()
+        self._inflight = 0
+        # introspection counters (CI serving smoke + tests read these)
+        self.fused_dispatches = 0  # rode the fused wire→grid path
+        self.column_dispatches = 0  # generic columns path
+        self.wire_fallbacks = 0  # all-wire chunk that could NOT fuse
+        self.adaptive_closes = 0  # window closed on rows/bytes/idle engine
+        self.window_expires = 0  # window closed on the wall-clock ceiling
 
-    async def check(
-        self, cols: RequestColumns, now_ms: Optional[int] = None
-    ) -> ResponseColumns:
-        """Enqueue a column batch; resolves with this batch's slice of the
-        coalesced response."""
+    # ------------------------------------------------------------- enqueue
+    async def check(self, payload, now_ms: Optional[int] = None) -> ResponseColumns:
+        """Enqueue a column batch (RequestColumns) or a pre-parsed wire
+        batch (service/wire.WireBatch); resolves with this batch's slice of
+        the coalesced response."""
         now = now_ms if now_ms is not None else ms_now()
         # stamp unset created_at at ENQUEUE time (reference stamps at request
         # entry, gubernator.go:225-227), not at flush time
-        cols = cols._replace(
-            created_at=np.where(cols.created_at == 0, now, cols.created_at)
-        )
-        loop = asyncio.get_running_loop()
-        fut: asyncio.Future = loop.create_future()
-        self._pending.append((cols, fut, time.perf_counter()))
-        self._pending_rows += cols.fp.shape[0]
-        if self.metrics is not None:
-            self.metrics.queue_length.set(self._pending_rows)
-        if self._closed:
-            # shutdown path: no loop to wake; dispatch inline
-            await self._flush()
+        if isinstance(payload, WireBatch):
+            cols = payload.cols
+            payload = payload._replace(
+                cols=cols._replace(
+                    created_at=np.where(cols.created_at == 0, now, cols.created_at)
+                )
+            )
         else:
-            if self._loop_task is None or self._loop_task.done():
-                self._wake = asyncio.Event()
-                self._loop_task = loop.create_task(self._run())
+            payload = payload._replace(
+                created_at=np.where(
+                    payload.created_at == 0, now, payload.created_at
+                )
+            )
+        rows = _payload_rows(payload)
+        loop = asyncio.get_running_loop()
+        if self._wake is None:
+            self._wake = asyncio.Event()
+            self._full = asyncio.Event()
+            self._space = asyncio.Event()
+        # bounded ring: callers past the cap wait for drain progress instead
+        # of growing the queue without limit (an oversized single batch is
+        # admitted alone rather than deadlocking)
+        while (
+            not self._closed
+            and self._pending_rows > 0
+            and self._pending_rows + rows > self.max_queue_rows
+        ):
+            self._space.clear()
+            await self._space.wait()
+        fut: asyncio.Future = loop.create_future()
+        self._pending.append((payload, fut, time.perf_counter()))
+        self._pending_rows += rows
+        self._pending_bytes += (
+            payload.nbytes if isinstance(payload, WireBatch) else 0
+        )
+        if self._closed:
+            # shutdown path: no workers to wake; dispatch inline
+            await self._flush_all()
+        else:
+            self._ensure_workers(loop)
             self._wake.set()
+            if (
+                self._pending_rows >= self.close_rows
+                or self._pending_bytes >= self.close_bytes
+            ):
+                self._full.set()
         return await fut
 
+    def _ensure_workers(self, loop) -> None:
+        self._worker_tasks = [t for t in self._worker_tasks if not t.done()]
+        while len(self._worker_tasks) < self.workers:
+            self._worker_tasks.append(
+                loop.create_task(
+                    self._run(), name=f"batcher-{len(self._worker_tasks)}"
+                )
+            )
+
+    # ------------------------------------------------------------- workers
     async def _run(self) -> None:
         while not self._closed:
-            await self._wake.wait()
-            self._wake.clear()
             if not self._pending:
+                self._wake.clear()
+                if self._pending:  # raced an enqueue between check and clear
+                    continue
+                await self._wake.wait()
                 continue
-            if self._pending_rows < self.coalesce_limit and self.batch_wait_s > 0:
-                await asyncio.sleep(self.batch_wait_s)
-            await self._flush()
+            await self._window()
+            chunk = self._take_chunk()
+            if chunk is None:
+                continue
+            await self._dispatch(chunk)
 
-    async def _flush(self) -> None:
-        # the coalesce limit is a real per-dispatch cap: flush in chunks of
-        # whole enqueued batches (a single oversized enqueue dispatches
-        # alone), bounding dispatch latency and compile-shape spread. Chunks
-        # dispatch CONCURRENTLY up to the in-flight cap, and — crucially —
-        # each chunk forms AFTER its in-flight slot frees: requests arriving
-        # while every slot is busy keep coalescing into the next chunk, so
-        # backpressure produces FEWER, LARGER dispatches instead of a queue
-        # of tiny ones (the natural batching the serial design had).
-        while self._pending:
-            await self._inflight_sem.acquire()
-            if not self._pending:  # drained while waiting for the slot
-                self._inflight_sem.release()
-                break
-            chunk = [self._pending.popleft()]
-            rows = chunk[0][0].fp.shape[0]
-            while (
-                self._pending
-                and rows + self._pending[0][0].fp.shape[0] <= self.coalesce_limit
-            ):
-                entry = self._pending.popleft()
-                chunk.append(entry)
-                rows += entry[0].fp.shape[0]
-            self._pending_rows -= rows
-            task = asyncio.get_running_loop().create_task(
-                self._dispatch_guarded(chunk)
-            )
-            self._inflight.add(task)
-            task.add_done_callback(self._inflight.discard)
-        # one clamped gauge update per flush, after the chunk loop — per-chunk
-        # sets only churned the gauge with intermediate values
+    async def _window(self) -> None:
+        """Hold the coalesce window open until it should close: on
+        accumulated rows/bytes (engine-sized dispatch ready), on an idle
+        engine (light load — why wait?), on a dispatch slot freeing (refill
+        the pipeline), or on the `batch_wait_ms` wall-clock ceiling."""
+        if self.batch_wait_s <= 0:
+            return
+        if (
+            self._pending_rows >= self.close_rows
+            or self._pending_bytes >= self.close_bytes
+        ):
+            self.adaptive_closes += 1
+            return
+        if self.adaptive and self._inflight == 0:
+            # engine idle: dispatching now beats waiting for company —
+            # requests arriving during THIS dispatch coalesce into the next
+            self.adaptive_closes += 1
+            return
+        if not self.adaptive:
+            await asyncio.sleep(self.batch_wait_s)
+            return
+        self._full.clear()
+        if (
+            self._pending_rows >= self.close_rows
+            or self._pending_bytes >= self.close_bytes
+        ):  # filled while clearing
+            self.adaptive_closes += 1
+            return
+        try:
+            await asyncio.wait_for(self._full.wait(), self.batch_wait_s)
+            self.adaptive_closes += 1
+        except asyncio.TimeoutError:
+            self.window_expires += 1
+
+    def _take_chunk(self):
+        """Pop a chunk of whole enqueued batches up to the coalesce limit
+        (a single oversized enqueue dispatches alone), bounding dispatch
+        latency and compile-shape spread. One clamped gauge update per
+        flush — per-enqueue sets only churned the gauge with intermediate
+        values (hot-path metric cost at high request rates)."""
+        if not self._pending:
+            return None
+        chunk = [self._pending.popleft()]
+        rows = _payload_rows(chunk[0][0])
+        while (
+            self._pending
+            and rows + _payload_rows(self._pending[0][0]) <= self.coalesce_limit
+        ):
+            entry = self._pending.popleft()
+            chunk.append(entry)
+            rows += _payload_rows(entry[0])
+        self._pending_rows -= rows
+        self._pending_bytes = sum(
+            p.nbytes for p, _, _ in self._pending if isinstance(p, WireBatch)
+        )
+        if self._space is not None:
+            self._space.set()
         if self.metrics is not None:
             self.metrics.queue_length.set(max(self._pending_rows, 0))
+        return chunk
 
-    async def _dispatch_guarded(self, chunk) -> None:
-        try:
-            await self._dispatch(chunk)
-        finally:
-            self._inflight_sem.release()
-
+    # ------------------------------------------------------------ dispatch
     async def _dispatch(self, batch) -> None:
-        t0 = time.perf_counter()
-        if self.metrics is not None:
-            oldest = min(ts for _, _, ts in batch)
-            self.metrics.stage_duration.labels(stage="queue").observe(
-                t0 - oldest
-            )
-        cat = concat_columns([c for c, _, _ in batch])
+        self._inflight += 1
         try:
-            rc = await self.runner.check(cat)
+            t0 = time.perf_counter()
+            if self.metrics is not None:
+                oldest = min(ts for _, _, ts in batch)
+                self.metrics.stage_duration.labels(stage="queue").observe(
+                    t0 - oldest
+                )
+            payloads = [p for p, _, _ in batch]
+            rc = None
+            if all(isinstance(p, WireBatch) for p in payloads):
+                # fused path: pre-packed parser lanes scatter straight into
+                # one staged compact grid (ops/engine.prepare_check_wire) —
+                # the request bytes are traversed exactly once end to end
+                rc = await self.runner.check_wire(payloads)
+                if rc is not None:
+                    self.fused_dispatches += 1
+                else:
+                    self.wire_fallbacks += 1
+            if rc is None:
+                cat = concat_columns([_payload_cols(p) for p in payloads])
+                rc = await self.runner.check(cat)
+                self.column_dispatches += 1
         except Exception as exc:  # pragma: no cover - defensive
             for _, fut, _ in batch:
                 if not fut.done():
                     fut.set_exception(exc)
             return
+        finally:
+            self._inflight -= 1
+            if self._full is not None:
+                # a slot freed: a worker holding its window open should
+                # re-evaluate — refilling the pipeline beats waiting
+                self._full.set()
         if self.metrics is not None:
             self.metrics.batch_send_duration.observe(time.perf_counter() - t0)
         off = 0
-        for cols, fut, _ in batch:
-            n = cols.fp.shape[0]
+        for payload, fut, _ in batch:
+            n = _payload_rows(payload)
             sl = slice(off, off + n)
             if not fut.done():
                 fut.set_result(
@@ -177,14 +311,24 @@ class Batcher:
                 )
             off += n
 
+    async def _flush_all(self) -> None:
+        """Drain every pending chunk inline (shutdown path)."""
+        while self._pending:
+            chunk = self._take_chunk()
+            if chunk is None:
+                break
+            await self._dispatch(chunk)
+
     async def drain(self) -> None:
-        """Stop the flush loop and flush anything pending (shutdown path).
-        Lets in-flight dispatches finish rather than cancelling them —
-        cancelled dispatches would strand their callers' futures."""
+        """Stop the flush workers and flush anything pending (shutdown
+        path). Lets in-flight dispatches finish rather than cancelling them
+        — cancelled dispatches would strand their callers' futures."""
         self._closed = True
-        if self._loop_task is not None and not self._loop_task.done():
+        if self._wake is not None:
             self._wake.set()
-            await self._loop_task
-        await self._flush()
-        if self._inflight:
-            await asyncio.gather(*list(self._inflight), return_exceptions=True)
+            self._full.set()
+            self._space.set()
+        if self._worker_tasks:
+            await asyncio.gather(*self._worker_tasks, return_exceptions=True)
+            self._worker_tasks = []
+        await self._flush_all()

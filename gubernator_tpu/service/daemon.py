@@ -48,7 +48,7 @@ from gubernator_tpu.service.peer_client import (
 )
 from gubernator_tpu.service.runner import EngineRunner
 from gubernator_tpu.service.wire import (
-    MAX_BATCH_SIZE,
+    batch_too_large_error,
     columns_from_pb,
     pb_from_response_columns,
     subset_columns,
@@ -143,6 +143,24 @@ class Daemon:
             coalesce_limit=conf.behaviors.coalesce_limit,
             metrics=self.metrics,
             max_inflight=conf.behaviors.pipeline_inflight,
+            workers=conf.behaviors.front_workers,
+            adaptive=conf.behaviors.adaptive_batch,
+            close_rows=conf.behaviors.batch_close_rows,
+            close_bytes=conf.behaviors.batch_close_bytes,
+            max_queue_rows=conf.behaviors.batch_queue_rows,
+        )
+        # front-door parse/encode pool: the native parser and response
+        # encoder drop the GIL, so offloading big request buffers here lets
+        # N workers parse/encode concurrently while the event loop keeps
+        # accepting connections. Tiny requests stay inline — the executor
+        # hop costs more than the parse.
+        from concurrent.futures import ThreadPoolExecutor
+
+        n_door = conf.behaviors.front_workers or max(
+            2, conf.behaviors.pipeline_inflight // 2
+        )
+        self._door = ThreadPoolExecutor(
+            max_workers=n_door, thread_name_prefix="door"
         )
         self.global_manager = GlobalManager(self)
         from gubernator_tpu.service.region_manager import RegionManager
@@ -650,10 +668,8 @@ class Daemon:
     async def get_rate_limits(
         self, items: List["pb.RateLimitReq"]
     ) -> List["pb.RateLimitResp"]:
-        if len(items) > MAX_BATCH_SIZE:
-            raise ValueError(
-                f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'"
-            )
+        if len(items) > self.conf.max_batch_size:
+            raise ValueError(batch_too_large_error(self.conf.max_batch_size))
         self.metrics.concurrent_checks.inc()
         # ingress scope: adopt the client's trace when one is propagated in
         # request metadata, else start a fresh root span
@@ -770,21 +786,35 @@ class Daemon:
         return out  # type: ignore[return-value]
 
     # ------------------------------------------------- native raw fast path
+    # requests below this many wire bytes parse inline: the door-pool
+    # executor hop costs more than the parse itself for small buffers
+    DOOR_OFFLOAD_BYTES = 4096
+
     async def get_rate_limits_raw(self, data: bytes) -> bytes:
         """Serve GetRateLimitsReq wire bytes → GetRateLimitsResp wire bytes.
 
         The native ingress (gubernator_tpu/native) parses the request buffer
-        straight into column arrays — no per-item Python objects on the
-        owner-local path; only items that must travel as messages (forwards,
-        GLOBAL/MULTI_REGION queue entries) materialize lazily from their wire
-        spans. Falls back to the pb path when the extension is unavailable or
-        an event channel needs full request objects."""
-        from gubernator_tpu.service.wire import columns_from_wire
+        straight into column arrays AND pre-packed compact-wire lanes in one
+        pass — no per-item Python objects on the owner-local path, and (for
+        wire-encodable batches against a compact-wire local engine) no
+        column re-pack either: the batcher stages the parser's lanes
+        directly into the dispatch grid. Big buffers parse on the door pool
+        (the C parser drops the GIL, so N workers parse concurrently); only
+        items that must travel as messages (forwards, GLOBAL/MULTI_REGION
+        queue entries) materialize lazily from their wire spans. Falls back
+        to the pb path when the extension is unavailable or an event channel
+        needs full request objects."""
+        from gubernator_tpu.service.wire import wire_batch_from_wire
 
         parsed = None
         if self.event_channel is None:
             t0 = time.perf_counter()
-            parsed = columns_from_wire(data)
+            if len(data) >= self.DOOR_OFFLOAD_BYTES:
+                parsed = await asyncio.get_running_loop().run_in_executor(
+                    self._door, wire_batch_from_wire, data
+                )
+            else:
+                parsed = wire_batch_from_wire(data)
             self.metrics.stage_duration.labels(stage="parse").observe(
                 time.perf_counter() - t0
             )
@@ -792,33 +822,36 @@ class Daemon:
             req = pb.GetRateLimitsReq.FromString(data)
             resps = await self.get_rate_limits(list(req.requests))
             return pb.GetRateLimitsResp(responses=resps).SerializeToString()
-        cols, ring, spans, traceparent = parsed
-        n = cols.fp.shape[0]
-        if n > MAX_BATCH_SIZE:
-            raise ValueError(
-                f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'"
-            )
+        wb, ring, spans, traceparent = parsed
+        n = wb.rows
+        if n > self.conf.max_batch_size:
+            raise ValueError(batch_too_large_error(self.conf.max_batch_size))
         self.metrics.concurrent_checks.inc()
         parent = tracing.parse_traceparent(traceparent) if traceparent else None
         token = tracing.start_scope("GetRateLimits", parent)
         try:
-            return await self._route_raw(data, cols, ring, spans)
+            return await self._route_raw(data, wb, ring, spans)
         finally:
             tracing.end_scope(token)
             self.metrics.concurrent_checks.dec()
 
-    async def _route_raw(self, data, cols, ring, spans) -> bytes:
+    async def _route_raw(self, data, wb, ring, spans) -> bytes:
         from gubernator_tpu.service.wire import (
             encode_response_columns,
             item_from_span,
+            subset_wire,
         )
 
+        cols = wb.cols
         n = cols.fp.shape[0]
         force_global = self.conf.behaviors.force_global
         if force_global:
+            # GLOBAL is kernel-inert (dropped on the compact wire), so the
+            # routing-only behavior flip leaves the parser's lanes valid
             cols = cols._replace(
                 behavior=cols.behavior | np.int32(int(Behavior.GLOBAL))
             )
+            wb = wb._replace(cols=cols)
 
         def materialize(i):
             """Lazy pb item from its wire span; a forced GLOBAL bit must
@@ -871,16 +904,23 @@ class Daemon:
                     errors[int(i)] = ERROR_STRINGS[int(rc.err[j])]
 
         async def run_local():
-            rc = await self.batcher.check(subset_columns(cols, local_rows))
+            # the WireBatch subset keeps the parser's pre-packed lanes with
+            # the columns — an all-local encodable batch stages straight
+            # into the dispatch grid (fused path, service/batcher.py)
+            rc = await self.batcher.check(subset_wire(wb, local_rows))
             place(local_rows, rc)
 
         async def run_global():
             # answer from local state with GLOBAL stripped + NO_BATCHING
-            # forced, and queue the async hits (gubernator.go:401-429)
-            g = subset_columns(cols, global_rows)
+            # forced, and queue the async hits (gubernator.go:401-429).
+            # Both touched bits are kernel-inert — the lane image stays
+            # valid, so the fused path serves GLOBAL answer rows too.
+            g = subset_wire(wb, global_rows)
             g = g._replace(
-                behavior=(g.behavior & ~np.int32(int(Behavior.GLOBAL)))
-                | np.int32(int(Behavior.NO_BATCHING))
+                cols=g.cols._replace(
+                    behavior=(g.cols.behavior & ~np.int32(int(Behavior.GLOBAL)))
+                    | np.int32(int(Behavior.NO_BATCHING))
+                )
             )
             for i in global_rows:
                 item = materialize(i)
@@ -951,7 +991,18 @@ class Daemon:
                 resps.append(r)
             return pb.GetRateLimitsResp(responses=resps).SerializeToString()
         t0 = time.perf_counter()
-        out_bytes = encode_response_columns(status, limit, remaining, reset, errors)
+        if n * 8 >= self.DOOR_OFFLOAD_BYTES:
+            # native encode drops the GIL — responder workers encode big
+            # batches in parallel off the event loop
+            out_bytes = await asyncio.get_running_loop().run_in_executor(
+                self._door,
+                encode_response_columns,
+                status, limit, remaining, reset, errors,
+            )
+        else:
+            out_bytes = encode_response_columns(
+                status, limit, remaining, reset, errors
+            )
         self.metrics.stage_duration.labels(stage="encode").observe(
             time.perf_counter() - t0
         )
@@ -1353,6 +1404,7 @@ class Daemon:
             # shards before the checkpoint (global_manager.close analog)
             await self.runner.sync_global()
         self.maybe_checkpoint()
+        self._door.shutdown(wait=True)
         self.runner.close()
         if tracing.exporter is not None:
             # flush (not close): the exporter is process-global and other

@@ -115,7 +115,7 @@ class DaemonMetrics:
             registry=r,
             buckets=(0.0005, 0.001, 0.002, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5, 2.5),
         )
-        self.stage_duration = Summary(
+        self.stage_duration = Histogram(
             "gubernator_tpu_stage_duration",
             "Seconds per serving-pipeline stage",
             # parse | queue | put | issue | fetch | encode, plus the mesh
@@ -124,9 +124,18 @@ class DaemonMetrics:
             # grid pack, device transfer; docs/latency.md "mesh ingress")
             # and the compact-wire codec stages wire_pack | wire_decode
             # (host encode of the 5-lane ingress grid / decode of the int32
-            # egress; docs/latency.md "wire budget")
+            # egress; docs/latency.md "wire budget").
+            # A HISTOGRAM (was a Summary) so per-stage TAILS are scrapeable:
+            # _sum/_count keep the same series names the e2e bench means
+            # used, and the buckets let BENCH_r06+ report per-stage p99 —
+            # means hid exactly the tail behavior the serving plane is
+            # judged on (docs/latency.md "Serving plane")
             ["stage"],
             registry=r,
+            buckets=(
+                1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 0.01, 0.025, 0.05,
+                0.1, 0.25, 0.5, 1.0, 2.5, 10.0,
+            ),
         )
         self.wire_bytes = Counter(
             # renders as gubernator_tpu_wire_bytes_total

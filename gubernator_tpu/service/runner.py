@@ -68,11 +68,7 @@ class EngineRunner:
             or (can is not None and not can(cols))
         ):
             return await self.check_columns(cols, now_ms=now_ms)
-        from gubernator_tpu.ops.engine import (
-            finish_check_columns,
-            issue_check_columns,
-            prepare_check_columns,
-        )
+        from gubernator_tpu.ops.engine import prepare_check_columns
 
         loop = asyncio.get_running_loop()
 
@@ -85,6 +81,53 @@ class EngineRunner:
                 )
                 self._observe_shard_stages()
             return prepared
+
+        prepared = await loop.run_in_executor(self._prep, prepare)
+        return await self._issue_and_finish(prepared)
+
+    async def check_wire(self, parts, now_ms=None) -> Optional[ResponseColumns]:
+        """Fused front-door check: pre-parsed WireBatch pieces
+        (service/wire.py — native-parser lanes) staged straight into ONE
+        compact ingress grid, no column concat and no HostBatch pack.
+        Returns None when the batch cannot ride the fused path (engine not
+        wire-capable, duplicate keys, non-encodable rows, Store attached) —
+        the caller falls back to the columns path, which is semantically
+        identical."""
+        engine = self.engine
+        if (
+            not getattr(engine, "supports_wire_ingress", False)
+            or getattr(engine, "store", None) is not None
+        ):
+            return None
+        from gubernator_tpu.ops.engine import prepare_check_wire
+
+        loop = asyncio.get_running_loop()
+
+        def prepare():
+            t0 = time.perf_counter()
+            prepared = prepare_check_wire(engine, parts, now_ms=now_ms)
+            if prepared is not None and self.metrics is not None:
+                self.metrics.stage_duration.labels(stage="put").observe(
+                    time.perf_counter() - t0
+                )
+            return prepared
+
+        prepared = await loop.run_in_executor(self._prep, prepare)
+        if prepared is None:
+            return None
+        return await self._issue_and_finish(prepared)
+
+    async def _issue_and_finish(self, prepared) -> ResponseColumns:
+        """Shared issue/finish halves of the pipelined dispatch: ISSUE on
+        the engine thread (enqueue kernel launches, no fetch), FINISH on a
+        fetch worker (materialize outputs, rare fixups back on the engine
+        thread), stats folded in on the engine thread."""
+        from gubernator_tpu.ops.engine import (
+            finish_check_columns,
+            issue_check_columns,
+        )
+
+        loop = asyncio.get_running_loop()
 
         def issue(prepared):
             t0 = time.perf_counter()
@@ -126,7 +169,6 @@ class EngineRunner:
             self._exec.submit(apply)  # fire-and-forget, engine thread
             return rc
 
-        prepared = await loop.run_in_executor(self._prep, prepare)
         pending = await loop.run_in_executor(self._exec, lambda: issue(prepared))
         return await loop.run_in_executor(self._fetch, lambda: finish(pending))
 

@@ -209,10 +209,12 @@ async def start_servers(daemon) -> None:
     the daemon (port 0 supported for tests)."""
     # transport limits mirroring the reference's server options
     # (daemon.go:131-144): 1 MiB receive cap — a wire batch maxes out at
-    # MAX_BATCH_SIZE small messages, so anything bigger is abuse, not
-    # traffic — plus optional connection-age bounds for LB churn
-    # (GUBER_GRPC_MAX_CONN_AGE_SEC, config.go:351).
-    options = [("grpc.max_receive_message_length", 1024 * 1024)]
+    # GUBER_MAX_BATCH_SIZE small messages, so anything bigger is abuse, not
+    # traffic (the cap scales at ~1 KiB/item when the batch limit is raised
+    # past the reference's 1000) — plus optional connection-age bounds for
+    # LB churn (GUBER_GRPC_MAX_CONN_AGE_SEC, config.go:351).
+    recv_cap = max(1024 * 1024, daemon.conf.max_batch_size * 1024)
+    options = [("grpc.max_receive_message_length", recv_cap)]
     if daemon.conf.grpc_max_conn_age_s > 0:
         age_ms = int(daemon.conf.grpc_max_conn_age_s * 1000)
         options += [

@@ -8,7 +8,7 @@ nowhere else on the serving path.
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -23,8 +23,44 @@ from gubernator_tpu.ops.batch import (
 from gubernator_tpu.proto import gubernator_pb2 as pb
 from gubernator_tpu.proto import peers_pb2 as peers_pb
 
-# the reference rejects batches above this size outright (gubernator.go:41-42)
+# the reference rejects batches above this size outright (gubernator.go:41-42);
+# GUBER_MAX_BATCH_SIZE overrides per daemon (config.max_batch_size) — this
+# constant is the wire-compatible default and the rejection-string template.
 MAX_BATCH_SIZE = 1000
+
+
+def batch_too_large_error(cap: int) -> str:
+    """The reference's exact rejection wording (gubernator.go:41-42),
+    parameterized by the configured cap."""
+    return f"Requests.RateLimits list too large; max size is '{cap}'"
+
+
+class WireBatch(NamedTuple):
+    """One parsed request batch carrying BOTH serving forms: the legacy
+    column view (routing, pb fallback, non-encodable dispatches) and the
+    pre-packed compact-wire lanes the native parser produced in the same
+    pass over the bytes. When every row is `encodable`, the batcher stages
+    `lanes` straight into the engine's ingress grid (ops/wire.py layout,
+    created-delta stamped at flush) — the proto bytes are traversed exactly
+    once on the whole serving path."""
+
+    cols: RequestColumns
+    lanes: np.ndarray  # (5, n) int32, lane-4 created-delta bits zero
+    encodable: np.ndarray  # (n,) bool — compact-wire representable
+    nbytes: int  # request wire size (adaptive-window byte accounting)
+
+    @property
+    def rows(self) -> int:
+        return self.cols.fp.shape[0]
+
+
+def subset_wire(wb: WireBatch, rows: np.ndarray) -> WireBatch:
+    return WireBatch(
+        cols=subset_columns(wb.cols, rows),
+        lanes=wb.lanes[:, rows],
+        encodable=wb.encodable[rows],
+        nbytes=int(wb.nbytes * len(rows) / max(wb.rows, 1)),
+    )
 
 
 def columns_from_pb(
@@ -188,21 +224,24 @@ def transfer_chunk_arrays(req):
 # ----------------------------------------------------------- native ingress
 
 
-def columns_from_wire(data: bytes):
+def wire_batch_from_wire(data: bytes):
     """Native parse of GetRateLimitsReq wire bytes (gubernator_tpu.native):
-    → (RequestColumns, ring_points uint32, spans (n,2) int64) or None when
-    the extension is unavailable. ring_points are fnv1a_32 of each item's
-    hash key (the ring lookup hash) and spans are each item's byte range in
-    `data` for lazy pb materialization — only items that must travel as
-    messages (forwards, GLOBAL queue entries) ever become Python objects."""
+    → (WireBatch, ring_points uint32, spans (n,2) int64, traceparent) or
+    None when the extension is unavailable. ring_points are fnv1a_32 of each
+    item's hash key (the ring lookup hash) and spans are each item's byte
+    range in `data` for lazy pb materialization — only items that must
+    travel as messages (forwards, GLOBAL queue entries) ever become Python
+    objects. The WireBatch additionally carries the parser's pre-packed
+    compact-wire lanes — the "parse once, stage once" ingress image."""
     from gubernator_tpu import native
 
     m = native.load()
     if m is None:
         return None
-    n, fp, algo, beh, hits, lim, burst, dur, ca, err, ring, span, traceparent = (
-        m.parse_get_rate_limits(data)
-    )
+    (
+        n, fp, algo, beh, hits, lim, burst, dur, ca, err, ring, span,
+        traceparent, lanes, enc,
+    ) = m.parse_get_rate_limits(data)
     # np.frombuffer over bytes is read-only; routing mutates behavior/err
     cols = RequestColumns(
         fp=np.frombuffer(fp, np.int64),
@@ -215,12 +254,28 @@ def columns_from_wire(data: bytes):
         created_at=np.frombuffer(ca, np.int64),
         err=np.frombuffer(err, np.int8).copy(),
     )
+    wb = WireBatch(
+        cols=cols,
+        lanes=np.frombuffer(lanes, np.int32).reshape(5, n),
+        encodable=np.frombuffer(enc, np.int8).astype(bool),
+        nbytes=len(data),
+    )
     return (
-        cols,
+        wb,
         np.frombuffer(ring, np.uint32),
         np.frombuffer(span, np.int64).reshape(-1, 2),
         traceparent,  # first propagated trace context in the batch, or None
     )
+
+
+def columns_from_wire(data: bytes):
+    """Column-only view of wire_batch_from_wire (kept for callers that
+    don't ride the fused lane path)."""
+    got = wire_batch_from_wire(data)
+    if got is None:
+        return None
+    wb, ring, spans, traceparent = got
+    return wb.cols, ring, spans, traceparent
 
 
 def item_from_span(data: bytes, span) -> "pb.RateLimitReq":
@@ -237,15 +292,18 @@ def encode_response_columns(
     errors: dict,
 ) -> bytes:
     """Native GetRateLimitsResp encode from response columns; `errors` is a
-    sparse {row: message} dict."""
+    sparse {row: message} dict. Arrays cross the boundary via the buffer
+    protocol — contiguous int64 columns encode ZERO-COPY (no .tobytes()
+    staging), and the C assembly loop drops the GIL so responder workers
+    encode in parallel."""
     from gubernator_tpu import native
 
     m = native.load()
     assert m is not None, "native module required (guarded by columns_from_wire)"
     return m.encode_responses(
-        np.ascontiguousarray(status, dtype=np.int64).tobytes(),
-        np.ascontiguousarray(limit, dtype=np.int64).tobytes(),
-        np.ascontiguousarray(remaining, dtype=np.int64).tobytes(),
-        np.ascontiguousarray(reset_time, dtype=np.int64).tobytes(),
+        np.ascontiguousarray(status, dtype=np.int64),
+        np.ascontiguousarray(limit, dtype=np.int64),
+        np.ascontiguousarray(remaining, dtype=np.int64),
+        np.ascontiguousarray(reset_time, dtype=np.int64),
         errors,
     )
