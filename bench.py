@@ -659,6 +659,14 @@ def sharded_ingress_case(rng, now, batch=1 << 17) -> dict:
             # rows ratio is 8×; proportional staging keeps the cost ratio in
             # the same decade, keyspace-bound staging would not move at all
             entry["host_stage_big_vs_small"] = round(big_ms / max(small_ms, 1e-6), 2)
+        # table-health snapshot at this population (ops/telemetry.py): the
+        # same scan the daemon runs on its background cadence, so BENCH
+        # records carry occupancy/collision pressure alongside the rates
+        from gubernator_tpu.ops.telemetry import finish_scan
+
+        entry["table_telemetry"] = finish_scan(
+            sharded.telemetry_begin(now)
+        ).to_dict()
         out[f"{live}"] = entry
     return out
 
@@ -1249,6 +1257,10 @@ def e2e_serving_case() -> dict:
             if cnt:
                 stages[st] = round(tot / cnt * 1e3, 3)
         stage_p99 = _stage_p99_ms(scraped, STAGES)
+        # table-health snapshot through the daemon's own background-scan
+        # path (engine-thread launch, off-thread fetch) — lands in the
+        # bench JSON next to the serving numbers it contextualizes
+        telemetry = (await d.runner.table_telemetry()).to_dict()
         await client.close()
         await d.close()
         arr = np.asarray(sorted(distinct_lat)) * 1e3
@@ -1269,6 +1281,7 @@ def e2e_serving_case() -> dict:
             "column_dispatches": d.batcher.column_dispatches,
             "adaptive_closes": d.batcher.adaptive_closes,
             "window_expires": d.batcher.window_expires,
+            "table_telemetry": telemetry,
             # thundering herd: one key, CLIENTS-way closed loop; the ratio
             # is the planner's hot-key cost (max_exact sequential passes +
             # aggregate tail per dispatch vs 1 pass for distinct keys)

@@ -267,6 +267,15 @@ class DaemonConfig:
     # checkpoint/resume (SURVEY §5.4): snapshot file for the Loader hook
     checkpoint_path: str = ""
 
+    # background device-table telemetry cadence (ops/telemetry.py; the scan
+    # overlaps serving and feeds gubernator_tpu_table_* + /v1/debug/table);
+    # 0 disables the loop (the debug endpoint then scans on demand)
+    telemetry_interval_ms: float = 5_000.0
+    # serve the /v1/debug/{table,pipeline,peers,global} JSON snapshots on
+    # the HTTP listeners (docs/observability.md); off hides the plane on
+    # deployments that treat internals as sensitive
+    debug_endpoints: bool = True
+
     # accepted client created_at skew (ms); requests outside now±tolerance are
     # clamped and counted (gubernator_created_at_clamped_count)
     created_at_tolerance_ms: float = 5 * 60 * 1000.0
@@ -431,6 +440,10 @@ class DaemonConfig:
             raise ConfigError("GUBER_TLS_CLIENT_AUTH must be require or verify")
         if self.created_at_tolerance_ms <= 0:
             raise ConfigError("GUBER_CREATED_AT_TOLERANCE must be positive")
+        if self.telemetry_interval_ms < 0:
+            raise ConfigError(
+                "GUBER_TELEMETRY_INTERVAL_MS must be >= 0 (0 = disabled)"
+            )
 
 
 def setup_daemon_config(
@@ -528,6 +541,10 @@ def setup_daemon_config(
         tls_auto=_get_bool(env, "GUBER_TLS_AUTO", False),
         tls_client_auth=_get(env, "GUBER_TLS_CLIENT_AUTH", ""),
         checkpoint_path=_get(env, "GUBER_CHECKPOINT_PATH", ""),
+        telemetry_interval_ms=_get_float_ms(
+            env, "GUBER_TELEMETRY_INTERVAL_MS", 5_000.0
+        ),
+        debug_endpoints=_get_bool(env, "GUBER_DEBUG_ENDPOINTS", True),
         created_at_tolerance_ms=_get_float_ms(
             env, "GUBER_CREATED_AT_TOLERANCE", 5 * 60 * 1000.0
         ),

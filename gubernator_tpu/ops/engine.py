@@ -958,6 +958,19 @@ class LocalEngine:
         self.stats.dispatches += 1
         return int(np.asarray(found).sum())
 
+    # ------------------------------------------------------------- telemetry
+
+    def telemetry_begin(self, now_ms: Optional[int] = None):
+        """Launch the fused table-telemetry scan (ops/telemetry.py) without
+        fetching — called on the engine thread so it reads a coherent table,
+        finished off-thread so the device scan overlaps serving dispatches
+        (EngineRunner.table_telemetry)."""
+        from gubernator_tpu.ops.telemetry import scan_begin
+
+        return scan_begin(
+            self.table.rows, now_ms if now_ms is not None else ms_now()
+        )
+
     # ---------------------------------------------------------- checkpointing
 
     def snapshot(self) -> np.ndarray:

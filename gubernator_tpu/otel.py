@@ -22,11 +22,41 @@ import logging
 import threading
 import urllib.request
 from collections import deque
-from typing import List
+from typing import List, Optional
 
 log = logging.getLogger("gubernator_tpu.otel")
 
 MAX_BUFFER = 8192  # spans held before the oldest drop (backpressure-free)
+
+
+def _attr_value(v) -> dict:
+    """Python value → OTLP JSON AnyValue (ints are strings per the OTLP 1.x
+    JSON mapping of int64)."""
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+def parse_resource_attributes(raw: str) -> dict:
+    """OTEL_RESOURCE_ATTRIBUTES parser: comma-separated key=value pairs with
+    percent-encoded values (the W3C Baggage subset the OTEL spec mandates).
+    Malformed pairs are skipped — resource decoration must never stop the
+    exporter from coming up."""
+    from urllib.parse import unquote
+
+    out: dict = {}
+    for pair in raw.split(","):
+        if "=" not in pair:
+            continue
+        k, _, v = pair.partition("=")
+        k = k.strip()
+        if k:
+            out[k] = unquote(v.strip())
+    return out
 
 
 class OTLPJsonExporter:
@@ -37,6 +67,7 @@ class OTLPJsonExporter:
         flush_interval_s: float = 2.0,
         max_batch: int = 512,
         append_path: bool = True,
+        resource_attributes: Optional[dict] = None,
     ):
         # OTLP spec: the generic endpoint gets the per-signal path appended;
         # a signal-specific endpoint is used VERBATIM (append_path=False)
@@ -45,6 +76,9 @@ class OTLPJsonExporter:
             ep = ep + "/v1/traces"
         self.endpoint = ep
         self.service_name = service_name
+        # extra resource attributes (OTEL_RESOURCE_ATTRIBUTES): what lets a
+        # shared collector tell multi-daemon cluster nodes apart
+        self.resource_attributes = dict(resource_attributes or {})
         self.flush_interval_s = flush_interval_s
         self.max_batch = max_batch
         self.exported = 0
@@ -63,20 +97,40 @@ class OTLPJsonExporter:
 
     # ------------------------------------------------------------- recording
     def record(
-        self, name: str, span, parent_span_id: str, start_ns: int, end_ns: int
+        self,
+        name: str,
+        span,
+        parent_span_id: str,
+        start_ns: int,
+        end_ns: int,
+        attributes: Optional[dict] = None,
+        links=(),
+        kind: int = 2,
     ) -> None:
-        """tracing.end_scope feeds finished spans here (serving thread —
-        must stay O(1) and never block)."""
+        """tracing.end_scope / tracing.record_span feed finished spans here
+        (serving thread — must stay O(1) and never block). `kind` defaults
+        to SPAN_KIND_SERVER (request scopes wrap RPC handling); stage spans
+        pass SPAN_KIND_INTERNAL (1). `links` carries SpanContexts of related
+        spans in OTHER traces — the batch-aware causality edge."""
         entry = {
             "traceId": span.trace_id,
             "spanId": span.span_id,
             "name": name,
-            "kind": 2,  # SPAN_KIND_SERVER: these scopes wrap RPC handling
+            "kind": kind,
             "startTimeUnixNano": str(start_ns),
             "endTimeUnixNano": str(end_ns),
         }
         if parent_span_id:
             entry["parentSpanId"] = parent_span_id
+        if attributes:
+            entry["attributes"] = [
+                {"key": k, "value": _attr_value(v)}
+                for k, v in attributes.items()
+            ]
+        if links:
+            entry["links"] = [
+                {"traceId": l.trace_id, "spanId": l.span_id} for l in links
+            ]
         with self._lock:
             if len(self._buf) == MAX_BUFFER:
                 self.dropped += 1  # deque(maxlen) evicts the oldest
@@ -92,18 +146,21 @@ class OTLPJsonExporter:
         return out
 
     def _payload(self, spans: List[dict]) -> bytes:
+        resource_attrs = [
+            {
+                "key": "service.name",
+                "value": {"stringValue": self.service_name},
+            }
+        ] + [
+            {"key": k, "value": _attr_value(v)}
+            for k, v in self.resource_attributes.items()
+            if k != "service.name"
+        ]
         return json.dumps(
             {
                 "resourceSpans": [
                     {
-                        "resource": {
-                            "attributes": [
-                                {
-                                    "key": "service.name",
-                                    "value": {"stringValue": self.service_name},
-                                }
-                            ]
-                        },
+                        "resource": {"attributes": resource_attrs},
                         "scopeSpans": [
                             {
                                 "scope": {"name": "gubernator_tpu"},
@@ -169,10 +226,22 @@ def exporter_from_env(env=None):
     generic_ep = env.get("OTEL_EXPORTER_OTLP_ENDPOINT", "")
     if not traces_ep and not generic_ep:
         return None
+    # resource attributes: OTEL_RESOURCE_ATTRIBUTES decorates every span so
+    # multi-daemon clusters sharing one collector stay distinguishable;
+    # OTEL_SERVICE_NAME takes precedence over a service.name entry (the
+    # OTEL SDK precedence rule)
+    attrs = parse_resource_attributes(env.get("OTEL_RESOURCE_ATTRIBUTES", ""))
+    service = (
+        env.get("OTEL_SERVICE_NAME", "")
+        or attrs.pop("service.name", "")
+        or "gubernator-tpu"
+    )
+    attrs.pop("service.name", None)
     return OTLPJsonExporter(
         traces_ep or generic_ep,
-        service_name=env.get("OTEL_SERVICE_NAME", "gubernator-tpu"),
+        service_name=service,
         # per OTLP spec the signal-specific endpoint is used verbatim; only
         # the generic endpoint gets /v1/traces appended
         append_path=not traces_ep,
+        resource_attributes=attrs,
     )

@@ -76,12 +76,26 @@ class PendingHits:
     kernel. Entry order only affects which sync round an entry rides in
     (sync() drains fully every tick), never the reconciled result."""
 
-    __slots__ = ("hb", "hits", "reset")
+    __slots__ = ("hb", "hits", "reset", "oldest_ts")
 
     def __init__(self):
         self.hb: Optional[HostBatch] = None  # unique-fp config carrier rows
         self.hits: Optional[np.ndarray] = None  # (n,) i64 accumulated hits
         self.reset: Optional[np.ndarray] = None  # (n,) i32 RESET bits OR-ed
+        # monotonic ts of the oldest entry still in the accumulator: set
+        # when the first entry lands in an empty queue, cleared only on a
+        # FULL drain (a partial take keeps it — the remainder is no newer,
+        # so staleness stays an upper bound). Feeds the
+        # gubernator_global_sync_staleness_seconds gauge.
+        self.oldest_ts: Optional[float] = None
+
+    def age_s(self) -> float:
+        """Seconds the oldest pending entry has waited (0 when empty)."""
+        if self.oldest_ts is None or self.hb is None:
+            return 0.0
+        import time as _time
+
+        return max(0.0, _time.monotonic() - self.oldest_ts)
 
     def __len__(self) -> int:
         # single read of self.hb: has_pending() is called from the event-loop
@@ -96,6 +110,10 @@ class PendingHits:
     ) -> None:
         """Fold batch rows `rows` of `hb` in (hits pre-zeroed for owner-side
         rows that only mark a broadcast)."""
+        if self.hb is None:
+            import time as _time
+
+            self.oldest_ts = _time.monotonic()
         new = _subset(hb, rows)
         if self.hb is not None:
             new = HostBatch(
@@ -135,6 +153,7 @@ class PendingHits:
         )
         if k == n:
             self.hb = self.hits = self.reset = None
+            self.oldest_ts = None
         else:
             self.hb = HostBatch(*[f[k:] for f in self.hb])
             self.hits = self.hits[k:]
@@ -145,6 +164,7 @@ class PendingHits:
         """Drop every pending entry (bench/test harness reset — modeling a
         steady state where the sync tick keeps the accumulator drained)."""
         self.hb = self.hits = self.reset = None
+        self.oldest_ts = None
 
 
 @dataclass
@@ -447,6 +467,11 @@ class GlobalShardedEngine(ShardedEngine):
 
     def has_pending(self) -> bool:
         return any(len(p) for p in self.pending)
+
+    def oldest_pending_age_s(self) -> float:
+        """Age of the oldest un-synced mesh-GLOBAL hit across every home
+        device's outbox (the in-mesh half of the staleness gauge)."""
+        return max((p.age_s() for p in self.pending), default=0.0)
 
     # ------------------------------------------------------------------ check
     def check(

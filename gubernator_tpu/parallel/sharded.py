@@ -650,6 +650,18 @@ class ShardedEngine:
         self.stats.dispatches += 1
         return int(np.asarray(found).sum())
 
+    # ----------------------------------------------------------- telemetry
+
+    def telemetry_begin(self, now_ms: Optional[int] = None):
+        """Launch the per-shard telemetry scan (parallel/telemetry.py)
+        without fetching; additionally yields per-shard live counts so hot
+        shards are observable (cf. LocalEngine.telemetry_begin)."""
+        from gubernator_tpu.parallel.telemetry import sharded_scan_begin
+
+        return sharded_scan_begin(
+            self, now_ms if now_ms is not None else ms_now()
+        )
+
     supports_grow = False  # the daemon must not start an auto-grow loop
 
     def maybe_grow(self, **kw) -> bool:

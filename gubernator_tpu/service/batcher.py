@@ -37,6 +37,7 @@ from typing import Deque, List, Optional, Tuple
 
 import numpy as np
 
+from gubernator_tpu import tracing
 from gubernator_tpu.ops.batch import RequestColumns, ResponseColumns
 from gubernator_tpu.ops.engine import ms_now
 from gubernator_tpu.service.wire import WireBatch, concat_columns
@@ -98,9 +99,15 @@ class Batcher:
         self.max_queue_rows = (
             max_queue_rows if max_queue_rows > 0 else coalesce_limit * 8
         )
-        # deque: workers pop from the head per coalesced chunk — a list's
-        # pop(0) is O(n) per pop, O(n²) across a backlog drain
-        self._pending: Deque[Tuple[object, asyncio.Future, float]] = deque()
+        # deque of (payload, future, enqueue perf_counter, requester span):
+        # workers pop from the head per coalesced chunk — a list's pop(0) is
+        # O(n) per pop, O(n²) across a backlog drain. The span is the
+        # enqueueing request's trace context, linked to the dispatch span
+        # that ends up serving it (batching breaks parent-child causality;
+        # OTLP links restore it — docs/observability.md).
+        self._pending: Deque[Tuple[object, asyncio.Future, float, object]] = (
+            deque()
+        )
         self._pending_rows = 0
         self._pending_bytes = 0
         self._wake: Optional[asyncio.Event] = None
@@ -115,6 +122,9 @@ class Batcher:
         self.wire_fallbacks = 0  # all-wire chunk that could NOT fuse
         self.adaptive_closes = 0  # window closed on rows/bytes/idle engine
         self.window_expires = 0  # window closed on the wall-clock ceiling
+        # adaptive-close reason split (the /v1/debug/pipeline payload):
+        # rows/bytes thresholds, idle engine, freed dispatch slot
+        self.close_reasons = {"rows": 0, "bytes": 0, "idle": 0, "slot": 0}
 
     # ------------------------------------------------------------- enqueue
     async def check(self, payload, now_ms: Optional[int] = None) -> ResponseColumns:
@@ -154,7 +164,9 @@ class Batcher:
             self._space.clear()
             await self._space.wait()
         fut: asyncio.Future = loop.create_future()
-        self._pending.append((payload, fut, time.perf_counter()))
+        self._pending.append(
+            (payload, fut, time.perf_counter(), tracing.current_span())
+        )
         self._pending_rows += rows
         self._pending_bytes += (
             payload.nbytes if isinstance(payload, WireBatch) else 0
@@ -207,12 +219,13 @@ class Batcher:
             self._pending_rows >= self.close_rows
             or self._pending_bytes >= self.close_bytes
         ):
-            self.adaptive_closes += 1
+            self._close_adaptive()
             return
         if self.adaptive and self._inflight == 0:
             # engine idle: dispatching now beats waiting for company —
             # requests arriving during THIS dispatch coalesce into the next
             self.adaptive_closes += 1
+            self.close_reasons["idle"] += 1
             return
         if not self.adaptive:
             await asyncio.sleep(self.batch_wait_s)
@@ -222,13 +235,24 @@ class Batcher:
             self._pending_rows >= self.close_rows
             or self._pending_bytes >= self.close_bytes
         ):  # filled while clearing
-            self.adaptive_closes += 1
+            self._close_adaptive()
             return
         try:
             await asyncio.wait_for(self._full.wait(), self.batch_wait_s)
-            self.adaptive_closes += 1
+            self._close_adaptive()
         except asyncio.TimeoutError:
             self.window_expires += 1
+
+    def _close_adaptive(self) -> None:
+        """Count one adaptive close, attributed to what actually tripped it
+        (rows/bytes threshold, else a freed dispatch slot re-evaluating)."""
+        self.adaptive_closes += 1
+        if self._pending_rows >= self.close_rows:
+            self.close_reasons["rows"] += 1
+        elif self._pending_bytes >= self.close_bytes:
+            self.close_reasons["bytes"] += 1
+        else:
+            self.close_reasons["slot"] += 1
 
     def _take_chunk(self):
         """Pop a chunk of whole enqueued batches up to the coalesce limit
@@ -249,7 +273,7 @@ class Batcher:
             rows += _payload_rows(entry[0])
         self._pending_rows -= rows
         self._pending_bytes = sum(
-            p.nbytes for p, _, _ in self._pending if isinstance(p, WireBatch)
+            e[0].nbytes for e in self._pending if isinstance(e[0], WireBatch)
         )
         if self._space is not None:
             self._space.set()
@@ -260,32 +284,49 @@ class Batcher:
     # ------------------------------------------------------------ dispatch
     async def _dispatch(self, batch) -> None:
         self._inflight += 1
+        # one `dispatch` span per flush: batching breaks request→engine
+        # parent-child causality (N requests share one flush), so the flush
+        # gets its OWN trace with stage child spans (queue here; put/issue/
+        # fetch in the runner) and every request span gains an OTLP link to
+        # it — minted only when spans actually export
+        disp_span = tracing.new_span() if tracing.exporter is not None else None
+        fused = False
         try:
             t0 = time.perf_counter()
+            oldest = min(e[2] for e in batch)
             if self.metrics is not None:
-                oldest = min(ts for _, _, ts in batch)
                 self.metrics.stage_duration.labels(stage="queue").observe(
-                    t0 - oldest
+                    t0 - oldest,
+                    exemplar=(
+                        {"trace_id": disp_span.trace_id} if disp_span else None
+                    ),
                 )
-            payloads = [p for p, _, _ in batch]
+            if disp_span is not None:
+                q_ns = time.time_ns()
+                tracing.record_span(
+                    "queue", tracing.new_span(disp_span), disp_span.span_id,
+                    q_ns - int((t0 - oldest) * 1e9), q_ns,
+                )
+            payloads = [e[0] for e in batch]
             rc = None
             if all(isinstance(p, WireBatch) for p in payloads):
                 # fused path: pre-packed parser lanes scatter straight into
                 # one staged compact grid (ops/engine.prepare_check_wire) —
                 # the request bytes are traversed exactly once end to end
-                rc = await self.runner.check_wire(payloads)
+                rc = await self.runner.check_wire(payloads, span=disp_span)
                 if rc is not None:
                     self.fused_dispatches += 1
+                    fused = True
                 else:
                     self.wire_fallbacks += 1
             if rc is None:
                 cat = concat_columns([_payload_cols(p) for p in payloads])
-                rc = await self.runner.check(cat)
+                rc = await self.runner.check(cat, span=disp_span)
                 self.column_dispatches += 1
         except Exception as exc:  # pragma: no cover - defensive
-            for _, fut, _ in batch:
-                if not fut.done():
-                    fut.set_exception(exc)
+            for e in batch:
+                if not e[1].done():
+                    e[1].set_exception(exc)
             return
         finally:
             self._inflight -= 1
@@ -294,9 +335,33 @@ class Batcher:
                 # re-evaluate — refilling the pipeline beats waiting
                 self._full.set()
         if self.metrics is not None:
-            self.metrics.batch_send_duration.observe(time.perf_counter() - t0)
+            self.metrics.batch_send_duration.observe(
+                time.perf_counter() - t0,
+                exemplar=(
+                    {"trace_id": disp_span.trace_id} if disp_span else None
+                ),
+            )
+        if disp_span is not None:
+            # request spans → dispatch span links (registered while their
+            # scopes are still open: the futures resolve after this), and
+            # the dispatch span itself links back to every distinct request
+            req_spans = [e[3] for e in batch if e[3] is not None]
+            for rs in req_spans:
+                tracing.add_span_link(rs, disp_span)
+            end_ns = time.time_ns()
+            tracing.record_span(
+                "dispatch", disp_span, "",
+                end_ns - int((time.perf_counter() - oldest) * 1e9), end_ns,
+                attributes={
+                    "batch.rows": sum(_payload_rows(e[0]) for e in batch),
+                    "batch.requests": len(batch),
+                    "batch.fused": fused,
+                },
+                links=req_spans,
+            )
         off = 0
-        for payload, fut, _ in batch:
+        for e in batch:
+            payload, fut = e[0], e[1]
             n = _payload_rows(payload)
             sl = slice(off, off + n)
             if not fut.done():
@@ -310,6 +375,32 @@ class Batcher:
                     )
                 )
             off += n
+
+    def debug(self) -> dict:
+        """Live front-door state for /v1/debug/pipeline (docs/observability.md):
+        ring depth, worker liveness, dispatch-path counters, and WHY the
+        adaptive window has been closing."""
+        return {
+            "pending_requests": len(self._pending),
+            "pending_rows": self._pending_rows,
+            "pending_bytes": self._pending_bytes,
+            "inflight": self._inflight,
+            "workers": self.workers,
+            "workers_alive": sum(1 for t in self._worker_tasks if not t.done()),
+            "adaptive": self.adaptive,
+            "batch_wait_ms": self.batch_wait_s * 1e3,
+            "coalesce_limit": self.coalesce_limit,
+            "close_rows": self.close_rows,
+            "close_bytes": self.close_bytes,
+            "max_queue_rows": self.max_queue_rows,
+            "fused_dispatches": self.fused_dispatches,
+            "column_dispatches": self.column_dispatches,
+            "wire_fallbacks": self.wire_fallbacks,
+            "adaptive_closes": self.adaptive_closes,
+            "window_expires": self.window_expires,
+            "close_reasons": dict(self.close_reasons),
+            "closed": self._closed,
+        }
 
     async def _flush_all(self) -> None:
         """Drain every pending chunk inline (shutdown path)."""

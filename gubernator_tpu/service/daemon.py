@@ -168,6 +168,8 @@ class Daemon:
         self.region_manager = RegionManager(self)
         self._maintenance_task = None
         self._global_sync_task = None  # mesh-global collective sync tick
+        self._telemetry_task = None  # background table-telemetry cadence
+        self._table_telemetry = None  # last ops/telemetry.TableSnapshot
         self._local_picker = ReplicatedConsistentHash()
         self._region_picker = RegionPicker()
         self._peer_clients: Dict[str, PeerClient] = {}
@@ -241,6 +243,13 @@ class Daemon:
             d._global_sync_task = asyncio.create_task(
                 d._global_sync_loop(), name="mesh-global-sync"
             )
+        if conf.telemetry_interval_ms > 0:
+            # background table-telemetry cadence (docs/observability.md):
+            # the scan is issued on the engine thread and fetched off it, so
+            # it overlaps serving dispatches — never the serving path
+            d._telemetry_task = asyncio.create_task(
+                d._telemetry_loop(), name="table-telemetry"
+            )
         if d._client_creds is not None and conf.tls_cert_file:
             # rotation watcher: the gRPC server hot-reloads per handshake,
             # but peer-forwarding CLIENTS hold credentials from startup — on
@@ -278,6 +287,46 @@ class Daemon:
                 raise
             except Exception:  # pragma: no cover - defensive
                 log.exception("mesh global sync tick failed")
+
+    async def _telemetry_loop(self) -> None:
+        """Background table-telemetry cadence (GUBER_TELEMETRY_INTERVAL_MS):
+        refresh the gubernator_tpu_table_* families, the /v1/debug/table
+        snapshot, cache_size, and the GLOBAL staleness gauge."""
+        wait_s = self.conf.telemetry_interval_ms / 1e3
+        while not self._shutting_down:
+            await asyncio.sleep(wait_s)
+            try:
+                await self.collect_telemetry()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # pragma: no cover - defensive
+                log.exception("table telemetry tick failed")
+
+    async def collect_telemetry(self):
+        """One telemetry round: scan the table (engine-thread launch, off-
+        thread fetch — EngineRunner.table_telemetry) and publish the
+        snapshot. Also callable on demand (the debug endpoint uses it when
+        the loop is disabled)."""
+        snap = await self.runner.table_telemetry()
+        self._table_telemetry = snap
+        self.metrics.observe_table(snap)
+        # the scan counts live keys anyway — keep cache_size fresh between
+        # /metrics scrapes for free
+        self.metrics.cache_size.set(snap.live_keys)
+        self.metrics.global_sync_staleness.set(self.global_sync_staleness_s())
+        return snap
+
+    def global_sync_staleness_s(self) -> float:
+        """Age of the oldest un-synced GLOBAL hit across BOTH planes: the
+        cross-daemon async queue (GlobalManager) and the in-mesh outbox
+        (GlobalShardedEngine.pending). The convergence-lag signal the
+        multi-region roadmap item is judged on — if this grows while
+        traffic flows, replicas are falling behind their owners."""
+        age = self.global_manager.oldest_hit_age_s()
+        mesh_age = getattr(self.engine, "oldest_pending_age_s", None)
+        if mesh_age is not None:
+            age = max(age, mesh_age())
+        return age
 
     async def _maintenance_loop(self) -> None:
         """Auto-grow tick: double the table when live keys pass 60% of
@@ -807,6 +856,7 @@ class Daemon:
         from gubernator_tpu.service.wire import wire_batch_from_wire
 
         parsed = None
+        parse_s = 0.0
         if self.event_channel is None:
             t0 = time.perf_counter()
             if len(data) >= self.DOOR_OFFLOAD_BYTES:
@@ -815,9 +865,7 @@ class Daemon:
                 )
             else:
                 parsed = wire_batch_from_wire(data)
-            self.metrics.stage_duration.labels(stage="parse").observe(
-                time.perf_counter() - t0
-            )
+            parse_s = time.perf_counter() - t0
         if parsed is None:
             req = pb.GetRateLimitsReq.FromString(data)
             resps = await self.get_rate_limits(list(req.requests))
@@ -829,6 +877,11 @@ class Daemon:
         self.metrics.concurrent_checks.inc()
         parent = tracing.parse_traceparent(traceparent) if traceparent else None
         token = tracing.start_scope("GetRateLimits", parent)
+        # parse is a stage of THIS request (not of any batch dispatch):
+        # observed under the request span so its exemplar resolves to the
+        # request's own trace; the child span makes "where did my p99 go"
+        # decomposable per request
+        self._observe_request_stage("parse", parse_s, token.span)
         try:
             return await self._route_raw(data, wb, ring, spans)
         finally:
@@ -1003,10 +1056,26 @@ class Daemon:
             out_bytes = encode_response_columns(
                 status, limit, remaining, reset, errors
             )
-        self.metrics.stage_duration.labels(stage="encode").observe(
-            time.perf_counter() - t0
+        self._observe_request_stage(
+            "encode", time.perf_counter() - t0, tracing.current_span()
         )
         return out_bytes
+
+    def _observe_request_stage(self, stage: str, dt_s: float, span) -> None:
+        """One request-scoped stage (parse/encode — stages that belong to a
+        single request, unlike the per-flush queue/put/issue/fetch): the
+        histogram sample carries the REQUEST trace as its exemplar and the
+        child span hangs under the request span."""
+        self.metrics.stage_duration.labels(stage=stage).observe(
+            dt_s,
+            exemplar={"trace_id": span.trace_id} if span is not None else None,
+        )
+        if span is not None and tracing.exporter is not None:
+            end_ns = time.time_ns()
+            tracing.record_span(
+                stage, tracing.new_span(span), span.span_id,
+                end_ns - int(dt_s * 1e9), end_ns,
+            )
 
     def _emit_event(self, item, resp) -> None:
         if resp is None:  # pragma: no cover - defensive
@@ -1239,6 +1308,88 @@ class Daemon:
             self._applied_transfers.popitem(last=False)
         return handoff_pb.TransferStateResp(merged=merged)
 
+    # ------------------------------------------------------------ debug plane
+    # JSON snapshots behind /v1/debug/{table,pipeline,peers,global}
+    # (docs/observability.md): what to look at when p99 regresses (pipeline),
+    # when evictions start (table), when forwards fail (peers), and when
+    # GLOBAL convergence lags (global).
+
+    async def debug_table(self) -> dict:
+        """Latest table-telemetry snapshot; scans on demand when the
+        background cadence is disabled or has not ticked yet."""
+        snap = self._table_telemetry
+        if snap is None:
+            snap = await self.collect_telemetry()
+        return snap.to_dict()
+
+    def debug_pipeline(self) -> dict:
+        """Front-door + engine pipeline state: ring depth, worker liveness,
+        dispatch-path counters, adaptive-close reasons, engine identity."""
+        eng = self.engine
+        return {
+            "batcher": self.batcher.debug(),
+            "engine": {
+                "kind": type(eng).__name__,
+                "wire": getattr(eng, "wire", None),
+                "write_mode": getattr(eng, "write_mode", None),
+                "n_shards": getattr(eng, "n_shards", 1),
+                "route": getattr(eng, "route", None),
+                "dedup": getattr(eng, "dedup", None),
+                "poisoned": getattr(eng, "poisoned", None),
+                "checks": eng.stats.checks,
+                "dispatches": eng.stats.dispatches,
+                "dropped": eng.stats.dropped,
+            },
+            "pipeline_inflight": self.conf.behaviors.pipeline_inflight,
+            "concurrent_checks": self.metrics.concurrent_checks._value.get(),
+        }
+
+    def debug_peers(self) -> dict:
+        """Peer plane: per-peer breaker state + recent errors, and ownership
+        handoff progress."""
+        peers = []
+        for addr, client in self._peer_clients.items():
+            peers.append({
+                "address": addr,
+                "breaker_state": client.breaker.state_name,
+                "recent_errors": client.recent_errors()[:5],
+            })
+        h = self.handoff
+        return {
+            "self": self.conf.advertise_address,
+            "local_peer_count": self._local_picker.size(),
+            "region_peer_count": self._region_picker.size(),
+            "leaving": self._leaving,
+            "peers": peers,
+            "handoff": {
+                "enabled": h.enabled,
+                "active": h.active,
+                "rounds": h.rounds,
+                "last_round": dict(h.last_round),
+                "tracked_fps": len(self.ownership),
+            },
+        }
+
+    def debug_global(self) -> dict:
+        """GLOBAL behavior: cross-daemon queue ages + mesh outbox depth —
+        the convergence-lag view behind the staleness gauge."""
+        out = {
+            "staleness_s": round(self.global_sync_staleness_s(), 3),
+            "manager": self.global_manager.debug(),
+        }
+        self.metrics.global_sync_staleness.set(out["staleness_s"])
+        if getattr(self.engine, "mesh_global", False):
+            gs = self.engine.global_stats
+            out["mesh"] = {
+                "pending": sum(len(p) for p in self.engine.pending),
+                "oldest_age_s": round(self.engine.oldest_pending_age_s(), 3),
+                "sync_rounds": gs.sync_rounds,
+                "hits_queued": gs.hits_queued,
+                "broadcasts_applied": gs.broadcasts_applied,
+                "updates_installed": gs.updates_installed,
+            }
+        return out
+
     # ----------------------------------------------------------------- health
     async def health_check(self) -> "pb.HealthCheckResp":
         """Aggregate per-peer recent errors + breaker states (reference
@@ -1371,6 +1522,12 @@ class Daemon:
             self._global_sync_task.cancel()
             try:
                 await self._global_sync_task
+            except asyncio.CancelledError:
+                pass
+        if self._telemetry_task is not None:
+            self._telemetry_task.cancel()
+            try:
+                await self._telemetry_task
             except asyncio.CancelledError:
                 pass
         if self._pool is not None:

@@ -41,6 +41,12 @@ class GlobalManager:
         self.queue_cap = b.global_queue_cap
         # pending hits: hash_key → aggregated RateLimitReq (non-owner side)
         self._hits: Dict[str, pb.RateLimitReq] = {}
+        # hash_key → monotonic ts of the key's FIRST un-synced hit; survives
+        # requeues (the hit is that old however many sends failed) and is
+        # dropped only when the key's hits reach the owner or are dropped.
+        # min() over this is the gubernator_global_sync_staleness_seconds
+        # gauge — the convergence-lag signal (docs/observability.md).
+        self._hit_age: Dict[str, float] = {}
         # requeue accounting: hash_key → failed-send count (bounded retries)
         self._hit_attempts: Dict[str, int] = {}
         # pending broadcasts: hash_key → latest owner-side request (config carrier)
@@ -77,6 +83,7 @@ class GlobalManager:
         Zero-hit requests are never queued (global.go:85-95)."""
         if item.hits == 0:
             return
+        self._hit_age.setdefault(key, time.monotonic())
         agg = self._hits.get(key)
         if agg is None:
             agg = pb.RateLimitReq()
@@ -132,10 +139,12 @@ class GlobalManager:
             except Exception:
                 # no peers; drop (eventual consistency tolerates it)
                 self._hit_attempts.pop(key, None)
+                self._hit_age.pop(key, None)
                 continue
             if self.daemon.is_self(info):
                 # became owner since queueing; owner path handles it
                 self._hit_attempts.pop(key, None)
+                self._hit_age.pop(key, None)
                 continue
             by_peer.setdefault(info.grpc_address, []).append((key, item))
             infos[info.grpc_address] = info
@@ -177,6 +186,7 @@ class GlobalManager:
                 else:
                     for key, _ in pairs:
                         self._hit_attempts.pop(key, None)
+                        self._hit_age.pop(key, None)
 
         await asyncio.gather(*(send(a, p) for a, p in by_peer.items()))
         if by_peer:
@@ -194,6 +204,7 @@ class GlobalManager:
                 key not in self._hits and len(self._hits) >= self.queue_cap
             ):
                 self._hit_attempts.pop(key, None)
+                self._hit_age.pop(key, None)
                 dropped += 1
                 continue
             self._hit_attempts[key] = attempts
@@ -212,6 +223,27 @@ class GlobalManager:
         if dropped:
             self.metrics.global_requeue_dropped.inc(dropped)
         self.metrics.global_queue_length.set(len(self._hits))
+
+    # ----------------------------------------------------------- introspection
+    def oldest_hit_age_s(self) -> float:
+        """Age of the oldest GLOBAL hit not yet acked by its owner (0 when
+        nothing is pending) — queued AND in-flight/requeued keys count; a
+        hit is only "synced" once an owner send succeeded."""
+        if not self._hit_age:
+            return 0.0
+        return max(0.0, time.monotonic() - min(self._hit_age.values()))
+
+    def debug(self) -> dict:
+        """Live GLOBAL-plane state for /v1/debug/global."""
+        return {
+            "pending_hits": len(self._hits),
+            "pending_updates": len(self._updates),
+            "unsynced_keys": len(self._hit_age),
+            "requeue_attempts": len(self._hit_attempts),
+            "oldest_hit_age_s": round(self.oldest_hit_age_s(), 3),
+            "sync_wait_ms": self.sync_wait_s * 1e3,
+            "batch_limit": self.batch_limit,
+        }
 
     # -------------------------------------------------------- broadcast loop
     async def _broadcast_loop(self) -> None:

@@ -58,6 +58,11 @@ class HandoffManager:
         # one round at a time: overlapping rebalances (a flapping discovery
         # backend) would race extract/tombstone against each other
         self._lock = asyncio.Lock()
+        # progress surface for /v1/debug/peers: is a round running, and what
+        # did the last one move
+        self.active = False
+        self.rounds = 0
+        self.last_round: Dict[str, float] = {}
 
     # ------------------------------------------------------------- entries
     async def rebalance(self, old_picker, new_picker) -> Dict[str, int]:
@@ -91,6 +96,7 @@ class HandoffManager:
         daemon = self.daemon
         self_addr = daemon.conf.advertise_address
         stats = dict(extracted=0, transferred=0, tombstoned=0, unroutable=0)
+        self.active = True
         try:
             fps, slots = await daemon.runner.extract_live()
             if fps.shape[0] == 0:
@@ -147,6 +153,11 @@ class HandoffManager:
                 )
             return stats
         finally:
+            self.active = False
+            self.rounds += 1
+            self.last_round = {
+                **stats, "duration_ms": round((time.perf_counter() - t0) * 1e3, 1),
+            }
             self.metrics.handoff_duration.observe(time.perf_counter() - t0)
             log.info(
                 "handoff round: %s in %.1f ms",

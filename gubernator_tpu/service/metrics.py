@@ -20,7 +20,44 @@ from prometheus_client import (
     Summary,
     generate_latest,
 )
+from prometheus_client.core import CounterMetricFamily
+from prometheus_client.openmetrics import exposition as om_exposition
 from prometheus_client.parser import text_string_to_metric_families
+
+# one bucket scheme for every request/stage-latency histogram on the serving
+# path (stage_duration since PR 6; grpc_request_duration/batch_send_duration
+# since the observability PR — Summaries hid exactly the tails the serving
+# plane is judged on, and Summaries cannot carry OpenMetrics exemplars)
+LATENCY_BUCKETS = (
+    1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 10.0,
+)
+
+
+class _OtelSpanCollector:
+    """Surfaces the process-global OTLP exporter's own health as scrapeable
+    series (gubernator_otel_spans_*): export failures used to be counted in
+    exporter attributes nobody could scrape — a silently dead trace pipeline
+    looked identical to an idle one. Reads tracing.exporter at collect time
+    (zeros when no exporter is configured), so every daemon's registry in a
+    shared process reports the shared pipeline, like the process collectors
+    do."""
+
+    def collect(self):
+        from gubernator_tpu import tracing
+
+        exp = tracing.exporter
+        for name, doc, value in (
+            ("exported", "Spans successfully exported over OTLP",
+             getattr(exp, "exported", 0)),
+            ("dropped", "Spans dropped by the bounded export buffer",
+             getattr(exp, "dropped", 0)),
+            ("export_errors", "Failed OTLP export POSTs (batch dropped)",
+             getattr(exp, "export_errors", 0)),
+        ):
+            fam = CounterMetricFamily(f"gubernator_otel_spans_{name}", doc)
+            fam.add_metric([], value)
+            yield fam
 
 
 class DaemonMetrics:
@@ -64,11 +101,15 @@ class DaemonMetrics:
             ["method", "status"],
             registry=r,
         )
-        self.grpc_request_duration = Summary(
+        self.grpc_request_duration = Histogram(
+            # a HISTOGRAM (was a Summary): request-plane TAILS are the
+            # serving plane's acceptance metric, and histogram buckets can
+            # carry trace-exemplars — _sum/_count series names unchanged
             "gubernator_grpc_request_duration",
             "Request handling duration in seconds",
             ["method"],
             registry=r,
+            buckets=LATENCY_BUCKETS,
         )
         self.concurrent_checks = Gauge(
             "gubernator_concurrent_checks_counter",
@@ -132,10 +173,7 @@ class DaemonMetrics:
             # judged on (docs/latency.md "Serving plane")
             ["stage"],
             registry=r,
-            buckets=(
-                1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 0.01, 0.025, 0.05,
-                0.1, 0.25, 0.5, 1.0, 2.5, 10.0,
-            ),
+            buckets=LATENCY_BUCKETS,
         )
         self.wire_bytes = Counter(
             # renders as gubernator_tpu_wire_bytes_total
@@ -164,10 +202,12 @@ class DaemonMetrics:
             "Items waiting in the front-door coalescing buffer",
             registry=r,
         )
-        self.batch_send_duration = Summary(
+        self.batch_send_duration = Histogram(
+            # Histogram (was Summary): see grpc_request_duration
             "gubernator_batch_send_duration",
             "Seconds per coalesced front-door batch",
             registry=r,
+            buckets=LATENCY_BUCKETS,
         )
         self.batch_queue_length = Gauge(
             "gubernator_batch_queue_length",
@@ -296,6 +336,94 @@ class DaemonMetrics:
             "Requests whose client created_at was outside the skew tolerance",
             registry=r,
         )
+        # --- device-side table telemetry (ops/telemetry.py; the background
+        # scan EngineRunner.table_telemetry feeds via observe_table). These
+        # are SNAPSHOT gauges, not event counters: each scan replaces the
+        # previous values; distribution families use a bucket label like a
+        # histogram's `le` but stay gauges because the population they
+        # describe (live keys right now) shrinks as well as grows.
+        self.table_live_keys = Gauge(
+            "gubernator_tpu_table_live_keys",
+            "Live (non-empty, unexpired) keys at the last telemetry scan",
+            registry=r,
+        )
+        self.table_occupied_slots = Gauge(
+            "gubernator_tpu_table_occupied_slots",
+            "Occupied slots (live + expired-not-yet-evicted)",
+            registry=r,
+        )
+        self.table_capacity = Gauge(
+            "gubernator_tpu_table_capacity",
+            "Total table slots (buckets x slots-per-bucket)",
+            registry=r,
+        )
+        self.table_load_factor = Gauge(
+            "gubernator_tpu_table_load_factor",
+            "live_keys / capacity — eviction pressure precursor (buckets "
+            "degrade past ~0.6)",
+            registry=r,
+        )
+        self.table_over_fraction = Gauge(
+            "gubernator_tpu_table_over_fraction",
+            "Fraction of live keys whose stored status is OVER_LIMIT",
+            registry=r,
+        )
+        self.table_bucket_occupancy = Gauge(
+            "gubernator_tpu_table_bucket_occupancy",
+            "Buckets holding exactly `slots` live entries (collision "
+            "pressure: mass at slots=8 predicts unexpired_evictions)",
+            ["slots"],  # "0".."8"
+            registry=r,
+        )
+        self.table_probe_depth = Gauge(
+            "gubernator_tpu_table_probe_depth",
+            "Live keys by their bucket's occupancy (a lookup gathers the "
+            "whole bucket row — depth is the key's collision exposure)",
+            ["depth"],  # "1".."8"
+            registry=r,
+        )
+        self.table_block_fill = Gauge(
+            "gubernator_tpu_table_block_fill",
+            "Sweep-block fill-fraction histogram (64-bucket blocks, decile "
+            "bins) — hot-block skew the sparse write kernel sees",
+            ["decile"],  # "0".."9"
+            registry=r,
+        )
+        self.table_ttl_horizon = Gauge(
+            "gubernator_tpu_table_ttl_horizon",
+            "Live keys expiring within the horizon (cumulative; le in "
+            "seconds) — how much of the table frees itself soon",
+            ["le"],
+            registry=r,
+        )
+        self.table_remaining_frac = Gauge(
+            "gubernator_tpu_table_remaining_frac",
+            "Live keys with remaining/limit at or below the bound "
+            "(cumulative) — admission headroom distribution",
+            ["le"],
+            registry=r,
+        )
+        self.table_scan_duration = Histogram(
+            "gubernator_tpu_table_scan_duration",
+            "Seconds per background telemetry scan (launch to decoded "
+            "snapshot; the scan overlaps serving dispatches)",
+            registry=r,
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
+        )
+        # --- GLOBAL convergence lag (docs/observability.md): age of the
+        # oldest un-synced GLOBAL hit across the cross-daemon queue
+        # (service/global_manager.py) and the mesh outbox
+        # (parallel/global_sync.PendingHits) — the signal the multi-region
+        # reconcile roadmap item is judged on. 0 = nothing pending.
+        self.global_sync_staleness = Gauge(
+            "gubernator_global_sync_staleness_seconds",
+            "Age in seconds of the oldest GLOBAL hit not yet synced to its "
+            "owner (cross-daemon queue and mesh outbox)",
+            registry=r,
+        )
+        # OTLP exporter health (satellite: export failures were attributes
+        # nobody could scrape)
+        r.register(_OtelSpanCollector())
 
     def observe_engine(self, stats) -> None:
         """Refresh counter families from an EngineStats snapshot (engine
@@ -371,8 +499,38 @@ class DaemonMetrics:
             queued=gs.hits_queued,
         )
 
-    def render(self) -> bytes:
-        """Prometheus text exposition (the /metrics body)."""
+    def observe_table(self, snap) -> None:
+        """Publish one table-telemetry snapshot (ops/telemetry.TableSnapshot)
+        into the gubernator_tpu_table_* families. Snapshot semantics: every
+        series is overwritten; a shrinking table shrinks its gauges."""
+        from gubernator_tpu.ops.telemetry import REMAIN_EDGES, TTL_EDGES_MS
+
+        self.table_live_keys.set(snap.live_keys)
+        self.table_occupied_slots.set(snap.occupied_slots)
+        self.table_capacity.set(snap.capacity)
+        self.table_load_factor.set(snap.load_factor)
+        self.table_over_fraction.set(snap.over_fraction)
+        for j, v in enumerate(snap.bucket_occupancy):
+            self.table_bucket_occupancy.labels(slots=str(j)).set(v)
+        for j, v in enumerate(snap.probe_depth, start=1):
+            self.table_probe_depth.labels(depth=str(j)).set(v)
+        for j, v in enumerate(snap.block_fill):
+            self.table_block_fill.labels(decile=str(j)).set(v)
+        for e, v in zip(TTL_EDGES_MS, snap.ttl_horizon):
+            self.table_ttl_horizon.labels(le=str(e // 1000)).set(v)
+        self.table_ttl_horizon.labels(le="+Inf").set(snap.live_keys)
+        for e, v in zip(REMAIN_EDGES, snap.remaining_frac):
+            self.table_remaining_frac.labels(le=str(e)).set(v)
+        self.table_remaining_frac.labels(le="+Inf").set(snap.live_keys)
+        self.table_scan_duration.observe(snap.scan_ms / 1e3)
+
+    def render(self, openmetrics: bool = False) -> bytes:
+        """Prometheus exposition (the /metrics body). `openmetrics=True`
+        emits the OpenMetrics format — the one that carries the exemplars
+        (trace_ids on latency buckets); scrapers ask for it via the Accept
+        header (service/server.py negotiates)."""
+        if openmetrics:
+            return om_exposition.generate_latest(self.registry)
         return generate_latest(self.registry)
 
 

@@ -17,8 +17,17 @@ from typing import Optional
 
 import numpy as np
 
+from gubernator_tpu import tracing
 from gubernator_tpu.ops.batch import RequestColumns, ResponseColumns
 from gubernator_tpu.ops.engine import LocalEngine
+
+
+def _exemplar(span) -> Optional[dict]:
+    """OpenMetrics exemplar payload for a stage observation: the dispatch
+    span's trace_id, so a p99 bucket is one click from its trace. None when
+    the dispatch is untraced (no exporter) — prometheus_client treats None
+    as no-exemplar."""
+    return {"trace_id": span.trace_id} if span is not None else None
 
 
 class EngineRunner:
@@ -48,9 +57,12 @@ class EngineRunner:
         self._prep = ThreadPoolExecutor(
             max_workers=max(2, fetch_workers // 2), thread_name_prefix="prep"
         )
+        # background telemetry fetches get their OWN single thread (lazy):
+        # a table scan parked on a fetch worker would steal a pipeline slot
+        self._telemetry: Optional[ThreadPoolExecutor] = None
 
     async def check(
-        self, cols: RequestColumns, now_ms: Optional[int] = None
+        self, cols: RequestColumns, now_ms: Optional[int] = None, span=None
     ) -> ResponseColumns:
         """Pipelined check when the engine supports the prepare/issue/finish
         split, else the serial path. Store-configured engines stay serial:
@@ -60,7 +72,12 @@ class EngineRunner:
         veto per batch via `can_pipeline(cols)`; engines whose batches need
         a custom split (the mesh-global engine's replica/owner fork) provide
         their own pending type through the prepare_columns/issue_pending/
-        finish_pending hooks instead of vetoing."""
+        finish_pending hooks instead of vetoing.
+
+        `span` is the batcher's dispatch SpanContext: each pipeline stage
+        emits a child span under it (and stage_duration exemplars carry its
+        trace_id), so a coalesced flush decomposes per-stage in the trace
+        view."""
         can = getattr(self.engine, "can_pipeline", None)
         if (
             not getattr(self.engine, "supports_pipeline", False)
@@ -75,17 +92,17 @@ class EngineRunner:
         def prepare():
             t0 = time.perf_counter()
             prepared = prepare_check_columns(self.engine, cols, now_ms=now_ms)
+            self._observe_stage("put", t0, span)
             if self.metrics is not None:
-                self.metrics.stage_duration.labels(stage="put").observe(
-                    time.perf_counter() - t0
-                )
                 self._observe_shard_stages()
             return prepared
 
         prepared = await loop.run_in_executor(self._prep, prepare)
-        return await self._issue_and_finish(prepared)
+        return await self._issue_and_finish(prepared, span=span)
 
-    async def check_wire(self, parts, now_ms=None) -> Optional[ResponseColumns]:
+    async def check_wire(
+        self, parts, now_ms=None, span=None
+    ) -> Optional[ResponseColumns]:
         """Fused front-door check: pre-parsed WireBatch pieces
         (service/wire.py — native-parser lanes) staged straight into ONE
         compact ingress grid, no column concat and no HostBatch pack.
@@ -106,18 +123,33 @@ class EngineRunner:
         def prepare():
             t0 = time.perf_counter()
             prepared = prepare_check_wire(engine, parts, now_ms=now_ms)
-            if prepared is not None and self.metrics is not None:
-                self.metrics.stage_duration.labels(stage="put").observe(
-                    time.perf_counter() - t0
-                )
+            if prepared is not None:
+                self._observe_stage("put", t0, span)
             return prepared
 
         prepared = await loop.run_in_executor(self._prep, prepare)
         if prepared is None:
             return None
-        return await self._issue_and_finish(prepared)
+        return await self._issue_and_finish(prepared, span=span)
 
-    async def _issue_and_finish(self, prepared) -> ResponseColumns:
+    def _observe_stage(self, stage: str, t0: float, span) -> None:
+        """One pipeline-stage observation: histogram sample (with the
+        dispatch trace_id as its OpenMetrics exemplar) plus a child span
+        under the dispatch span. Wall-clock ns for the span are derived
+        from the same perf_counter interval the histogram measured."""
+        dt = time.perf_counter() - t0
+        if self.metrics is not None:
+            self.metrics.stage_duration.labels(stage=stage).observe(
+                dt, exemplar=_exemplar(span)
+            )
+        if span is not None and tracing.exporter is not None:
+            end_ns = time.time_ns()
+            tracing.record_span(
+                stage, tracing.new_span(span), span.span_id,
+                end_ns - int(dt * 1e9), end_ns,
+            )
+
+    async def _issue_and_finish(self, prepared, span=None) -> ResponseColumns:
         """Shared issue/finish halves of the pipelined dispatch: ISSUE on
         the engine thread (enqueue kernel launches, no fetch), FINISH on a
         fetch worker (materialize outputs, rare fixups back on the engine
@@ -132,10 +164,7 @@ class EngineRunner:
         def issue(prepared):
             t0 = time.perf_counter()
             pending = issue_check_columns(self.engine, prepared)
-            if self.metrics is not None:
-                self.metrics.stage_duration.labels(stage="issue").observe(
-                    time.perf_counter() - t0
-                )
+            self._observe_stage("issue", t0, span)
             return pending
 
         def fixup(fn):
@@ -147,10 +176,7 @@ class EngineRunner:
         def finish(pending):
             t0 = time.perf_counter()
             rc, delta = finish_check_columns(self.engine, pending, fixup)
-            if self.metrics is not None:
-                self.metrics.stage_duration.labels(stage="fetch").observe(
-                    time.perf_counter() - t0
-                )
+            self._observe_stage("fetch", t0, span)
 
             def apply():
                 self.engine.stats.merge(delta)
@@ -252,6 +278,29 @@ class EngineRunner:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(self._exec, self.engine.live_count)
 
+    async def table_telemetry(self, now_ms: Optional[int] = None):
+        """One background table-telemetry scan (ops/telemetry.py), split
+        like a serving dispatch: the LAUNCH runs on the engine thread (the
+        scan must read a coherent table — every mutation is single-writer
+        there, and the enqueue costs microseconds), the FETCH runs on a
+        dedicated telemetry thread so the device streams the table WHILE
+        the engine thread keeps issuing serving dispatches. The scan is
+        never on the serving path; its only engine-thread cost is the
+        launch."""
+        from gubernator_tpu.ops.telemetry import finish_scan
+
+        loop = asyncio.get_running_loop()
+        if self._telemetry is None:
+            self._telemetry = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="telemetry"
+            )
+        pending = await loop.run_in_executor(
+            self._exec, lambda: self.engine.telemetry_begin(now_ms)
+        )
+        return await loop.run_in_executor(
+            self._telemetry, lambda: finish_scan(pending)
+        )
+
     async def snapshot(self) -> np.ndarray:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(self._exec, self.engine.snapshot)
@@ -291,6 +340,8 @@ class EngineRunner:
         return self._exec.submit(self.engine.snapshot).result()
 
     def close(self) -> None:
+        if self._telemetry is not None:
+            self._telemetry.shutdown(wait=True)
         self._prep.shutdown(wait=True)
         self._fetch.shutdown(wait=True)
         self._exec.shutdown(wait=True)

@@ -16,12 +16,16 @@ import grpc
 from aiohttp import web
 from google.protobuf import json_format
 
+from gubernator_tpu import tracing
 from gubernator_tpu.proto import gubernator_pb2 as pb
 from gubernator_tpu.proto import handoff_pb2 as handoff_pb
 from gubernator_tpu.proto import peers_pb2 as peers_pb
 
 V1 = "pb.gubernator.V1"
 PEERS_V1 = "pb.gubernator.PeersV1"
+
+# OpenMetrics exposition content type (the format that carries exemplars)
+OPENMETRICS_CT = "application/openmetrics-text"
 
 
 def _timed(metrics, method):
@@ -38,8 +42,15 @@ def _timed(metrics, method):
                 metrics.grpc_request_counts.labels(
                     method=method, status=status
                 ).inc()
+                # the handler's request scope has already closed; its span
+                # is this context's last-ended — the request-duration bucket
+                # carries the request's trace_id as its exemplar
+                span = tracing.last_ended_span()
                 metrics.grpc_request_duration.labels(method=method).observe(
-                    time.perf_counter() - t0
+                    time.perf_counter() - t0,
+                    exemplar=(
+                        {"trace_id": span.trace_id} if span is not None else None
+                    ),
                 )
 
         return run
@@ -171,10 +182,49 @@ def build_http_app(daemon, status_only: bool = False) -> web.Application:
 
     async def metrics(request: web.Request) -> web.Response:
         daemon.metrics.cache_size.set(await daemon.runner.live_count())
+        daemon.metrics.global_sync_staleness.set(
+            daemon.global_sync_staleness_s()
+        )
+        # content negotiation: scrapers that Accept the OpenMetrics format
+        # get it (WITH the trace exemplars on latency buckets); everyone
+        # else keeps the classic text exposition
+        if OPENMETRICS_CT in request.headers.get("Accept", ""):
+            return web.Response(
+                body=daemon.metrics.render(openmetrics=True),
+                headers={
+                    "Content-Type": f"{OPENMETRICS_CT}; version=1.0.0; "
+                    "charset=utf-8"
+                },
+            )
         return web.Response(
             body=daemon.metrics.render(),
             content_type="text/plain",
             charset="utf-8",
+        )
+
+    async def debug(request: web.Request) -> web.Response:
+        """/v1/debug/{table,pipeline,peers,global}: live JSON snapshots of
+        the planes the scrape-and-assert metrics model cannot show
+        (docs/observability.md)."""
+        kind = request.match_info["kind"]
+        try:
+            if kind == "table":
+                return web.json_response(await daemon.debug_table())
+            if kind == "pipeline":
+                return web.json_response(daemon.debug_pipeline())
+            if kind == "peers":
+                return web.json_response(daemon.debug_peers())
+            if kind == "global":
+                return web.json_response(daemon.debug_global())
+        except Exception as exc:  # pragma: no cover - defensive
+            return web.json_response(
+                {"code": 13, "message": f"debug snapshot failed: {exc}"},
+                status=500,
+            )
+        return web.json_response(
+            {"code": 5, "message": f"unknown debug plane {kind!r}; one of: "
+             "table, pipeline, peers, global"},
+            status=404,
         )
 
     app = web.Application()
@@ -185,6 +235,10 @@ def build_http_app(daemon, status_only: bool = False) -> web.Application:
     app.router.add_get("/v1/LiveCheck", live)
     app.router.add_post("/v1/LiveCheck", live)
     app.router.add_get("/metrics", metrics)
+    if daemon.conf.debug_endpoints:
+        # the debug plane rides the status listener too: it is exactly what
+        # an operator probes when the serving listener is the thing broken
+        app.router.add_get("/v1/debug/{kind}", debug)
     return app
 
 

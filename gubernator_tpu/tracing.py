@@ -13,8 +13,9 @@ from __future__ import annotations
 
 import contextvars
 import secrets
+import threading
 from dataclasses import dataclass
-from typing import Callable, Mapping, Optional
+from typing import Callable, Dict, List, Mapping, Optional
 
 TRACEPARENT_KEY = "traceparent"
 _FLAG_SAMPLED = 0x01
@@ -34,13 +35,26 @@ _current: contextvars.ContextVar[Optional[SpanContext]] = contextvars.ContextVar
     "gubernator_tpu_span", default=None
 )
 
+# the most recently ENDED scope's span in this context: transport-layer
+# metrics (grpc_request_duration) observe AFTER the handler's scope closed,
+# so this is how a request-duration bucket gets the request's trace_id as
+# its OpenMetrics exemplar
+_last_ended: contextvars.ContextVar[Optional[SpanContext]] = (
+    contextvars.ContextVar("gubernator_tpu_last_span", default=None)
+)
+
+
+def last_ended_span() -> Optional[SpanContext]:
+    return _last_ended.get()
+
 # embedder hook: called with (name, SpanContext) whenever a scope starts;
 # wire this to a real tracer (OTEL etc.) if you have one
 span_hook: Optional[Callable[[str, SpanContext], None]] = None
 
 # optional exporter: an object with record(name, span, parent_span_id,
-# start_ns, end_ns); end_scope feeds it finished spans. Wired by the daemon
-# from the standard OTEL_* envs (gubernator_tpu.otel.OTLPJsonExporter).
+# start_ns, end_ns, *, attributes=None, links=(), kind=...); end_scope and
+# record_span feed it finished spans. Wired by the daemon from the standard
+# OTEL_* envs (gubernator_tpu.otel.OTLPJsonExporter).
 exporter = None
 
 
@@ -59,6 +73,60 @@ class Scope:
     span: SpanContext
     parent_span_id: str
     start_ns: int
+    attributes: Optional[dict] = None
+
+
+# ---------------------------------------------------------------- span links
+# Batching breaks parent-child causality: a request span cannot parent the
+# dispatch span that served it (one dispatch serves many requests, and it
+# outlives none of them cleanly). OTLP span LINKS restore the edge — the
+# batcher registers "request span → dispatch span" links here while the
+# request scope is still open, and end_scope attaches them to the finished
+# span. Bounded: an abandoned scope (exceptions, exporter off) must not leak.
+_links_lock = threading.Lock()
+_pending_links: "Dict[str, List[SpanContext]]" = {}
+_MAX_LINK_SPANS = 4096  # open spans tracked
+_MAX_LINKS_PER_SPAN = 16  # a request split across local/global/forward rows
+
+
+def add_span_link(span: Optional[SpanContext], target: Optional[SpanContext]) -> None:
+    """Register a link from `span` (whose scope is still open — e.g. the
+    request scope awaiting its batch slice) to `target` (e.g. the dispatch
+    span that served it). Attached when the span's scope ends."""
+    if span is None or target is None:
+        return
+    with _links_lock:
+        lst = _pending_links.setdefault(span.span_id, [])
+        if len(lst) < _MAX_LINKS_PER_SPAN:
+            lst.append(target)
+        while len(_pending_links) > _MAX_LINK_SPANS:
+            _pending_links.pop(next(iter(_pending_links)))
+
+
+def take_span_links(span_id: str) -> List[SpanContext]:
+    with _links_lock:
+        return _pending_links.pop(span_id, [])
+
+
+def record_span(
+    name: str,
+    span: SpanContext,
+    parent_span_id: str,
+    start_ns: int,
+    end_ns: int,
+    attributes: Optional[dict] = None,
+    links=(),
+    kind: int = 1,
+) -> None:
+    """Emit one already-finished span straight to the exporter — the scope
+    machinery (contextvar set/reset) is wrong for spans whose lifetime
+    crosses threads and requests, like a batcher flush and its pipeline
+    stage children. No-op without an exporter or when sampled out."""
+    if exporter is not None and span.flags & 0x01:
+        exporter.record(
+            name, span, parent_span_id, start_ns, end_ns,
+            attributes=attributes, links=links, kind=kind,
+        )
 
 
 def current_span() -> Optional[SpanContext]:
@@ -97,6 +165,10 @@ def start_scope(name: str, parent: Optional[SpanContext] = None):
 def end_scope(scope) -> None:
     if isinstance(scope, Scope):
         _current.reset(scope.token)
+        _last_ended.set(scope.span)
+        # pop pending links unconditionally — an unsampled or unexported
+        # scope must not strand registry entries
+        links = take_span_links(scope.span.span_id)
         # honor the W3C sampled flag: traces sampled out upstream
         # (traceparent ...-00) must not produce orphan partial traces here
         if exporter is not None and scope.span.flags & 0x01:
@@ -105,6 +177,7 @@ def end_scope(scope) -> None:
             exporter.record(
                 scope.name, scope.span, scope.parent_span_id,
                 scope.start_ns, time.time_ns(),
+                attributes=scope.attributes, links=links,
             )
     else:  # raw contextvars token (embedders on the old surface)
         _current.reset(scope)

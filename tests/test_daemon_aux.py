@@ -607,9 +607,9 @@ async def test_coalesce_limit_caps_dispatch_size():
     sizes = []
     orig = runner.check  # the batcher's (pipelined) entry point
 
-    async def spy(cols, now_ms=None):
+    async def spy(cols, now_ms=None, span=None):
         sizes.append(cols.fp.shape[0])
-        return await orig(cols, now_ms=now_ms)
+        return await orig(cols, now_ms=now_ms, span=span)
 
     runner.check = spy
     b = Batcher(runner, batch_wait_ms=5.0, coalesce_limit=32)

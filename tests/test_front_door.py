@@ -257,10 +257,10 @@ class StubRunner:
         self.gate: "asyncio.Event | None" = None
         self.dispatch_rows = []
 
-    async def check_wire(self, parts):
+    async def check_wire(self, parts, span=None):
         return None  # force the columns path
 
-    async def check(self, cols, now_ms=None):
+    async def check(self, cols, now_ms=None, span=None):
         self.dispatch_rows.append(cols.fp.shape[0])
         if self.gate is not None and len(self.dispatch_rows) == 1:
             await self.gate.wait()
@@ -357,7 +357,7 @@ async def test_queue_gauge_set_once_per_flush():
                 def labels(self, **kw):
                     return self
 
-                def observe(self, v):
+                def observe(self, v, exemplar=None):
                     pass
 
                 def inc(self, v=1):

@@ -1,0 +1,532 @@
+"""Observability-layer tests (ISSUE 7): device-side table telemetry parity
+vs the host oracle (local + 8-dev CPU mesh), OpenMetrics exemplars whose
+trace_ids resolve to dispatch spans, span links across a coalesced flush,
+the /v1/debug/* JSON plane, and GLOBAL sync-staleness monotonicity."""
+
+import asyncio
+import functools
+
+import numpy as np
+import pytest
+
+from gubernator_tpu import tracing
+from gubernator_tpu.client import V1Client
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.ops.batch import RequestColumns
+from gubernator_tpu.ops.engine import LocalEngine
+from gubernator_tpu.ops.telemetry import (
+    REMAIN_EDGES,
+    TTL_EDGES_MS,
+    finish_scan,
+    host_telemetry,
+)
+from gubernator_tpu.types import RateLimitRequest
+
+from tests.cluster import daemon_config
+
+NOW = 1_700_000_000_000
+
+PARITY_FIELDS = (
+    "live_keys", "occupied_slots", "over_keys", "bucket_occupancy",
+    "ttl_horizon", "remaining_frac", "block_fill",
+)
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **k):
+        asyncio.run(fn(*a, **k))
+
+    return wrapper
+
+
+def _mixed_cols(rng, n):
+    """Traffic that exercises every telemetry dimension: token+leaky, tight
+    limits (depleted + OVER keys), short durations (expired slots at a later
+    scan now), and spread TTL horizons."""
+    fp = np.unique(rng.integers(1, (1 << 63) - 1, size=2 * n,
+                                dtype=np.int64))[:n]
+    return RequestColumns(
+        fp=fp,
+        algo=(np.arange(n) % 2).astype(np.int32),
+        behavior=np.zeros(n, dtype=np.int32),
+        hits=rng.integers(0, 5, n).astype(np.int64),
+        limit=rng.integers(1, 10, n).astype(np.int64),
+        burst=np.zeros(n, dtype=np.int64),
+        duration=rng.choice(
+            [500, 30_000, 120_000, 7_200_000, 172_800_000], n
+        ).astype(np.int64),
+        created_at=np.full(n, NOW, dtype=np.int64),
+        err=np.zeros(n, dtype=np.int8),
+    )
+
+
+class StubExporter:
+    """In-memory tracing exporter: records what the OTLP one would POST."""
+
+    def __init__(self):
+        self.spans = []
+        self.exported = 3
+        self.dropped = 1
+        self.export_errors = 0
+
+    def record(self, name, span, parent_span_id, start_ns, end_ns,
+               attributes=None, links=(), kind=2):
+        self.spans.append({
+            "name": name, "trace_id": span.trace_id, "span_id": span.span_id,
+            "parent": parent_span_id, "start": start_ns, "end": end_ns,
+            "attributes": dict(attributes or {}), "links": list(links),
+            "kind": kind,
+        })
+
+    def flush(self):
+        pass
+
+
+# ---------------------------------------------------------------- telemetry
+
+
+def test_telemetry_scan_matches_host_oracle_local():
+    eng = LocalEngine(capacity=4096, write_mode="xla")
+    rng = np.random.default_rng(11)
+    eng.check_columns(_mixed_cols(rng, 3000), now_ms=NOW)
+    # drive a couple of keys to exact depletion so stored OVER status exists
+    hot = RequestColumns(
+        fp=np.asarray([12345], dtype=np.int64),
+        algo=np.zeros(1, np.int32), behavior=np.zeros(1, np.int32),
+        hits=np.asarray([3], np.int64), limit=np.asarray([3], np.int64),
+        burst=np.zeros(1, np.int64), duration=np.asarray([60_000], np.int64),
+        created_at=np.full(1, NOW, np.int64), err=np.zeros(1, np.int8),
+    )
+    eng.check_columns(hot, now_ms=NOW)  # depletes to remaining=0
+    # a hit against a depleted key is what sticks stored status = OVER
+    eng.check_columns(hot._replace(hits=np.asarray([1], np.int64)),
+                      now_ms=NOW)
+    later = NOW + 2_000  # the 500 ms-duration cohort is expired by now
+    snap = finish_scan(eng.telemetry_begin(later))
+    oracle = host_telemetry(np.asarray(eng.table.rows), later)
+    for f in PARITY_FIELDS:
+        assert getattr(snap, f) == getattr(oracle, f), f
+    # structural invariants the dashboards rely on
+    assert snap.over_keys >= 1  # the depleted key
+    assert snap.occupied_slots > snap.live_keys  # expired cohort visible
+    assert sum(snap.bucket_occupancy) == snap.n_buckets
+    assert sum(snap.probe_depth) == snap.live_keys
+    assert sum(snap.block_fill) == snap.n_buckets // min(64, snap.n_buckets) \
+        or sum(snap.block_fill) > 0
+    assert snap.ttl_horizon == sorted(snap.ttl_horizon)  # cumulative
+    assert snap.remaining_frac == sorted(snap.remaining_frac)
+    assert snap.ttl_horizon[-1] <= snap.live_keys
+    assert len(snap.ttl_horizon) == len(TTL_EDGES_MS)
+    assert len(snap.remaining_frac) == len(REMAIN_EDGES)
+
+
+def test_telemetry_scan_matches_host_oracle_sharded():
+    from gubernator_tpu.parallel import make_mesh
+    from gubernator_tpu.parallel.sharded import ShardedEngine
+
+    eng = ShardedEngine(make_mesh(8), capacity_per_shard=1 << 10,
+                        write_mode="xla")
+    rng = np.random.default_rng(13)
+    eng.check_columns(_mixed_cols(rng, 4000), now_ms=NOW)
+    later = NOW + 2_000
+    snap = finish_scan(eng.telemetry_begin(later))
+    oracle = host_telemetry(np.asarray(eng.table.rows), later)
+    for f in PARITY_FIELDS:
+        assert getattr(snap, f) == getattr(oracle, f), f
+    # the mesh variant additionally reports per-shard live counts
+    assert snap.per_shard_live is not None and len(snap.per_shard_live) == 8
+    assert sum(snap.per_shard_live) == snap.live_keys
+    assert snap.capacity == 8 * (1 << 10)
+
+
+@async_test
+async def test_daemon_telemetry_loop_populates_metrics():
+    from gubernator_tpu.service.daemon import Daemon
+    from gubernator_tpu.service.metrics import parse_metrics
+
+    conf = daemon_config(telemetry_interval_ms=100.0)
+    d = await Daemon.spawn(conf)
+    client = V1Client(d.conf.grpc_address)
+    try:
+        await client.get_rate_limits([
+            RateLimitRequest(name="tm", unique_key=f"k{i}", hits=1,
+                             limit=100, duration=60_000)
+            for i in range(64)
+        ])
+        for _ in range(50):
+            await asyncio.sleep(0.1)
+            if d._table_telemetry is not None:
+                break
+        assert d._table_telemetry is not None, "telemetry loop never ticked"
+        scraped = parse_metrics(d.metrics.render().decode())
+        assert scraped["gubernator_tpu_table_live_keys"][()] == 64
+        assert scraped["gubernator_tpu_table_capacity"][()] >= 8192
+        occ = scraped["gubernator_tpu_table_bucket_occupancy"]
+        assert sum(occ.values()) == d._table_telemetry.n_buckets
+        # snapshot histograms carry an explicit +Inf bound = live keys
+        assert scraped["gubernator_tpu_table_ttl_horizon"][
+            (("le", "+Inf"),)
+        ] == 64
+        assert scraped["gubernator_tpu_table_scan_duration_count"][()] >= 1
+        # the exporter-health satellites render (zeros without an exporter)
+        assert "gubernator_otel_spans_exported_total" in scraped
+        assert "gubernator_global_sync_staleness_seconds" in scraped
+    finally:
+        await client.close()
+        await d.close()
+
+
+# ------------------------------------------------- exemplars + span links
+
+
+@async_test
+async def test_stage_exemplars_resolve_to_dispatch_spans():
+    """A scraped stage_duration bucket must carry an OpenMetrics exemplar
+    whose trace_id resolves to a recorded `dispatch` span holding ≥1 request
+    span link (the acceptance criterion's exact chain)."""
+    from prometheus_client.openmetrics.parser import (
+        text_string_to_metric_families,
+    )
+
+    from gubernator_tpu.service.daemon import Daemon
+
+    exp = StubExporter()
+    old = tracing.exporter
+    tracing.set_exporter(exp)
+    d = await Daemon.spawn(daemon_config())
+    client = V1Client(d.conf.grpc_address)
+    try:
+        reqs = [
+            RateLimitRequest(name="ex", unique_key=f"k{i}", hits=1,
+                             limit=100, duration=60_000)
+            for i in range(32)
+        ]
+        await asyncio.gather(*(client.get_rate_limits(reqs)
+                               for _ in range(4)))
+        text = d.metrics.render(openmetrics=True).decode()
+        exemplars = {}  # metric name -> [trace_id]
+        for fam in text_string_to_metric_families(text):
+            for s in fam.samples:
+                if s.exemplar is not None:
+                    exemplars.setdefault(s.name, []).append(
+                        s.exemplar.labels["trace_id"]
+                    )
+        # stage buckets AND the (Summary→Histogram satellite) request plane
+        assert any(k.startswith("gubernator_tpu_stage_duration_bucket")
+                   for k in exemplars), exemplars.keys()
+        assert any(
+            k.startswith("gubernator_grpc_request_duration_bucket")
+            for k in exemplars
+        ), exemplars.keys()
+        for tid in {t for v in exemplars.values() for t in v}:
+            assert len(tid) == 32 and int(tid, 16)  # valid W3C trace id
+        dispatches = {s["trace_id"]: s for s in exp.spans
+                      if s["name"] == "dispatch"}
+        assert dispatches, "no dispatch spans recorded"
+        stage_tids = [
+            t for k, v in exemplars.items()
+            if k.startswith("gubernator_tpu_stage_duration_bucket")
+            for t in v
+        ]
+        resolved = [dispatches[t] for t in stage_tids if t in dispatches]
+        assert resolved, (stage_tids, list(dispatches))
+        assert any(len(sp["links"]) >= 1 for sp in resolved)
+        assert resolved[0]["attributes"]["batch.rows"] >= 32
+        # stage child spans hang under the dispatch span
+        stages = {s["name"] for s in exp.spans
+                  if s["parent"] and s["trace_id"] in dispatches}
+        assert {"queue", "put", "issue", "fetch"} <= stages
+    finally:
+        tracing.set_exporter(old)
+        await client.close()
+        await d.close()
+
+
+@async_test
+async def test_request_spans_link_to_shared_dispatch_span():
+    """Requests coalesced into ONE flush each carry a link to the SAME
+    dispatch span — the causality edge batching otherwise erases."""
+    from gubernator_tpu.service.daemon import Daemon
+
+    exp = StubExporter()
+    old = tracing.exporter
+    tracing.set_exporter(exp)
+    # non-adaptive 50 ms window: concurrent requests land in one flush
+    conf = daemon_config()
+    conf.behaviors = BehaviorConfig(
+        batch_wait_ms=50.0, adaptive_batch=False,
+        batch_timeout_ms=5000.0, global_timeout_ms=5000.0,
+    )
+    d = await Daemon.spawn(conf)
+    try:
+        async def one(i):
+            trace = f"{i:02d}" * 16
+            await d.get_rate_limits([
+                __import__("gubernator_tpu.proto.gubernator_pb2",
+                           fromlist=["x"]).RateLimitReq(
+                    name="ln", unique_key=f"k{i}", hits=1, limit=100,
+                    duration=60_000,
+                    metadata={"traceparent": f"00-{trace}-{'ab' * 8}-01"},
+                )
+            ])
+            return trace
+
+        traces = await asyncio.gather(*(one(i) for i in range(1, 5)))
+        req_spans = [s for s in exp.spans if s["name"] == "GetRateLimits"
+                     and s["trace_id"] in traces]
+        assert len(req_spans) == 4
+        linked_dispatches = [s["links"][0].span_id for s in req_spans
+                             if s["links"]]
+        assert linked_dispatches, "no request span carried a dispatch link"
+        # at least two requests shared one flush → same dispatch span id
+        assert any(linked_dispatches.count(x) >= 2
+                   for x in set(linked_dispatches)), linked_dispatches
+        # and the dispatch span links back to its member request spans
+        disp = {s["span_id"]: s for s in exp.spans if s["name"] == "dispatch"}
+        shared = max(set(linked_dispatches), key=linked_dispatches.count)
+        assert len(disp[shared]["links"]) >= 2
+    finally:
+        tracing.set_exporter(old)
+        await d.close()
+
+
+# --------------------------------------------------------------- debug plane
+
+
+@async_test
+async def test_debug_endpoints_schema():
+    import aiohttp
+
+    from gubernator_tpu.service.daemon import Daemon
+
+    d = await Daemon.spawn(daemon_config(telemetry_interval_ms=0.0))
+    client = V1Client(d.conf.grpc_address)
+    try:
+        await client.get_rate_limits([
+            RateLimitRequest(name="dbg", unique_key=f"k{i}", hits=1,
+                             limit=10, duration=60_000)
+            for i in range(8)
+        ])
+        base = f"http://{d.conf.http_address}"
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/v1/debug/table") as r:
+                assert r.status == 200
+                table = await r.json()
+            async with s.get(f"{base}/v1/debug/pipeline") as r:
+                pipeline = await r.json()
+            async with s.get(f"{base}/v1/debug/peers") as r:
+                peers = await r.json()
+            async with s.get(f"{base}/v1/debug/global") as r:
+                glob = await r.json()
+            async with s.get(f"{base}/v1/debug/bogus") as r:
+                assert r.status == 404
+        # table: scans on demand when the loop is disabled
+        assert table["live_keys"] == 8
+        assert set(table) >= {
+            "capacity", "load_factor", "bucket_occupancy", "probe_depth",
+            "ttl_horizon_ms", "remaining_frac", "block_fill_deciles",
+            "over_fraction", "scan_ms",
+        }
+        b = pipeline["batcher"]
+        assert set(b) >= {
+            "pending_rows", "workers", "workers_alive", "inflight",
+            "fused_dispatches", "column_dispatches", "adaptive_closes",
+            "close_reasons",
+        }
+        assert set(b["close_reasons"]) == {"rows", "bytes", "idle", "slot"}
+        assert pipeline["engine"]["kind"] == "LocalEngine"
+        assert peers["self"] == d.conf.advertise_address
+        assert set(peers["handoff"]) >= {"enabled", "active", "rounds"}
+        assert "staleness_s" in glob and "manager" in glob
+        assert set(glob["manager"]) >= {
+            "pending_hits", "oldest_hit_age_s", "unsynced_keys",
+        }
+    finally:
+        await client.close()
+        await d.close()
+
+
+@async_test
+async def test_debug_endpoints_disabled_by_config():
+    import aiohttp
+
+    from gubernator_tpu.service.daemon import Daemon
+
+    d = await Daemon.spawn(daemon_config(debug_endpoints=False))
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                f"http://{d.conf.http_address}/v1/debug/table"
+            ) as r:
+                assert r.status == 404
+    finally:
+        await d.close()
+
+
+# ---------------------------------------------------------------- staleness
+
+
+def test_pending_hits_age_monotonic_and_cleared():
+    import time as _time
+
+    from gubernator_tpu.ops.batch import HostBatch, pack_columns
+    from gubernator_tpu.parallel.global_sync import PendingHits
+
+    rng = np.random.default_rng(3)
+    cols = _mixed_cols(rng, 8)
+    hb, _err = pack_columns(cols, NOW)
+    p = PendingHits()
+    assert p.age_s() == 0.0
+    p.merge(hb, np.arange(8), np.ones(8, dtype=np.int64),
+            np.zeros(8, dtype=np.int32))
+    a1 = p.age_s()
+    _time.sleep(0.02)
+    a2 = p.age_s()
+    assert a2 > a1 >= 0.0  # monotonic while un-drained
+    p.take(3)  # partial drain keeps the (conservative) age
+    assert p.age_s() >= a2
+    p.take(100)  # full drain clears it
+    assert p.age_s() == 0.0
+    p.merge(hb, np.arange(8), np.ones(8, dtype=np.int64),
+            np.zeros(8, dtype=np.int32))
+    assert p.age_s() < a2  # re-anchored at the new first entry
+    p.clear()
+    assert p.age_s() == 0.0
+
+
+@async_test
+async def test_global_staleness_gauge_under_paused_sync():
+    """With the sync loop effectively paused (huge GlobalSyncWait), queued
+    GLOBAL hits age monotonically and the gauge reports it; a drained queue
+    reads 0."""
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.service.daemon import Daemon
+    from gubernator_tpu.service.metrics import parse_metrics
+
+    conf = daemon_config()
+    conf.behaviors = BehaviorConfig(
+        global_sync_wait_ms=600_000.0,  # paused for this test's lifetime
+        batch_timeout_ms=5000.0, global_timeout_ms=5000.0,
+    )
+    d = await Daemon.spawn(conf)
+    try:
+        assert d.global_sync_staleness_s() == 0.0
+        item = pb.RateLimitReq(name="gs", unique_key="k", hits=2, limit=10,
+                               duration=60_000)
+        d.global_manager.queue_hit("gs_k", item)
+        a1 = d.global_sync_staleness_s()
+        await asyncio.sleep(0.05)
+        a2 = d.global_sync_staleness_s()
+        assert a2 > a1 >= 0.0
+        # more hits on the SAME key do not reset the age
+        d.global_manager.queue_hit("gs_k", item)
+        assert d.global_sync_staleness_s() >= a2
+        # the /metrics render refreshes the gauge
+        import aiohttp
+
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://{d.conf.http_address}/metrics") as r:
+                scraped = parse_metrics(await r.text())
+        assert scraped["gubernator_global_sync_staleness_seconds"][()] >= a2
+        # a successful drain (no peers → keys dropped) zeroes it
+        await d.global_manager._send_hits()
+        assert d.global_sync_staleness_s() == 0.0
+    finally:
+        await d.close()
+
+
+# ------------------------------------------------------------ otel satellites
+
+
+def test_exporter_from_env_resource_attributes():
+    from gubernator_tpu.otel import exporter_from_env
+
+    exp = exporter_from_env({
+        "OTEL_EXPORTER_OTLP_ENDPOINT": "http://127.0.0.1:1",
+        "OTEL_SERVICE_NAME": "svc-a",
+        "OTEL_RESOURCE_ATTRIBUTES":
+            "service.name=ignored,host.name=node-3,region=us%2Deast,bad",
+    })
+    try:
+        assert exp.service_name == "svc-a"  # OTEL_SERVICE_NAME wins
+        assert exp.resource_attributes == {
+            "host.name": "node-3", "region": "us-east",
+        }
+        payload = exp._payload([{"traceId": "0" * 32, "spanId": "1" * 16,
+                                 "name": "x", "kind": 2,
+                                 "startTimeUnixNano": "1",
+                                 "endTimeUnixNano": "2"}])
+        import json
+
+        attrs = json.loads(payload)["resourceSpans"][0]["resource"][
+            "attributes"
+        ]
+        by_key = {a["key"]: a["value"] for a in attrs}
+        assert by_key["service.name"] == {"stringValue": "svc-a"}
+        assert by_key["host.name"] == {"stringValue": "node-3"}
+        assert by_key["region"] == {"stringValue": "us-east"}
+    finally:
+        exp.close()
+
+    # service.name from the resource attrs when OTEL_SERVICE_NAME is unset
+    exp2 = exporter_from_env({
+        "OTEL_EXPORTER_OTLP_ENDPOINT": "http://127.0.0.1:1",
+        "OTEL_RESOURCE_ATTRIBUTES": "service.name=from-attrs",
+    })
+    try:
+        assert exp2.service_name == "from-attrs"
+        assert "service.name" not in exp2.resource_attributes
+    finally:
+        exp2.close()
+
+
+def test_otel_span_counters_reflect_exporter():
+    from gubernator_tpu.service.metrics import DaemonMetrics, parse_metrics
+
+    exp = StubExporter()  # exported=3, dropped=1, export_errors=0
+    old = tracing.exporter
+    tracing.set_exporter(exp)
+    try:
+        m = DaemonMetrics()
+        scraped = parse_metrics(m.render().decode())
+        assert scraped["gubernator_otel_spans_exported_total"][()] == 3
+        assert scraped["gubernator_otel_spans_dropped_total"][()] == 1
+        assert scraped["gubernator_otel_spans_export_errors_total"][()] == 0
+    finally:
+        tracing.set_exporter(old)
+
+
+def test_otlp_record_carries_attributes_and_links():
+    from gubernator_tpu.otel import OTLPJsonExporter
+
+    exp = OTLPJsonExporter("http://127.0.0.1:1")
+    try:
+        parent = tracing.new_span()
+        link = tracing.new_span()
+        exp.record("dispatch", parent, "", 1, 2,
+                   attributes={"batch.rows": 42, "batch.fused": True,
+                               "note": "x"},
+                   links=[link], kind=1)
+        entry = exp._buf[-1]
+        assert entry["kind"] == 1
+        by_key = {a["key"]: a["value"] for a in entry["attributes"]}
+        assert by_key["batch.rows"] == {"intValue": "42"}
+        assert by_key["batch.fused"] == {"boolValue": True}
+        assert by_key["note"] == {"stringValue": "x"}
+        assert entry["links"] == [
+            {"traceId": link.trace_id, "spanId": link.span_id}
+        ]
+    finally:
+        exp.close()
+
+
+def test_pending_link_registry_bounded_and_popped():
+    a, b = tracing.new_span(), tracing.new_span()
+    tracing.add_span_link(a, b)
+    tracing.add_span_link(a, b)
+    assert len(tracing.take_span_links(a.span_id)) == 2
+    assert tracing.take_span_links(a.span_id) == []  # popped
+    tracing.add_span_link(None, b)  # no-ops never register
+    tracing.add_span_link(a, None)
+    assert tracing.take_span_links(a.span_id) == []
