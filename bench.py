@@ -504,6 +504,176 @@ def _pipelined_checks(eng, cols_iter, now, depth=2):
         eng.stats.merge(delta)
 
 
+def pod_scaling_case(rng, now) -> dict:
+    """Horizontal-scaling phase (pod-scale mesh tentpole): device-routed
+    decisions/s vs device count (1→2→4→8) for BOTH exchange schedules
+    (GUBER_A2A_IMPL ring vs collective, parallel/ring.py), plus an
+    exchange-only probe at each width — total wall per impl and the ring's
+    per-hop split (truncated-prefix probes expose the marginal hop cost,
+    which is where the double-buffered overlap shows: hops 2..D-1 must cost
+    well under hop 1's launch+transfer). The acceptance surface: ring
+    exchange wall no worse than the collective baseline on the widest mesh,
+    and decisions/s growing with D. Transport accounting rides the same
+    wire-bytes gate as sharded-ingress."""
+    from jax.sharding import NamedSharding
+
+    from gubernator_tpu.ops.batch import RequestColumns
+    from gubernator_tpu.parallel import make_mesh
+    from gubernator_tpu.parallel.mesh import shard_spec
+    from gubernator_tpu.parallel.ring import make_exchange_probe
+    from gubernator_tpu.parallel.a2a import pair_capacity
+    from gubernator_tpu.ops.engine import _pad_size
+    from gubernator_tpu.parallel.sharded import ShardedEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    n_all = len(jax.devices())
+    counts = [d for d in (1, 2, 4, 8) if d <= n_all]
+    if n_all not in counts:
+        counts.append(n_all)
+    batch = 1 << 15 if on_tpu else 2048
+    cap = (1 << 22) if on_tpu else (1 << 13)
+    n_disp = 24
+
+    def cols_for(fps):
+        n = fps.shape[0]
+        return RequestColumns(
+            fp=fps,
+            algo=np.zeros(n, dtype=np.int32),
+            behavior=np.zeros(n, dtype=np.int32),
+            hits=np.ones(n, dtype=np.int64),
+            limit=np.full(n, 1 << 30, dtype=np.int64),
+            burst=np.zeros(n, dtype=np.int64),
+            duration=np.full(n, 3_600_000, dtype=np.int64),
+            created_at=np.full(n, now, dtype=np.int64),
+            err=np.zeros(n, dtype=np.int8),
+        )
+
+    staged = [
+        rng.integers(1, (1 << 63) - 1, size=batch, dtype=np.int64)
+        for _ in range(4)
+    ]
+    out: dict = {
+        "batch": batch,
+        "device_counts": counts,
+        # CPU "devices" share one socket — decisions/s is flat by
+        # construction there and only the parity/overlap figures carry
+        # signal; TPU runs are where the scaling column means throughput
+        "backend": jax.default_backend(),
+        "scaling": {},
+    }
+    for D in counts:
+        mesh = make_mesh(D)
+        impls = ("collective", "ring") if D > 1 else ("collective",)
+        entry: dict = {}
+        for impl in impls:
+            eng = ShardedEngine(
+                mesh, capacity_per_shard=max(1024, cap // D),
+                route="device", dedup="device", a2a=impl,
+            )
+            _pipelined_checks(
+                eng, (cols_for(staged[i % 4]) for i in range(3)), now
+            )  # compile + seed
+            eng.take_stage_deltas()
+            eng.take_wire_deltas()
+
+            def timed(k, eng=eng):
+                t0 = time.perf_counter()
+                _pipelined_checks(
+                    eng, (cols_for(staged[i % 4]) for i in range(k)), now
+                )
+                return time.perf_counter() - t0
+
+            n_short, n_long = 2, 2 + n_disp
+            t_short = min(timed(n_short) for _ in range(3))
+            t_long = min(timed(n_long) for _ in range(3))
+            s = slope(t_short, t_long, n_short, n_long, batch, min_ratio=1.0)
+            rec: dict = {}
+            if s.reason is None:
+                rec["dispatch_ms"] = round(s.per_iter_ms, 3)
+                rec["decisions_per_sec"] = round(s.rate, 1)
+            else:
+                rec["invalid"] = s.reason
+            stage = eng.take_stage_deltas()
+            wire = eng.take_wire_deltas()
+            bad = check_transport(
+                stage["put"] / 1e3, wire["put"], label=f"pod-D{D}-{impl}-put"
+            )
+            if bad:
+                rec["transport_guard"] = bad
+            guard = check_dropped(eng.stats.dropped, eng.stats.checks or 1)
+            if guard:
+                rec["guard"] = guard
+            rec["a2a_overflow"] = eng.a2a_overflow
+            entry[impl] = rec
+            log(f"[pod-scaling:D{D}] {impl}: "
+                f"{rec.get('decisions_per_sec', rec.get('invalid'))} dec/s")
+
+        # exchange-only probe at this width's real dispatch geometry: the
+        # stage-split view of the exchange leg (per-hop ms = marginal cost
+        # of ring prefix k vs k-1; hop 1 carries the fixed launch cost)
+        if D > 1:
+            c = _pad_size(max(1, -(-batch // D)), floor=8)
+            block = (D, 12, pair_capacity(c, D))
+            x = jnp.asarray(rng.integers(
+                1, 1 << 40, size=(D,) + block, dtype=np.int64
+            ))
+            x = jax.device_put(x, NamedSharding(mesh, shard_spec(mesh)))
+
+            def wall_ms(fn, k=12):
+                fn(x).block_until_ready()
+                # block per iteration: XLA:CPU collective programs deadlock
+                # when many are dispatched concurrently
+                t0 = time.perf_counter()
+                for _ in range(k):
+                    fn(x).block_until_ready()
+                return (time.perf_counter() - t0) / k * 1e3
+
+            ring_ms = min(
+                wall_ms(make_exchange_probe(mesh, block, "ring"))
+                for _ in range(2)
+            )
+            coll_ms = min(
+                wall_ms(make_exchange_probe(mesh, block, "collective"))
+                for _ in range(2)
+            )
+            per_hop = []
+            prev = 0.0
+            for hops in range(1, D):
+                t = wall_ms(
+                    make_exchange_probe(mesh, block, "ring", hops=hops), k=6
+                )
+                per_hop.append(round(t - prev, 4))
+                prev = t
+            entry["exchange"] = {
+                "block_shape": list((D,) + block),
+                "ring_ms": round(ring_ms, 4),
+                "collective_ms": round(coll_ms, 4),
+                "ring_per_hop_ms": per_hop,
+            }
+        out["scaling"][f"D{D}"] = entry
+
+    # acceptance surface: ring exchange no worse than collective on the
+    # widest mesh (25% tolerance absorbs launch-overhead noise at CPU
+    # smoke shapes; on TPU the ring's DMA overlap is the whole point)
+    top = out["scaling"].get(f"D{max(counts)}", {})
+    ex = top.get("exchange")
+    if ex:
+        ratio = ex["ring_ms"] / max(ex["collective_ms"], 1e-9)
+        out["ring_vs_collective"] = round(ratio, 3)
+        out["ring_no_worse"] = bool(ratio <= 1.25)
+    rates = {
+        D: out["scaling"][f"D{D}"]
+        .get("ring" if D > 1 else "collective", {})
+        .get("decisions_per_sec")
+        for D in counts
+    }
+    if rates.get(counts[0]) and rates.get(max(counts)):
+        out["scaling_ratio"] = round(
+            rates[max(counts)] / rates[counts[0]], 3
+        )
+    return out
+
+
 def sharded_ingress_case(rng, now, batch=1 << 17) -> dict:
     """Sharded-vs-local dispatch with the host-stage/device split (the
     tentpole's proof surface): the mesh serving path (ShardedEngine at the
@@ -1382,6 +1552,14 @@ def main() -> None:
     matrix["sharded-ingress"] = _attempt(
         "sharded-ingress",
         lambda: sharded_ingress_case(np.random.default_rng(49), now),
+    )
+
+    # pod-scaling phase: decisions/s vs device count for both exchange
+    # schedules + the exchange-leg stage split (per-hop ring ms) — the
+    # horizontal-scaling record (docs/architecture.md "Pod-scale topology")
+    matrix["pod-scaling"] = _attempt(
+        "pod-scaling",
+        lambda: pod_scaling_case(np.random.default_rng(51), now),
     )
 
     # latency phase (sweep vs sparse vs xla device terms per table size);

@@ -616,6 +616,155 @@ def telemetry_smoke() -> dict:
     return out
 
 
+def mesh_smoke() -> dict:
+    """Pod-scale mesh regression gate on a SIMULATED 2-host mesh (the 8
+    forced-host-platform devices folded into 2 × 4 (host, device) rows):
+
+    (a) **ring/collective parity** — the hand-rolled ring schedule
+        (parallel/ring.py) must be byte-identical to the lax.all_to_all
+        oracle through real engine traffic (responses AND canonical table
+        state), duplicates included;
+    (b) **batch-proportional host staging** — the 2-D topology must not
+        re-grow per-dispatch host routing work (same bound as
+        sharded_smoke, driven on the (host, device) mesh through the ring
+        exchange);
+    (c) **hierarchical GLOBAL sync convergence** — replica answers + the
+        collective reconcile on the 2-host mesh must converge to the exact
+        per-key totals, and the inter-slice compact codec must round-trip
+        exactly (send half of the SyncGlobalsWire path)."""
+    from gubernator_tpu.parallel import make_mesh
+    from gubernator_tpu.parallel.global_sync import GlobalShardedEngine
+    from gubernator_tpu.parallel.sharded import ShardedEngine
+
+    mesh = make_mesh(8, hosts=2)
+    out: dict = {"axes": list(mesh.axis_names)}
+
+    # ---- (a) ring vs collective engine parity (byte-for-byte)
+    kw = dict(capacity_per_shard=1 << 12, write_mode="xla",
+              route="device", dedup="device")
+    ring = ShardedEngine(mesh, a2a="ring", **kw)
+    coll = ShardedEngine(mesh, a2a="collective", **kw)
+    rng = np.random.default_rng(11)
+    for step in range(3):
+        n = 1024
+        fp = rng.integers(1, (1 << 63) - 1, size=n, dtype=np.int64)
+        if step == 2:
+            fp[n // 2:] = fp[: n - n // 2]  # duplicate keys
+        c = cols(fp)
+        want = coll.check_columns(c, now_ms=NOW)
+        got = ring.check_columns(c, now_ms=NOW)
+        for f in ("status", "limit", "remaining", "reset_time", "err"):
+            if not np.array_equal(getattr(want, f), getattr(got, f)):
+                print(json.dumps({"error": f"mesh smoke: ring/collective "
+                                  f"mismatch in {f} at step {step}"}))
+                sys.exit(1)
+    if not np.array_equal(np.asarray(ring.table.rows),
+                          np.asarray(coll.table.rows)):
+        # identical dispatch order ⇒ even slot order must agree
+        print(json.dumps({"error": "mesh smoke: ring/collective table "
+                          "state diverged"}))
+        sys.exit(1)
+    out["ring_parity"] = True
+
+    # ---- (b) batch-proportional host staging on the 2-D topology
+    big, small = 4096, 512
+    fps = rng.integers(1, (1 << 63) - 1, size=big * 4, dtype=np.int64)
+    batches = {
+        n: [fps[i * n: (i + 1) * n] for i in range(4)] for n in (big, small)
+    }
+    for n in (small, big):  # compile + seed
+        for f in batches[n]:
+            ring.check_columns(cols(f), now_ms=NOW)
+
+    def stage_ms_per_dispatch(n: int, k: int = 12) -> float:
+        ring.take_stage_deltas()
+        d0 = ring.stage_dispatches
+        for i in range(k):
+            ring.check_columns(cols(batches[n][i % 4]), now_ms=NOW)
+        stage = ring.take_stage_deltas()
+        return sum(stage.values()) / max(1, ring.stage_dispatches - d0)
+
+    small_ms = min(stage_ms_per_dispatch(small) for _ in range(3))
+    big_ms = min(stage_ms_per_dispatch(big) for _ in range(3))
+    SLACK = 4.0
+    ok = big_ms <= (big / small) * SLACK * max(small_ms, 1e-4)
+    out["host_stage_small_ms"] = round(small_ms, 4)
+    out["host_stage_big_ms"] = round(big_ms, 4)
+    out["proportional"] = bool(ok)
+    if not ok:
+        print(json.dumps({"error": "mesh smoke: 2-host staging cost is "
+                          "super-linear in batch rows", **out}))
+        sys.exit(1)
+    guard = check_dropped(ring.stats.dropped, max(1, ring.stats.checks))
+    if guard:
+        print(json.dumps({"error": f"mesh smoke drop storm: {guard}", **out}))
+        sys.exit(1)
+
+    # ---- (c) hierarchical GLOBAL sync convergence on the 2-host mesh
+    geng = GlobalShardedEngine(mesh, a2a="ring", sync_out=64, **kw)
+    m = 96
+    gfp = rng.integers(1, (1 << 63) - 1, size=m, dtype=np.int64)
+    hits_total = np.zeros(m, dtype=np.int64)
+    for step in range(4):  # rotating homes: hits land on several replicas
+        h = rng.integers(1, 4, size=m).astype(np.int64)
+        hits_total += h
+        c = cols(gfp)._replace(
+            hits=h, behavior=np.full(m, 2, dtype=np.int32)  # GLOBAL
+        )
+        rc = geng.check_columns(c, now_ms=NOW)
+        if (rc.err != 0).any():
+            print(json.dumps({"error": "mesh smoke: GLOBAL serve error",
+                              **out}))
+            sys.exit(1)
+    geng.sync(now_ms=NOW)
+    if geng.has_pending():
+        print(json.dumps({"error": "mesh smoke: sync left pending hits",
+                          **out}))
+        sys.exit(1)
+    probe = cols(gfp)._replace(
+        hits=np.zeros(m, dtype=np.int64),
+        behavior=np.full(m, 2, dtype=np.int32),
+    )
+    # every rotating home's replica must answer the reconciled total
+    for _ in range(3):
+        rc = geng.check_columns(probe, now_ms=NOW)
+        want = (1 << 20) - hits_total
+        if not np.array_equal(np.asarray(rc.remaining), want):
+            print(json.dumps({"error": "mesh smoke: hierarchical GLOBAL "
+                              "sync did not converge", **out}))
+            sys.exit(1)
+    out["global_sync_rounds"] = geng.global_stats.sync_rounds
+    out["global_converged"] = True
+
+    # inter-slice codec half: lane pack → item decode must be exact
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.service.wire import sync_wire_items, sync_wire_pb
+
+    pairs = [
+        (f"ms_k{i}", pb.RateLimitReq(
+            name="ms", unique_key=f"k{i}", hits=(1 << 19) + i, limit=100,
+            duration=60_000, algorithm=i % 2, behavior=2, created_at=NOW,
+            burst=100 if i % 2 else 0,
+        ))
+        for i in range(8)
+    ]
+    req = sync_wire_pb(pairs, "ci")
+    if req is None:
+        print(json.dumps({"error": "mesh smoke: sync codec refused an "
+                          "encodable batch", **out}))
+        sys.exit(1)
+    back = sync_wire_items(req)
+    for (_k, a), b in zip(pairs, back):
+        if (a.name, a.unique_key, a.hits, a.limit, a.duration, a.algorithm,
+                a.created_at) != (b.name, b.unique_key, b.hits, b.limit,
+                                  b.duration, b.algorithm, b.created_at):
+            print(json.dumps({"error": "mesh smoke: sync codec roundtrip "
+                              "mismatch", **out}))
+            sys.exit(1)
+    out["wire_sync_codec"] = True
+    return out
+
+
 def main() -> None:
     eng = LocalEngine(capacity=1 << 15, write_mode="xla")
     rng = np.random.default_rng(0)
@@ -639,6 +788,7 @@ def main() -> None:
         "handoff_smoke": handoff_smoke(),
         "serving_smoke": serving_smoke(),
         "telemetry_smoke": telemetry_smoke(),
+        "mesh_smoke": mesh_smoke(),
     }))
 
 

@@ -149,6 +149,12 @@ class BehaviorConfig:
     global_sync_wait_ms: float = 100.0  # hit-sync cadence (GlobalSyncWait)
     global_batch_limit: int = 1000  # GlobalBatchLimit
     global_peer_concurrency: int = 100  # GlobalPeerRequestsConcurrency
+    # inter-slice GLOBAL hit batches ride the compact wire codec
+    # (SyncGlobalsWire RPC, service/wire.sync_wire_pb — 20 B/entry of
+    # numeric config + one string blob instead of nested RateLimitReq
+    # messages) when the batch is representable; off forces the classic
+    # GetPeerRateLimits proto path everywhere (the parity oracle)
+    global_wire_sync: bool = True
 
     force_global: bool = False  # reference config.go:65-66
 
@@ -222,6 +228,19 @@ class DaemonConfig:
     # "device" (in-trace aggregation — hits summed, RESET OR-ed, newest
     # config wins; O(1) host planning, kernel2.dedup_packed_cols)
     shard_dedup: str = "auto"
+    # ownership-exchange schedule for route="device" dispatches
+    # (parallel/ring.py): "auto" (ring on TPU backends, collective
+    # elsewhere) | "ring" (hand-rolled per-hop remote-DMA/ppermute
+    # schedule, double-buffered hops) | "collective" (one monolithic
+    # lax.all_to_all per direction — the parity oracle). Byte-identical
+    # results either way; GUBER_A2A_IMPL.
+    a2a_impl: str = "auto"
+    # fold the mesh's devices into this many (simulated) host rows — the
+    # 2-D (host, device) topology used by multi-host tests/CI on one
+    # machine (GUBER_MESH_HOSTS; 0 = from the runtime: process_count on a
+    # real pod slice, 1 host otherwise). Read by parallel/mesh.make_mesh
+    # through the environment, surfaced here for validation + visibility.
+    mesh_hosts: int = 0
     workers: int = 0  # 0 = auto; host-side executor width
 
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
@@ -388,6 +407,15 @@ class DaemonConfig:
                 f"GUBER_SHARD_DEDUP: must be auto, host or device, got "
                 f"{self.shard_dedup!r}"
             )
+        if self.a2a_impl not in ("auto", "ring", "collective"):
+            raise ConfigError(
+                f"GUBER_A2A_IMPL: must be auto, ring or collective, got "
+                f"{self.a2a_impl!r}"
+            )
+        if self.mesh_hosts < 0:
+            raise ConfigError(
+                "GUBER_MESH_HOSTS must be >= 0 (0 = topology from the runtime)"
+            )
         if self.cache_size <= 0:
             raise ConfigError("GUBER_CACHE_SIZE must be positive")
         if self.behaviors.batch_limit <= 0 or self.behaviors.batch_limit > 1000:
@@ -469,6 +497,8 @@ def setup_daemon_config(
         engine=_get(env, "GUBER_ENGINE", "local"),
         shard_route=_get(env, "GUBER_SHARD_ROUTE", "auto"),
         shard_dedup=_get(env, "GUBER_SHARD_DEDUP", "auto"),
+        a2a_impl=_get(env, "GUBER_A2A_IMPL", "auto"),
+        mesh_hosts=_get_int(env, "GUBER_MESH_HOSTS", 0),
         workers=_get_int(env, "GUBER_WORKER_COUNT", 0),
         behaviors=BehaviorConfig(
             batch_timeout_ms=_get_float_ms(env, "GUBER_BATCH_TIMEOUT", 500.0),
@@ -490,6 +520,7 @@ def setup_daemon_config(
             global_peer_concurrency=_get_int(
                 env, "GUBER_GLOBAL_PEER_CONCURRENCY", 100
             ),
+            global_wire_sync=_get_bool(env, "GUBER_GLOBAL_WIRE_SYNC", True),
             force_global=_get_bool(env, "GUBER_FORCE_GLOBAL", False),
             peer_breaker_errors=_get_int(env, "GUBER_PEER_BREAKER_ERRORS", 5),
             peer_breaker_backoff_base_ms=_get_float_ms(

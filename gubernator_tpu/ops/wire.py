@@ -327,6 +327,35 @@ def encode_wire_out(packed: jnp.ndarray, base) -> jnp.ndarray:
 # -------------------------------------------------------------- host decode
 
 
+def decode_wire_host(lanes: np.ndarray, base: int) -> dict:
+    """Vectorized HOST decode of a (5, n) int32 lane image (pack_wire_rows
+    layout) back to full-width int64 columns — the receive half of the
+    inter-slice GLOBAL sync codec (service/global_manager.py ships pending
+    hits as one lane image instead of n proto messages; the owner daemon
+    decodes them here before applying). The in-trace twin is
+    decode_wire_block; the two must agree field-for-field, which
+    tests/test_ring_exchange.py pins by round-tripping through both."""
+    lanes = np.asarray(lanes, dtype=np.int32)
+    l0, l1, l2, l3, l4 = (lanes[i].astype(np.int64) for i in range(WIRE_LANES))
+    fp = (l0 & 0xFFFFFFFF) | (l1 << 32)
+    dur = l3 & _DUR_MASK
+    algo = (l3 >> DUR_BITS) & 3
+    hits = l4 & _HITS_MASK
+    delta = ((l4 >> HITS_BITS) & _DELTA_MASK) - DELTA_BIAS
+    behavior = ((l4 >> 30) & 1) * _RESET | ((l4 >> 31) & 1) * _DRAIN
+    created = base + delta
+    return {
+        "fp": fp,
+        "algo": algo.astype(np.int32),
+        "behavior": behavior.astype(np.int32),
+        "hits": hits,
+        "limit": l2,
+        "duration": dur,
+        "created_at": created,
+        "active": fp != 0,
+    }
+
+
 def wire_out_base(arr: np.ndarray) -> int:
     """The base stamped into a fetched compact egress array."""
     return (int(arr[-1, 1]) & 0xFFFFFFFF) | (int(arr[-1, 2]) << 32)

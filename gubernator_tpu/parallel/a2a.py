@@ -18,9 +18,11 @@ scaling-book recipe: annotate, exchange, let ICI do the work):
     buffer — C is the per-(src,dst) capacity, mean + 5σ of the multinomial
     per-pair count; rows past a pair's capacity are marked dropped (claim
     retry re-dispatches them, the MoE "token dropping" analog);
- 3. ONE `lax.all_to_all` delivers every row to its owning device over ICI
-    (the reference's N×N gRPC forwarding mesh, peer_client.go, collapsed
-    into a collective);
+ 3. ONE exchange delivers every row to its owning device over the
+    interconnect (the reference's N×N gRPC forwarding mesh, peer_client.go,
+    collapsed into a collective) — either a monolithic `lax.all_to_all` or
+    the hand-rolled per-hop ring schedule (parallel/ring.py,
+    GUBER_A2A_IMPL), byte-identical by contract;
  4. the owner runs the decision kernel on its received (D·C) rows;
  5. a second all_to_all returns responses to each row's arrival device,
     which un-sorts them to arrival order.
@@ -36,7 +38,7 @@ import os
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from gubernator_tpu.ops.kernel2 import (
     FLAG_DROPPED,
@@ -48,7 +50,8 @@ from gubernator_tpu.ops.kernel2 import (
 )
 from gubernator_tpu.ops.engine import default_write_mode
 from gubernator_tpu.ops.table2 import Table2
-from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_map_compat, shard_of
+from gubernator_tpu.parallel.mesh import shard_map_compat, shard_of, shard_spec
+from gubernator_tpu.parallel.ring import a2a_impl, exchange
 
 i32 = jnp.int32
 i64 = jnp.int64
@@ -83,7 +86,7 @@ def pair_capacity(c: int, D: int) -> int:
 
 def make_a2a_decide(
     mesh: Mesh, c: int, math: str = "mixed", write=None, dedup: bool = False,
-    wire: bool = False,
+    wire: bool = False, impl: "str | None" = None,
 ):
     """Jitted all-shards decide with ON-DEVICE routing: (Table2[D,·],
     (D, 12, c) arrival-order grid, (D, c+2, 4) recycled egress buffer) →
@@ -111,8 +114,16 @@ def make_a2a_decide(
     HOST boundary is what the narrow layout shrinks — the decode runs
     before the exchange, so the ICI legs still move the full 12-lane rows
     (ICI bandwidth is not the bottleneck the wire budget targets) and the
-    exchange/dedup machinery below is shared byte-for-byte."""
+    exchange/dedup machinery below is shared byte-for-byte.
+
+    `impl` picks the exchange schedule (parallel/ring.py): "collective" =
+    one lax.all_to_all per direction (the seed path — and the parity
+    oracle), "ring" = the hand-rolled per-hop schedule with double-buffered
+    remote DMA on TPU / ppermute shifts elsewhere; None resolves through
+    GUBER_A2A_IMPL (auto = ring on TPU). The two produce byte-identical
+    grids — impl is a schedule knob, never a semantics one."""
     write = write or default_write_mode()
+    impl = a2a_impl(impl)
     D = int(mesh.devices.size)
     C = pair_capacity(c, D)
 
@@ -153,9 +164,7 @@ def make_a2a_decide(
         send3 = send.reshape(12, D, C).transpose(1, 0, 2)  # (D, 12, C)
 
         # ---- ICI: deliver rows to owners; leading axis src↔dst swaps
-        recv = jax.lax.all_to_all(
-            send3, SHARD_AXIS, split_axis=0, concat_axis=0
-        )  # (D, 12, C), leading = source device
+        recv = exchange(send3, mesh, impl)  # (D, 12, C), leading = source
         local = recv.transpose(1, 0, 2).reshape(12, D * C)
 
         if dedup:
@@ -173,9 +182,7 @@ def make_a2a_decide(
         stats_rows = packed[D * C :]  # (2, 4)
 
         # ---- ICI: responses ride back to each row's arrival device
-        back = jax.lax.all_to_all(
-            resp, SHARD_AXIS, split_axis=0, concat_axis=0
-        ).reshape(D * C, 4)
+        back = exchange(resp, mesh, impl).reshape(D * C, 4)
 
         # un-sort to arrival order: arrival row idx_s[p] sat in slot
         # o_s[p]*C + rank[p]
@@ -208,7 +215,7 @@ def make_a2a_decide(
         expand = lambda t: jax.tree.map(lambda x: x[None], t)
         return expand(table), packed_out[None]
 
-    spec = P(SHARD_AXIS)
+    spec = shard_spec(mesh)
     fn = shard_map_compat(
         per_device, mesh=mesh, in_specs=(spec, spec, spec),
         # check_vma=False: the Pallas sweep's out_shape carries no vma

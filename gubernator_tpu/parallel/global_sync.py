@@ -39,7 +39,7 @@ from typing import Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding
 
 from gubernator_tpu.ops.batch import (
     ERR_DROPPED,
@@ -55,7 +55,7 @@ from gubernator_tpu.ops.batch import (
 from gubernator_tpu.ops.kernel2 import decide2_impl, install2_impl
 from gubernator_tpu.ops.plan import _subset
 from gubernator_tpu.ops.table2 import Table2
-from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_map_compat, shard_of
+from gubernator_tpu.parallel.mesh import shard_axes, shard_map_compat, shard_of, shard_spec
 from gubernator_tpu.parallel.sharded import ShardedEngine, new_sharded_table
 from gubernator_tpu.types import (
     Behavior,
@@ -209,7 +209,8 @@ class GlobalStats:
     send_queue_length: int = 0
 
 
-def _sync_core(primary, replica, outbox: ReqBatch, me, D: int, write: str):
+def _sync_core(primary, replica, outbox: ReqBatch, me, D: int, write: str,
+               axes="shard"):
     """One collective sync round, per-device body (shared by the
     single-round and fused multi-round steps): exchange outboxes, owner
     applies aggregated hits, broadcast + replica install. Returns
@@ -222,7 +223,7 @@ def _sync_core(primary, replica, outbox: ReqBatch, me, D: int, write: str):
     DRAIN = int(Behavior.DRAIN_OVER_LIMIT)
 
     # ---- stage 1: exchange hit outboxes (runAsyncHits → sendHits analog)
-    gath = jax.lax.all_gather(outbox, SHARD_AXIS)  # leaves (D, OUT)
+    gath = jax.lax.all_gather(outbox, axes)  # leaves (D, OUT)
     flat = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), gath)
     N = flat.fp.shape[0]
     owner = ((flat.fp >> 32) % D).astype(jnp.int32)
@@ -271,7 +272,7 @@ def _sync_core(primary, replica, outbox: ReqBatch, me, D: int, write: str):
         burst=agg.burst,  # real config burst — richer than the wire
         stamp=agg.created_at,  # path's Burst=Limit rebuild
     )
-    bc_all = jax.lax.all_gather(bc, SHARD_AXIS)
+    bc_all = jax.lax.all_gather(bc, axes)
     bc_flat = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), bc_all)
     bc_owner = ((bc_flat.fp >> 32) % D).astype(jnp.int32)
     theirs = bc_flat.active & (bc_owner != me)
@@ -299,6 +300,7 @@ def _mk_sync_step(
     16K-entry round against ~16 ms of compute)."""
     D = n_shards
     write = write or default_write_mode()
+    axes = shard_axes(mesh)
 
     def per_device(primary, replica, outbox):
         primary = jax.tree.map(lambda x: x[0], primary)
@@ -311,9 +313,9 @@ def _mk_sync_step(
             outbox = req_from_arr(arr12)
         else:
             outbox = jax.tree.map(lambda x: x[0], outbox)
-        me = jax.lax.axis_index(SHARD_AXIS)
+        me = jax.lax.axis_index(axes)
         primary, replica, counters, bc = _sync_core(
-            primary, replica, outbox, me, D, write
+            primary, replica, outbox, me, D, write, axes=axes
         )
         expand = lambda t: jax.tree.map(lambda x: x[None], t)
         # bc (this device's owner-applied rows) returns to the host so a
@@ -321,7 +323,7 @@ def _mk_sync_step(
         # reference's OnChange fires on owner-side GLOBAL applies too
         return expand(primary), expand(replica), counters[None], expand(bc)
 
-    spec = P(SHARD_AXIS)
+    spec = shard_spec(mesh)
     fn = shard_map_compat(
         per_device,
         mesh=mesh,
@@ -349,13 +351,14 @@ def _mk_sync_step_multi(
     single-round path."""
     D = n_shards
     write = write or default_write_mode()
+    axes = shard_axes(mesh)
 
     def per_device(primary, replica, outboxes):
         primary = jax.tree.map(lambda x: x[0], primary)
         replica = jax.tree.map(lambda x: x[0], replica)
         # pytree: leaves (R, OUT); wire: ONE (R, 5, OUT+1) int32 grid
         outboxes = jax.tree.map(lambda x: x[0], outboxes)
-        me = jax.lax.axis_index(SHARD_AXIS)
+        me = jax.lax.axis_index(axes)
 
         def body(i, carry):
             primary, replica, counters = carry
@@ -370,7 +373,7 @@ def _mk_sync_step_multi(
                 arr12, _base = decode_wire_block(outbox)
                 outbox = req_from_arr(arr12)
             primary, replica, c, _bc = _sync_core(
-                primary, replica, outbox, me, D, write
+                primary, replica, outbox, me, D, write, axes=axes
             )
             return primary, replica, counters + c
 
@@ -381,7 +384,7 @@ def _mk_sync_step_multi(
         expand = lambda t: jax.tree.map(lambda x: x[None], t)
         return expand(primary), expand(replica), counters[None]
 
-    spec = P(SHARD_AXIS)
+    spec = shard_spec(mesh)
     fn = shard_map_compat(
         per_device,
         mesh=mesh,
@@ -421,6 +424,7 @@ class GlobalShardedEngine(ShardedEngine):
         write_mode: Optional[str] = None,
         dedup: Optional[str] = None,
         wire: Optional[str] = None,
+        a2a: Optional[str] = None,
     ):
         super().__init__(
             mesh,
@@ -432,6 +436,7 @@ class GlobalShardedEngine(ShardedEngine):
             write_mode=write_mode,
             dedup=dedup,
             wire=wire,
+            a2a=a2a,
         )
         # the replica table + collective step materialize on first GLOBAL
         # use: clustered daemons route GLOBAL over the host peer plane and

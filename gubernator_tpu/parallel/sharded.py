@@ -88,7 +88,13 @@ from gubernator_tpu.ops.engine import (
 )
 from gubernator_tpu.ops.plan import _subset, plan_passes, single_pass
 from gubernator_tpu.ops.table2 import Table2, new_table2
-from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_map_compat, shard_of
+from gubernator_tpu.parallel.mesh import (
+    devices_per_host,
+    mesh_hosts,
+    shard_map_compat,
+    shard_of,
+    shard_spec,
+)
 from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
 
 
@@ -159,7 +165,7 @@ def make_sharded_decide(
         expand = lambda t: jax.tree.map(lambda x: x[None], t)
         return expand(table), packed[None]
 
-    spec = P(SHARD_AXIS)
+    spec = shard_spec(mesh)
     fn = shard_map_compat(
         per_device, mesh=mesh, in_specs=(spec, spec, spec),
         # check_vma=False: the Pallas sweep's out_shape carries no vma
@@ -188,7 +194,7 @@ def make_sharded_install(mesh: Mesh, write: Optional[str] = None):
         expand = lambda t: jax.tree.map(lambda x: x[None], t)
         return expand(table), expand(installed)
 
-    spec = P(SHARD_AXIS)
+    spec = shard_spec(mesh)
     fn = shard_map_compat(
         per_device, mesh=mesh, in_specs=(spec, spec),
         # check_vma=False: the Pallas sweep's out_shape carries no vma
@@ -215,7 +221,7 @@ def make_sharded_merge(mesh: Mesh, write: Optional[str] = None):
         expand = lambda t: jax.tree.map(lambda x: x[None], t)
         return expand(table), expand(merged)
 
-    spec = P(SHARD_AXIS)
+    spec = shard_spec(mesh)
     fn = shard_map_compat(
         per_device, mesh=mesh, in_specs=(spec, spec, spec, spec, spec),
         out_specs=(spec, spec), check_vma=False
@@ -234,7 +240,7 @@ def make_sharded_tombstone(mesh: Mesh):
         rows, found = tombstone_rows_impl(rows, fp[0], active[0])
         return Table2(rows=rows[None]), found[None]
 
-    spec = P(SHARD_AXIS)
+    spec = shard_spec(mesh)
     fn = shard_map_compat(
         per_device, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=(spec, spec), check_vma=False
@@ -283,7 +289,7 @@ def new_sharded_table(mesh: Mesh, capacity_per_shard: int) -> Table2:
     D = mesh.devices.size
     local = new_table2(capacity_per_shard)
     stacked = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (D,) + x.shape), local)
-    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+    sharding = NamedSharding(mesh, shard_spec(mesh))
     return jax.tree.map(lambda x: jax.device_put(x, sharding), stacked)
 
 
@@ -306,8 +312,10 @@ class ShardedEngine:
         write_mode: Optional[str] = None,
         dedup: Optional[str] = None,
         wire: Optional[str] = None,
+        a2a: Optional[str] = None,
     ):
         from gubernator_tpu.ops.wire import default_wire_mode
+        from gubernator_tpu.parallel.ring import a2a_impl
 
         route = route or default_shard_route()
         if route not in ("host", "device"):
@@ -321,6 +329,16 @@ class ShardedEngine:
         # per-engine clock-skew bound; None = the ops.batch process default
         self.created_at_tolerance_ms = created_at_tolerance_ms
         self.n_shards = int(mesh.devices.size)
+        # pod topology: host rows × devices per host (1 × D on single-host
+        # meshes) — introspection for the debug plane and the bench; the
+        # shard id ↔ (host, device) mapping itself is mesh.py's host-major
+        # linearization, so no routing code below reads these
+        self.n_hosts = mesh_hosts(mesh)
+        self.devices_per_host = devices_per_host(mesh)
+        # ownership-exchange schedule for route="device" dispatches
+        # (parallel/ring.py): "ring" | "collective", resolved once from the
+        # override / GUBER_A2A_IMPL / backend auto rule
+        self.a2a_impl = a2a_impl(a2a)
         self.table = new_sharded_table(mesh, capacity_per_shard)
         # routing mode: "host" sorts rows into an ownership grid on the host;
         # "device" ships arrival-order rows and routes on-mesh with an
@@ -347,7 +365,7 @@ class ShardedEngine:
         # handoff mesh steps, built lazily (most engines never rebalance)
         self._merge_fn = None
         self._tombstone_fn = None
-        self._batch_sharding = NamedSharding(mesh, P(SHARD_AXIS))
+        self._batch_sharding = NamedSharding(mesh, shard_spec(mesh))
         self.max_exact_passes = max_exact_passes
         self.store = store  # write-through hook (gubernator_tpu.store.Store)
         self.stats = EngineStats()
@@ -384,6 +402,13 @@ class ShardedEngine:
         # scrapeable rather than bench-computed
         self.wire_bytes = {"put": 0, "fetch": 0}
         self._wire_taken = dict(self.wire_bytes)
+        # rows the a2a exchange capacity-dropped before they reached the
+        # kernel (FLAG_UNPROCESSED on a device-routed dispatch) — the
+        # per-engine source of gubernator_tpu_a2a_overflow_total{impl}.
+        # Counted at every depth: a row that overflows twice was twice a
+        # symptom of undersized pair capacity (GUBER_A2A_CAPACITY_SIGMA)
+        self.a2a_overflow = 0
+        self._a2a_overflow_taken = 0
         # per-shard ingress transfers issued concurrently (TPU: each
         # device_put is a serialized round trip on tunneled transports;
         # overlapping them makes the put cost max-of-shards, not
@@ -485,6 +510,15 @@ class ShardedEngine:
             self._wire_taken = dict(self.wire_bytes)
         return d
 
+    def take_a2a_overflow_delta(self) -> "tuple[str, int]":
+        """(exchange impl, overflow rows since the last take) —
+        EngineRunner feeds gubernator_tpu_a2a_overflow_total{impl} so
+        capacity pressure is scrapeable instead of test-only."""
+        with self._stage_lock:
+            d = self.a2a_overflow - self._a2a_overflow_taken
+            self._a2a_overflow_taken = self.a2a_overflow
+        return self.a2a_impl, d
+
     # ------------------------------------------------ egress buffer recycling
 
     def _take_egress(self, shape: tuple, dtype=np.int64):
@@ -579,7 +613,7 @@ class ShardedEngine:
             raise ValueError(
                 f"snapshot shape {rows.shape} != table {tuple(self.table.rows.shape)}"
             )
-        sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
+        sharding = NamedSharding(self.mesh, shard_spec(self.mesh))
         self.table = Table2(
             rows=jax.device_put(jnp.asarray(rows, dtype=jnp.int32), sharding)
         )
@@ -692,12 +726,13 @@ class ShardedEngine:
         if isinstance(staged, _StagedA2A):
             from gubernator_tpu.parallel.a2a import make_a2a_decide
 
-            key = ("a2a", staged.c, staged.math, staged.wire)
+            key = ("a2a", staged.c, staged.math, staged.wire, self.a2a_impl)
             fn = self._decide_fns.get(key)
             if fn is None:
                 fn = self._decide_fns[key] = make_a2a_decide(
                     self.mesh, staged.c, math=staged.math,
                     write=self.write_mode, dedup=dedup, wire=staged.wire,
+                    impl=self.a2a_impl,
                 )
             rows = staged.c
         else:
@@ -929,6 +964,14 @@ class ShardedEngine:
         dropped = (per[:, 3] & FLAG_DROPPED) != 0
         unproc = (per[:, 3] & FLAG_UNPROCESSED) != 0
         member = (per[:, 3] & FLAG_MEMBER) != 0
+        if isinstance(staged, _StagedA2A):
+            # capacity overflow: exchanged rows that never reached a kernel
+            # this dispatch (members inherit their carrier's flags without
+            # having been exchanged — not counted)
+            over = int((unproc & ~member).sum())
+            if over:
+                with self._stage_lock:
+                    self.a2a_overflow += over
         return (
             status, per[:, 0], per[:, 1], per[:, 2], dropped, hit, unproc,
             member, int(st[3]),

@@ -17,7 +17,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from gubernator_tpu.ops.telemetry import (
     PendingScan,
@@ -25,7 +25,7 @@ from gubernator_tpu.ops.telemetry import (
     block_width,
 )
 from gubernator_tpu.ops.table2 import K
-from gubernator_tpu.parallel.mesh import SHARD_AXIS, shard_map_compat
+from gubernator_tpu.parallel.mesh import shard_map_compat, shard_spec
 
 
 def make_sharded_scan(mesh: Mesh, n_buckets: int):
@@ -38,7 +38,7 @@ def make_sharded_scan(mesh: Mesh, n_buckets: int):
     def per_device(rows: jnp.ndarray, now: jnp.ndarray):
         return _scan_body(rows[0], now[0, 0], blk)[None]
 
-    spec = P(SHARD_AXIS)
+    spec = shard_spec(mesh)
     fn = shard_map_compat(
         per_device, mesh=mesh, in_specs=(spec, spec), out_specs=spec,
         check_vma=False,

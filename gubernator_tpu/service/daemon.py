@@ -34,6 +34,7 @@ from gubernator_tpu.ops.engine import LocalEngine, ms_now
 from gubernator_tpu.peers.hash_ring import ReplicatedConsistentHash
 from gubernator_tpu.peers.ownership import OwnershipIndex
 from gubernator_tpu.peers.picker import RegionPicker
+from gubernator_tpu.proto import globalsync_pb2 as globalsync_pb
 from gubernator_tpu.proto import gubernator_pb2 as pb
 from gubernator_tpu.proto import handoff_pb2 as handoff_pb
 from gubernator_tpu.proto import peers_pb2 as peers_pb
@@ -117,6 +118,10 @@ class Daemon:
 
             n_dev = len(jax.devices())
             self.engine = GlobalShardedEngine(
+                # topology resolves inside make_mesh: GUBER_MESH_HOSTS (the
+                # simulated multi-host mode) or jax.process_count() fold the
+                # devices into 2-D (host, device) axes; single hosts keep
+                # the seed's 1-D "shard" axis
                 make_mesh(n_dev),
                 capacity_per_shard=max(1, conf.cache_size // n_dev),
                 created_at_tolerance_ms=int(conf.created_at_tolerance_ms),
@@ -125,6 +130,9 @@ class Daemon:
                 # dedup on TPU meshes, host grid + pass planner elsewhere)
                 route=None if conf.shard_route == "auto" else conf.shard_route,
                 dedup=None if conf.shard_dedup == "auto" else conf.shard_dedup,
+                # exchange schedule for device-routed dispatches
+                # (parallel/ring.py; "auto" = ring on TPU backends)
+                a2a=None if conf.a2a_impl == "auto" else conf.a2a_impl,
             )
         else:
             self.engine = LocalEngine(
@@ -1283,6 +1291,24 @@ class Daemon:
             ).inc()
         return peers_pb.UpdatePeerGlobalsResp()
 
+    async def sync_globals_wire(
+        self, req: "globalsync_pb.SyncGlobalsWireReq"
+    ) -> "globalsync_pb.SyncGlobalsWireResp":
+        """Receive one compact inter-slice GLOBAL hit-sync batch
+        (service/wire.sync_wire_items): decode the lane image back to
+        items and drive them through the exact owner path the proto
+        GetPeerRateLimits fallback drives — DRAIN forced, broadcast
+        queueing, MULTI_REGION replication (excluded by the codec's
+        encodability rule) all behave identically."""
+        from gubernator_tpu.service.wire import sync_wire_items
+
+        items = sync_wire_items(req)
+        self.metrics.global_wire_entries.labels(direction="recv").inc(
+            len(items)
+        )
+        await self._get_peer_rate_limits(items)
+        return globalsync_pb.SyncGlobalsWireResp(applied=len(items))
+
     async def transfer_state(
         self, req: "handoff_pb.TransferStateReq"
     ) -> "handoff_pb.TransferStateResp":
@@ -1333,8 +1359,17 @@ class Daemon:
                 "wire": getattr(eng, "wire", None),
                 "write_mode": getattr(eng, "write_mode", None),
                 "n_shards": getattr(eng, "n_shards", 1),
+                "n_hosts": getattr(eng, "n_hosts", 1),
+                "devices_per_host": getattr(eng, "devices_per_host", None),
                 "route": getattr(eng, "route", None),
                 "dedup": getattr(eng, "dedup", None),
+                "a2a_impl": getattr(eng, "a2a_impl", None),
+                # exchange capacity-overflow rows (FLAG_UNPROCESSED before
+                # reaching a kernel): the live view of
+                # gubernator_tpu_a2a_overflow_total — sustained growth means
+                # pair_capacity is undersized for the traffic's skew
+                # (GUBER_A2A_CAPACITY_SIGMA)
+                "a2a_overflow": getattr(eng, "a2a_overflow", 0),
                 "poisoned": getattr(eng, "poisoned", None),
                 "checks": eng.stats.checks,
                 "dispatches": eng.stats.dispatches,

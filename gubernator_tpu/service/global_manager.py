@@ -28,6 +28,25 @@ from gubernator_tpu.proto import peers_pb2 as peers_pb
 from gubernator_tpu.types import Behavior, has_behavior
 
 
+def _unimplemented(exc: BaseException) -> bool:
+    """Does this (possibly PeerError-wrapped) failure mean the peer does not
+    implement the RPC (a pre-compact build)?"""
+    import grpc
+
+    seen = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        code = getattr(exc, "code", None)
+        if callable(code):
+            try:
+                if code() == grpc.StatusCode.UNIMPLEMENTED:
+                    return True
+            except Exception:
+                pass
+        exc = getattr(exc, "cause", None) or exc.__cause__
+    return False
+
+
 class GlobalManager:
     def __init__(self, daemon):
         self.daemon = daemon
@@ -39,6 +58,11 @@ class GlobalManager:
         self.metrics = daemon.metrics
         self.requeue_retries = b.global_requeue_retries
         self.queue_cap = b.global_queue_cap
+        # inter-slice compact sync (SyncGlobalsWire): batches ≥ _WIRE_MIN
+        # encodable entries ship as ONE lane-codec message instead of N
+        # nested RateLimitReq protos (service/wire.sync_wire_pb); smaller
+        # or non-encodable batches take the classic proto path
+        self.wire_sync = b.global_wire_sync
         # pending hits: hash_key → aggregated RateLimitReq (non-owner side)
         self._hits: Dict[str, pb.RateLimitReq] = {}
         # hash_key → monotonic ts of the key's FIRST un-synced hit; survives
@@ -167,12 +191,7 @@ class GlobalManager:
                 return
             async with sem:
                 try:
-                    await client.get_peer_rate_limits(
-                        peers_pb.GetPeerRateLimitsReq(
-                            requests=[i for _, i in pairs]
-                        ),
-                        timeout=self.timeout_s,
-                    )
+                    await self._ship(client, pairs)
                 except asyncio.CancelledError:
                     raise
                 except Exception:
@@ -191,6 +210,46 @@ class GlobalManager:
         await asyncio.gather(*(send(a, p) for a, p in by_peer.items()))
         if by_peer:
             self.metrics.global_send_duration.observe(time.perf_counter() - t0)
+
+    _WIRE_MIN = 4  # below this the proto path's framing overhead is moot
+
+    async def _ship(self, client, pairs) -> None:
+        """One owner-bound batch over the wire: the compact SyncGlobalsWire
+        codec when enabled, the batch is big enough to pay off, every entry
+        is representable, AND the peer speaks it — the classic
+        GetPeerRateLimits proto path otherwise (identical semantics). An
+        UNIMPLEMENTED answer latches `wire_sync_ok` off for that peer (a
+        pre-compact build) and the batch re-ships as proto in the same
+        round, so mixed-version clusters converge without losing a tick."""
+        req = None
+        if (
+            self.wire_sync
+            and len(pairs) >= self._WIRE_MIN
+            and getattr(client, "wire_sync_ok", True)
+        ):
+            from gubernator_tpu.service.wire import sync_wire_pb
+
+            req = sync_wire_pb(pairs, self.daemon.conf.advertise_address)
+        if req is not None:
+            try:
+                await client.sync_globals_wire(req, timeout=self.timeout_s)
+            except Exception as exc:
+                if not _unimplemented(exc):
+                    raise
+                client.wire_sync_ok = False
+            else:
+                self.metrics.global_wire_entries.labels(
+                    direction="sent"
+                ).inc(len(pairs))
+                return
+        await client.get_peer_rate_limits(
+            peers_pb.GetPeerRateLimitsReq(requests=[i for _, i in pairs]),
+            timeout=self.timeout_s,
+        )
+        if self.wire_sync and len(pairs) >= self._WIRE_MIN:
+            self.metrics.global_wire_entries.labels(
+                direction="fallback"
+            ).inc(len(pairs))
 
     def _requeue(self, pairs) -> None:
         """Re-merge a failed batch into the pending queue, bounded by a
@@ -243,6 +302,7 @@ class GlobalManager:
             "oldest_hit_age_s": round(self.oldest_hit_age_s(), 3),
             "sync_wait_ms": self.sync_wait_s * 1e3,
             "batch_limit": self.batch_limit,
+            "wire_sync": self.wire_sync,
         }
 
     # -------------------------------------------------------- broadcast loop

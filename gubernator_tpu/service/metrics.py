@@ -196,6 +196,26 @@ class DaemonMetrics:
             "counters by definition",
             registry=r,
         )
+        self.a2a_overflow = Counter(
+            # renders as gubernator_tpu_a2a_overflow_total
+            "gubernator_tpu_a2a_overflow",
+            "Rows the device-routed ownership exchange capacity-dropped "
+            "before they reached a kernel (FLAG_UNPROCESSED — retried, so "
+            "not lost; sustained growth means pair_capacity is undersized "
+            "for the traffic skew, GUBER_A2A_CAPACITY_SIGMA)",
+            ["impl"],  # ring | collective (GUBER_A2A_IMPL)
+            registry=r,
+        )
+        self.global_wire_entries = Counter(
+            # renders as gubernator_global_wire_sync_entries_total
+            "gubernator_global_wire_sync_entries",
+            "Inter-slice GLOBAL hit-sync entries by path: sent = shipped on "
+            "the compact SyncGlobalsWire codec, fallback = shipped on the "
+            "classic GetPeerRateLimits proto path (non-encodable batch or "
+            "pre-compact peer), recv = decoded and applied as owner",
+            ["direction"],  # sent | fallback | recv
+            registry=r,
+        )
         # --- batching front door (gubernator.go:98-112 analog)
         self.queue_length = Gauge(
             "gubernator_queue_length",

@@ -19,6 +19,7 @@ from typing import List, Optional, Tuple
 import grpc
 
 from gubernator_tpu import tracing
+from gubernator_tpu.proto import globalsync_pb2 as globalsync_pb
 from gubernator_tpu.proto import gubernator_pb2 as pb
 from gubernator_tpu.proto import handoff_pb2 as handoff_pb
 from gubernator_tpu.proto import peers_pb2 as peers_pb
@@ -28,6 +29,7 @@ from gubernator_tpu.types import Behavior, PeerInfo, has_behavior
 GET_PEER_RATE_LIMITS = "/pb.gubernator.PeersV1/GetPeerRateLimits"
 UPDATE_PEER_GLOBALS = "/pb.gubernator.PeersV1/UpdatePeerGlobals"
 TRANSFER_STATE = "/pb.gubernator.PeersV1/TransferState"
+SYNC_GLOBALS_WIRE = "/pb.gubernator.PeersV1/SyncGlobalsWire"
 GET_RATE_LIMITS = "/pb.gubernator.V1/GetRateLimits"
 HEALTH_CHECK = "/pb.gubernator.V1/HealthCheck"
 
@@ -157,6 +159,24 @@ class PeerClient:
         return await self._unary(
             UPDATE_PEER_GLOBALS, req, peers_pb.UpdatePeerGlobalsResp, timeout
         )
+
+    async def sync_globals_wire(
+        self,
+        req: "globalsync_pb.SyncGlobalsWireReq",
+        timeout: Optional[float] = None,
+    ) -> "globalsync_pb.SyncGlobalsWireResp":
+        """Ship one compact GLOBAL hit-sync batch (service/wire.sync_wire_pb)
+        to the owning peer — the inter-slice half of the hierarchical sync.
+        `wire_sync_ok` latches False when the peer answers UNIMPLEMENTED (a
+        pre-compact build), so the manager falls back to the proto path
+        permanently for that peer instead of probing every round."""
+        return await self._unary(
+            SYNC_GLOBALS_WIRE, req, globalsync_pb.SyncGlobalsWireResp, timeout
+        )
+
+    # latched by GlobalManager on UNIMPLEMENTED — peer runs a pre-compact
+    # build; the proto path serves it with identical semantics
+    wire_sync_ok = True
 
     async def transfer_state(
         self, req: "handoff_pb.TransferStateReq", timeout: Optional[float] = None

@@ -223,6 +223,11 @@ class EngineRunner:
                     self.metrics.wire_bytes.labels(direction=direction).inc(
                         nbytes
                     )
+        otake = getattr(self.engine, "take_a2a_overflow_delta", None)
+        if otake is not None:
+            impl, rows = otake()
+            if rows > 0:
+                self.metrics.a2a_overflow.labels(impl=impl).inc(rows)
 
     async def check_columns(
         self, cols: RequestColumns, now_ms: Optional[int] = None

@@ -17,6 +17,7 @@ from aiohttp import web
 from google.protobuf import json_format
 
 from gubernator_tpu import tracing
+from gubernator_tpu.proto import globalsync_pb2 as globalsync_pb
 from gubernator_tpu.proto import gubernator_pb2 as pb
 from gubernator_tpu.proto import handoff_pb2 as handoff_pb
 from gubernator_tpu.proto import peers_pb2 as peers_pb
@@ -97,6 +98,15 @@ def build_grpc_services(daemon):
         except ValueError as exc:  # malformed chunk buffers
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
 
+    @_timed(m, "/peers.SyncGlobalsWire")
+    async def sync_globals_wire(
+        request: "globalsync_pb.SyncGlobalsWireReq", context
+    ):
+        try:
+            return await daemon.sync_globals_wire(request)
+        except ValueError as exc:  # malformed lane/string buffers
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+
     def unary(fn, req_cls, resp_cls):
         return grpc.unary_unary_rpc_method_handler(
             fn,
@@ -135,6 +145,11 @@ def build_grpc_services(daemon):
                 transfer_state,
                 handoff_pb.TransferStateReq,
                 handoff_pb.TransferStateResp,
+            ),
+            "SyncGlobalsWire": unary(
+                sync_globals_wire,
+                globalsync_pb.SyncGlobalsWireReq,
+                globalsync_pb.SyncGlobalsWireResp,
             ),
         },
     )

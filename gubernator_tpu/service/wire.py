@@ -22,6 +22,7 @@ from gubernator_tpu.ops.batch import (
 )
 from gubernator_tpu.proto import gubernator_pb2 as pb
 from gubernator_tpu.proto import peers_pb2 as peers_pb
+from gubernator_tpu.types import Behavior
 
 # the reference rejects batches above this size outright (gubernator.go:41-42);
 # GUBER_MAX_BATCH_SIZE overrides per daemon (config.max_batch_size) — this
@@ -307,3 +308,142 @@ def encode_response_columns(
         np.ascontiguousarray(reset_time, dtype=np.int64),
         errors,
     )
+
+
+# ----------------------------------------- inter-slice GLOBAL sync codec
+# The PR-5 compact lane layout applied to the cross-daemon hit sync
+# (docs/architecture.md "Pod-scale topology"): numeric config rides ONE
+# 5-lane int32 image (ops/wire.pack_wire_rows — 20 B/entry instead of a
+# nested RateLimitReq message), full-precision accumulated hits ride an
+# int64 sidecar (inter-slice accumulations overflow the 18-bit lane
+# budget), and the key strings the owner needs for its broadcast queue
+# travel as one length-prefixed blob. Non-representable batches return
+# None and the caller falls back to the classic GetPeerRateLimits proto
+# path — identical semantics, more bytes (the PR-5 fallback contract).
+
+_SYNC_WIRE_BEHAVIOR = int(
+    Behavior.NO_BATCHING | Behavior.GLOBAL | Behavior.RESET_REMAINING
+    | Behavior.DRAIN_OVER_LIMIT
+)
+
+
+def sync_wire_pb(
+    pairs: Sequence[Tuple[str, "pb.RateLimitReq"]], source: str
+) -> Optional["globalsync_pb.SyncGlobalsWireReq"]:
+    """Pack one owner's pending-hit batch into a SyncGlobalsWireReq, or
+    None when any entry cannot ride the compact layout exactly (Gregorian /
+    MULTI_REGION behaviors must not be dropped, created_at must be present
+    and within the ±2047 ms delta budget of the batch base, tracing
+    metadata has no compact lane). The receive half is sync_wire_items."""
+    from gubernator_tpu.ops import wire as wire_mod
+
+    n = len(pairs)
+    if n == 0:
+        return None
+    items = [it for _k, it in pairs]
+    base = None
+    names: List[bytes] = []
+    keys: List[bytes] = []
+    for it in items:
+        if (
+            not it.HasField("created_at")
+            or it.behavior & ~_SYNC_WIRE_BEHAVIOR
+            or it.algorithm not in (0, 1)
+            or not (0 <= it.duration <= wire_mod._DUR_MASK)
+            or not (0 <= it.limit <= wire_mod.I32_MAX)
+            or it.metadata  # trace propagation has no compact lane
+            or not (
+                it.burst == 0 or (it.algorithm == 1 and it.burst == it.limit)
+            )
+            or it.name == ""
+            or it.unique_key == ""
+        ):
+            return None
+        if base is None:
+            base = it.created_at
+        if not (-wire_mod.DELTA_BIAS <= it.created_at - base
+                < wire_mod.DELTA_BIAS):
+            return None
+        nb, kb = it.name.encode(), it.unique_key.encode()
+        if len(nb) >= 1 << 16 or len(kb) >= 1 << 16:
+            return None
+        names.append(nb)
+        keys.append(kb)
+    lanes = np.zeros((wire_mod.WIRE_LANES, n), dtype=np.int32)
+    hits64 = np.zeros(n, dtype=np.int64)
+    for i, it in enumerate(items):
+        fp = fingerprint(it.name, it.unique_key)
+        lanes[0, i] = np.int64(fp).astype(np.int32)
+        lanes[1, i] = np.int64(fp >> 32).astype(np.int32)
+        lanes[2, i] = it.limit
+        lanes[3, i] = np.int64(
+            (it.duration & wire_mod._DUR_MASK)
+            | (int(it.algorithm) << wire_mod.DUR_BITS)
+        ).astype(np.int32)
+        reset = 1 if it.behavior & int(Behavior.RESET_REMAINING) else 0
+        drain = 1 if it.behavior & int(Behavior.DRAIN_OVER_LIMIT) else 0
+        delta = (it.created_at - base + wire_mod.DELTA_BIAS)
+        # lane hits stay 0: hits64 is authoritative on this codec
+        lanes[4, i] = np.int64(
+            ((delta & wire_mod._DELTA_MASK) << wire_mod.HITS_BITS)
+            | (reset << 30) | (drain << 31)
+        ).astype(np.int32)
+        hits64[i] = it.hits
+    from gubernator_tpu.proto import globalsync_pb2 as globalsync_pb
+
+    return globalsync_pb.SyncGlobalsWireReq(
+        source=source,
+        count=n,
+        base=base,
+        lanes=lanes.tobytes(),
+        hits=hits64.tobytes(),
+        name_lens=np.array([len(b) for b in names], dtype="<u2").tobytes(),
+        key_lens=np.array([len(b) for b in keys], dtype="<u2").tobytes(),
+        strings=b"".join(
+            b for pair in zip(names, keys) for b in pair
+        ),
+    )
+
+
+def sync_wire_items(
+    req: "globalsync_pb.SyncGlobalsWireReq",
+) -> List["pb.RateLimitReq"]:
+    """Decode a SyncGlobalsWireReq back to RateLimitReq items (owner side).
+    GLOBAL is re-set on every entry — this codec only ever carries GLOBAL
+    hit syncs — so the rebuilt items drive the exact
+    _get_peer_rate_limits path the proto fallback drives."""
+    from gubernator_tpu.ops.wire import WIRE_LANES, decode_wire_host
+
+    n = int(req.count)
+    lanes = np.frombuffer(req.lanes, dtype="<i4").reshape(WIRE_LANES, n)
+    cols = decode_wire_host(lanes, int(req.base))
+    hits = np.frombuffer(req.hits, dtype="<i8")
+    name_lens = np.frombuffer(req.name_lens, dtype="<u2")
+    key_lens = np.frombuffer(req.key_lens, dtype="<u2")
+    if not (
+        hits.shape[0] == n and name_lens.shape[0] == n
+        and key_lens.shape[0] == n
+        and int(name_lens.sum()) + int(key_lens.sum()) == len(req.strings)
+    ):
+        raise ValueError("SyncGlobalsWireReq: inconsistent buffer lengths")
+    items: List[pb.RateLimitReq] = []
+    off = 0
+    blob = req.strings
+    for i in range(n):
+        name = blob[off : off + int(name_lens[i])].decode()
+        off += int(name_lens[i])
+        key = blob[off : off + int(key_lens[i])].decode()
+        off += int(key_lens[i])
+        items.append(
+            pb.RateLimitReq(
+                name=name,
+                unique_key=key,
+                hits=int(hits[i]),
+                limit=int(cols["limit"][i]),
+                duration=int(cols["duration"][i]),
+                algorithm=int(cols["algo"][i]),
+                behavior=int(cols["behavior"][i]) | int(Behavior.GLOBAL),
+                created_at=int(cols["created_at"][i]),
+            )
+        )
+    return items
