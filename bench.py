@@ -1128,6 +1128,116 @@ def config6_latency_case(rng, now, batch=4096) -> dict:
     return out
 
 
+def durability_case(rng, now) -> dict:
+    """Durability phase (docs/durability.md): incremental checkpoint cost
+    vs the full snapshot, and warm-restart replay vs cold re-seed, at 10M
+    live keys on TPU (1M on CPU runs so the phase stays exercised).
+
+    Reported (acceptance surface):
+      * delta_bytes / full_bytes — a serving-rate write wave's frame must
+        be ≥3× smaller than the base snapshot (measured ~60–600×);
+      * extract+frame wall vs full-snapshot wall — checkpoint cost ∝
+        write rate, not table size;
+      * warm restart (base put + frame replay) vs cold re-seed of the
+        same live set — the ≥10× floor behind "minutes of re-seeding
+        becomes seconds of replay".
+    """
+    import tempfile
+
+    from gubernator_tpu.ops.checkpoint import (
+        EpochTracker, extract_begin, finish_extract,
+    )
+    from gubernator_tpu.ops.engine import LocalEngine
+    from gubernator_tpu.store import (
+        encode_delta_frame, fps_from_slots, load_snapshot_meta,
+        save_snapshot,
+    )
+
+    tpu = jax.default_backend() == "tpu"
+    LIVE = 10_000_000 if tpu else 1_000_000
+    BATCH = 1 << 17
+    eng = LocalEngine(capacity=int(LIVE * 1.7), write_mode=WRITE)
+    eng.ckpt = EpochTracker(eng.table.rows.shape[0])
+    keyspace = rng.integers(1, (1 << 63) - 1, size=LIVE, dtype=np.int64)
+
+    def cols_for(fps):
+        n = fps.shape[0]
+        from gubernator_tpu.ops.batch import RequestColumns
+
+        return RequestColumns(
+            fp=fps, algo=np.zeros(n, dtype=np.int32),
+            behavior=np.zeros(n, dtype=np.int32),
+            hits=np.ones(n, dtype=np.int64),
+            limit=np.full(n, 1 << 20, dtype=np.int64),
+            burst=np.zeros(n, dtype=np.int64),
+            duration=np.full(n, 3_600_000, dtype=np.int64),
+            created_at=np.full(n, now, dtype=np.int64),
+            err=np.zeros(n, dtype=np.int8),
+        )
+
+    # cold re-seed wall: the restart cost the warm path must beat
+    t0 = time.perf_counter()
+    for i in range(0, LIVE, BATCH):
+        eng.check_columns(cols_for(keyspace[i : i + BATCH]), now_ms=now)
+    seed_s = time.perf_counter() - t0
+    eng.ckpt.take()  # seeding dirt is the base's job, not a delta's
+
+    d = tempfile.mkdtemp()
+    base_path = f"{d}/base.npz"
+    t0 = time.perf_counter()
+    base_rows = eng.snapshot()
+    save_snapshot(base_path, base_rows, epoch=1)
+    full_s = time.perf_counter() - t0
+    full_bytes = int(base_rows.nbytes)
+
+    # one serving-rate write wave → one delta epoch
+    wave = np.unique(
+        keyspace[rng.integers(0, LIVE, size=BATCH, dtype=np.int64)]
+    )
+    eng.check_columns(cols_for(wave), now_ms=now + 5)
+    epoch, gids = eng.ckpt.take()
+    t0 = time.perf_counter()
+    d_fps, d_slots = finish_extract(
+        extract_begin(eng.table.rows, gids, eng.ckpt.blk, now + 5)
+    )
+    frame = encode_delta_frame(epoch, now + 5, d_slots)
+    delta_s = time.perf_counter() - t0
+
+    # warm restart: base put + frame replay vs the cold re-seed above
+    dst = LocalEngine(capacity=int(LIVE * 1.7), write_mode=WRITE)
+    t0 = time.perf_counter()
+    rows, _base_epoch = load_snapshot_meta(base_path)
+    dst.restore(rows)
+    dst.merge_rows(fps_from_slots(d_slots), d_slots, now_ms=now + 5)
+    restore_s = time.perf_counter() - t0
+
+    # spot parity: the wave's keys answer identically on both engines
+    probe = cols_for(wave[: 1 << 12])
+    probe = probe._replace(hits=np.zeros(probe.fp.shape[0], dtype=np.int64))
+    a = eng.check_columns(probe, now_ms=now + 6)
+    b = dst.check_columns(probe, now_ms=now + 6)
+    parity = bool(
+        np.array_equal(a.remaining, b.remaining)
+        and np.array_equal(a.status, b.status)
+    )
+    out = {
+        "live_keys": LIVE,
+        "seed_s": round(seed_s, 2),
+        "full_snapshot_s": round(full_s, 2),
+        "full_snapshot_bytes": full_bytes,
+        "delta_rows": int(d_fps.shape[0]),
+        "delta_bytes": len(frame),
+        "delta_s": round(delta_s, 3),
+        "delta_reduction": round(full_bytes / len(frame), 1),
+        "warm_restart_s": round(restore_s, 2),
+        "warm_vs_cold_speedup": round(seed_s / max(restore_s, 1e-6), 1),
+        "replay_parity": parity,
+    }
+    if not parity:
+        out["invalid"] = "warm-restarted engine diverged from the source"
+    return out
+
+
 def sweep_parity_smoke(rng, now):
     """Real-TPU check that BOTH Pallas write paths — the full sweep and the
     block-sparse grid — produce the same table and responses as the XLA
@@ -1560,6 +1670,13 @@ def main() -> None:
     matrix["pod-scaling"] = _attempt(
         "pod-scaling",
         lambda: pod_scaling_case(np.random.default_rng(51), now),
+    )
+
+    # durability phase: incremental checkpoint vs full snapshot + warm
+    # restart vs cold re-seed (docs/durability.md acceptance surface)
+    matrix["durability"] = _attempt(
+        "durability",
+        lambda: durability_case(np.random.default_rng(52), now),
     )
 
     # latency phase (sweep vs sparse vs xla device terms per table size);

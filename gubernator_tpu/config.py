@@ -285,6 +285,18 @@ class DaemonConfig:
 
     # checkpoint/resume (SURVEY §5.4): snapshot file for the Loader hook
     checkpoint_path: str = ""
+    # incremental checkpointing (docs/durability.md): background cadence of
+    # the dirty-block delta plane. 0 (default) keeps the seed behavior —
+    # restore on boot, one full snapshot on graceful shutdown; > 0 appends
+    # CRC-framed delta frames of blocks dirtied since the last epoch to the
+    # delta log every interval, bounding kill -9 loss to one interval of
+    # writes. Requires checkpoint_path.
+    checkpoint_interval_ms: float = 0.0
+    # compact the delta log into a fresh base snapshot after this many
+    # frames (bounds replay length and log growth)
+    checkpoint_compact_frames: int = 64
+    # delta-log file; default <checkpoint_path>.delta
+    checkpoint_delta_path: str = ""
 
     # background device-table telemetry cadence (ops/telemetry.py; the scan
     # overlaps serving and feeds gubernator_tpu_table_* + /v1/debug/table);
@@ -472,6 +484,24 @@ class DaemonConfig:
             raise ConfigError(
                 "GUBER_TELEMETRY_INTERVAL_MS must be >= 0 (0 = disabled)"
             )
+        if self.checkpoint_interval_ms < 0:
+            raise ConfigError(
+                "GUBER_CHECKPOINT_INTERVAL_MS must be >= 0 (0 = shutdown-"
+                "snapshot only)"
+            )
+        if self.checkpoint_interval_ms > 0 and not self.checkpoint_path:
+            raise ConfigError(
+                "GUBER_CHECKPOINT_INTERVAL_MS requires GUBER_CHECKPOINT_PATH "
+                "(the delta log lives beside the base snapshot)"
+            )
+        if self.checkpoint_delta_path and not self.checkpoint_path:
+            raise ConfigError(
+                "GUBER_CHECKPOINT_DELTA_PATH requires GUBER_CHECKPOINT_PATH"
+            )
+        if self.checkpoint_compact_frames <= 0:
+            raise ConfigError(
+                "GUBER_CHECKPOINT_COMPACT_FRAMES must be >= 1"
+            )
 
 
 def setup_daemon_config(
@@ -572,6 +602,13 @@ def setup_daemon_config(
         tls_auto=_get_bool(env, "GUBER_TLS_AUTO", False),
         tls_client_auth=_get(env, "GUBER_TLS_CLIENT_AUTH", ""),
         checkpoint_path=_get(env, "GUBER_CHECKPOINT_PATH", ""),
+        checkpoint_interval_ms=_get_float_ms(
+            env, "GUBER_CHECKPOINT_INTERVAL_MS", 0.0
+        ),
+        checkpoint_compact_frames=_get_int(
+            env, "GUBER_CHECKPOINT_COMPACT_FRAMES", 64
+        ),
+        checkpoint_delta_path=_get(env, "GUBER_CHECKPOINT_DELTA_PATH", ""),
         telemetry_interval_ms=_get_float_ms(
             env, "GUBER_TELEMETRY_INTERVAL_MS", 5_000.0
         ),

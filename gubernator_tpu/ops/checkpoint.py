@@ -1,0 +1,252 @@
+"""Incremental device-side checkpointing: epoch tracker + dirty-block extract.
+
+The full-snapshot Loader (store.py) pays table-size cost on every save — a
+100M-key table is ~6 GiB of DMA + compression per checkpoint, which is why
+the seed only snapshots at graceful shutdown (and a `kill -9` loses every
+counter since the last clean stop). This module makes checkpoint cost
+proportional to the WRITE RATE instead:
+
+* **EpochTracker** — a host-side dirty-block bitmap at the same granularity
+  family as the kernel2 sparse write (`bucket // CKPT_BLK`, cf. the sweep's
+  scalar-prefetched `target // (K·BLK)` dirty-block indices). Every table
+  mutation marks the touched fingerprints' blocks ON THE ENGINE THREAD,
+  strictly before (or in the same engine-thread job as) the mutation's
+  launch; `take()` runs on the engine thread too, immediately before the
+  extract launch, so the mark→mutate / take→extract pairs interleave FIFO
+  and a dirtied block can never fall between epochs.
+* **extract pass** — the PR-4 `extract_live_rows` pattern applied to only
+  the dirty blocks: one device gather of the dirty blocks' bucket rows, an
+  in-trace live filter + pack (live slots sorted to the front), and a host
+  fetch of just the live prefix. Cost ∝ dirty blocks, never table size.
+  Mesh engines run the same core per-shard under shard_map
+  (parallel/sharded.make_sharded_extract_dirty) so no slot row ever crosses
+  a device boundary.
+
+The extracted rows ride the table's own packed slot-field layout ((N, F)
+int32 — the same wire format TransferState chunks use), which is exactly
+what `kernel2.merge2` consumes on replay: a stale or duplicated frame can
+only tighten admission (remaining=min, expiry=max, OVER sticks), never
+over-grant. Framing/CRC/replay live in store.py + service/checkpoint.py.
+
+Granularity note: a dirty block's extract carries EVERY live row of its
+buckets, not just the written one — the amplification is bounded by
+CKPT_BLK × K × (live density), the price of block-granular tracking. The
+default CKPT_BLK=1 (bucket granularity) holds amplification at the
+bucket-occupancy floor; GUBER_CHECKPOINT_BLK trades bitmap size against
+frame amplification for tables where n_buckets bools of host memory
+matter.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gubernator_tpu.ops.table2 import (
+    EXP_HI,
+    EXP_LO,
+    F,
+    FP_HI,
+    FP_LO,
+    K,
+    ROW,
+)
+
+
+def ckpt_blk() -> int:
+    """Buckets per checkpoint dirty block (GUBER_CHECKPOINT_BLK). Bucket
+    granularity (1) by default: extract cost is dirty blocks × blk × K
+    slots, so unlike the sparse WRITE block (DMA-efficiency bound, default
+    64) the tracking block hugs the placement granularity — measured 11×
+    cheaper extracts at blk=1 vs blk=8 under a random-key write load.
+    Raising it shrinks the bitmap (n_buckets/blk bools) at the price of
+    extract amplification; even blk=1 is 12.5 MB of host bitmap at 100M
+    keys."""
+    return int(os.environ.get("GUBER_CHECKPOINT_BLK", "1"))
+
+
+class EpochTracker:
+    """Host-side dirty-block accumulator between checkpoint epochs.
+
+    One bitmap bit per (shard, block); `mark()` is a vectorized setitem on
+    the serving path (engine thread), `take()` snapshots-and-clears for one
+    checkpoint epoch, `remark()` re-arms a taken set whose save failed so a
+    full disk never silently drops dirt. Thread-safe: marks come from the
+    engine thread, takes from the checkpoint manager (which routes them to
+    the engine thread anyway — see module docstring), and status reads from
+    the debug plane."""
+
+    def __init__(
+        self,
+        n_buckets: int,
+        n_shards: int = 1,
+        blk: Optional[int] = None,
+        start_epoch: int = 0,
+    ):
+        if n_buckets <= 0:
+            raise ValueError("n_buckets must be positive")
+        b = blk or ckpt_blk()
+        b = min(b, n_buckets)
+        # conforming tables (new_table2) are pow2 below 2048 buckets or a
+        # multiple of 2048 above — some pow2 ≤ b always divides
+        while b > 1 and n_buckets % b:
+            b //= 2
+        self.blk = b
+        self.n_buckets = n_buckets
+        self.n_shards = n_shards
+        self.nblk = n_buckets // b  # blocks per shard
+        self._dirty = np.zeros(n_shards * self.nblk, dtype=bool)
+        # completed checkpoint epochs; take() hands out epoch+1 and advances
+        self.epoch = start_epoch
+        self.marked_fps = 0  # cumulative fps marked (status surface)
+        self._lock = threading.Lock()
+
+    def _block_ids(self, fps: np.ndarray) -> np.ndarray:
+        fps = np.asarray(fps, dtype=np.int64)
+        fps = fps[fps != 0]  # padding/inactive rows carry fp == 0
+        if fps.size == 0:
+            return fps
+        blkid = (fps % self.n_buckets) // self.blk
+        if self.n_shards > 1:
+            from gubernator_tpu.parallel.mesh import shard_of
+
+            blkid = shard_of(fps, self.n_shards) * self.nblk + blkid
+        return blkid
+
+    def mark(self, fps: np.ndarray) -> None:
+        """Mark the blocks holding `fps` dirty (fp == 0 entries ignored)."""
+        blkid = self._block_ids(fps)
+        if blkid.size == 0:
+            return
+        with self._lock:
+            self._dirty[blkid] = True
+            self.marked_fps += int(blkid.size)
+
+    def mark_all(self) -> None:
+        """Everything is dirty (restore/resize of unknown provenance): the
+        next epoch extracts the whole live set — expensive once, never
+        lossy."""
+        with self._lock:
+            self._dirty[:] = True
+
+    def take(self) -> Tuple[int, np.ndarray]:
+        """Snapshot-and-clear the dirty set for one checkpoint epoch.
+        Returns (epoch_id, sorted global block ids); the epoch counter
+        advances even on an empty take so frame ids stay monotone."""
+        with self._lock:
+            gids = np.nonzero(self._dirty)[0].astype(np.int64)
+            self._dirty[:] = False
+            self.epoch += 1
+            return self.epoch, gids
+
+    def remark(self, gids: np.ndarray) -> None:
+        """Re-arm a taken block set whose frame could not be persisted
+        (disk full, unwritable path): the dirt survives to the next epoch
+        instead of silently vanishing from every future checkpoint."""
+        if gids.size == 0:
+            return
+        with self._lock:
+            self._dirty[np.asarray(gids, dtype=np.int64)] = True
+
+    @property
+    def dirty_blocks(self) -> int:
+        with self._lock:
+            return int(self._dirty.sum())
+
+    def rebuild(self, n_buckets: int) -> "EpochTracker":
+        """Tracker for a resized table: same epoch lineage, everything
+        dirty (block ids do not survive a geometry change)."""
+        t = EpochTracker(
+            n_buckets, n_shards=self.n_shards, blk=self.blk,
+            start_epoch=self.epoch,
+        )
+        t.mark_all()
+        return t
+
+
+# ------------------------------------------------------------- extract pass
+
+
+def _extract_blocks_core(rows2d, bidx, now, blk: int):
+    """Traced core shared by the single-device jit and the per-shard
+    shard_map body (parallel/sharded.py): gather the dirty blocks' bucket
+    rows, filter live slots, pack them to the front.
+
+    `rows2d` is (T, ROW); `bidx` (g,) block ids with out-of-range sentinels
+    for padding (jnp.take mode="fill" zero-fills them — fp == 0 rows are
+    never live). Returns (slots (g·blk·K, F) live-first, fp (g·blk·K,),
+    live_count)."""
+    g = bidx.shape[0]
+    rowidx = (
+        bidx[:, None].astype(jnp.int32) * blk
+        + jnp.arange(blk, dtype=jnp.int32)[None, :]
+    ).reshape(-1)
+    blocks = jnp.take(rows2d, rowidx, axis=0, mode="fill", fill_value=0)
+    slots = blocks.reshape(g * blk * K, F)
+    lo = slots[:, FP_LO].astype(jnp.int64) & 0xFFFFFFFF
+    hi = slots[:, FP_HI].astype(jnp.int64)
+    fp = (hi << 32) | lo
+    exp = (slots[:, EXP_LO].astype(jnp.int64) & 0xFFFFFFFF) | (
+        slots[:, EXP_HI].astype(jnp.int64) << 32
+    )
+    live = (fp != 0) & (exp >= now)
+    order = jnp.argsort(jnp.where(live, 0, 1).astype(jnp.int32))
+    return slots[order], fp[order], live.sum()
+
+
+@functools.partial(jax.jit, static_argnames=("blk",))
+def _extract_blocks_sorted(rows, bidx, now, *, blk: int):
+    """Single-array entry: accepts any (..., ROW) rows layout ((NB, ROW)
+    local or (D, NB, ROW) sharded — the flatten folds the shard axis in,
+    exactly like table2._extract_sorted; block ids are then GLOBAL,
+    shard-major)."""
+    return _extract_blocks_core(rows.reshape(-1, ROW), bidx, now, blk)
+
+
+def _pad_pow2(n: int, floor: int = 8) -> int:
+    p = floor
+    while p < n:
+        p *= 2
+    return p
+
+
+def extract_begin(rows, gids: np.ndarray, blk: int, now_ms: int):
+    """LAUNCH half of a dirty-block extract (engine thread — must read a
+    coherent table, costs only the enqueue): pads the dirty-block list to a
+    pow2 grid width (log-many compiled shapes) with an out-of-range
+    sentinel and launches the gather+filter+pack. Returns a pending handle
+    for finish_extract."""
+    # sentinel: one past the last valid block id in the flattened layout
+    sentinel = int(np.prod(rows.shape[:-1])) // blk
+    g = int(gids.shape[0])
+    pad = _pad_pow2(max(g, 1))
+    bidx = np.full(pad, sentinel, dtype=np.int64)
+    bidx[:g] = gids
+    slots_s, fp_s, cnt = _extract_blocks_sorted(
+        rows, jnp.asarray(bidx), jnp.asarray(np.int64(now_ms)), blk=blk
+    )
+    return slots_s, fp_s, cnt
+
+
+def finish_extract(pending):
+    """FETCH half (any thread): materialize the live count, then fetch only
+    the live prefix padded to a pow2 so the compiled slice shapes stay
+    logarithmic in extract size (the extract_live_rows fetch rule)."""
+    slots_s, fp_s, cnt = pending
+    n = int(cnt)
+    if n == 0:
+        return np.empty(0, dtype=np.int64), np.empty((0, F), dtype=np.int32)
+    pad = 256
+    while pad < n:
+        pad *= 2
+    pad = min(pad, int(fp_s.shape[0]))
+    return (
+        np.asarray(fp_s[:pad])[:n].copy(),
+        np.asarray(slots_s[:pad])[:n].copy(),
+    )

@@ -276,9 +276,11 @@ class PendingCheck:
     fetch thread by `finish_check_columns` — the split that lets host pack +
     transfer of dispatch N+1 overlap device execution and fetch of N."""
 
-    __slots__ = ("hb", "err", "now", "passes", "clamped", "stacked", "rows")
+    __slots__ = (
+        "hb", "err", "now", "passes", "clamped", "stacked", "rows", "mark",
+    )
 
-    def __init__(self, hb, err, now, passes, clamped, rows=None):
+    def __init__(self, hb, err, now, passes, clamped, rows=None, mark=None):
         self.stacked = None  # same-shape pass outputs fused for ONE fetch
         self.hb = hb
         self.err = err
@@ -287,6 +289,11 @@ class PendingCheck:
         self.clamped = clamped
         # total request rows (fused wire batches carry no eager HostBatch)
         self.rows = rows if rows is not None else int(hb.fp.shape[0])
+        # fingerprints this batch will touch — recorded into the checkpoint
+        # epoch tracker at ISSUE time (engine thread), in the same job as
+        # the launches, so a dirtied block can never fall between epochs
+        # (ops/checkpoint.py ordering contract)
+        self.mark = mark
 
 
 class _LazyWireBatch:
@@ -398,7 +405,7 @@ def prepare_check_wire(engine, parts, now_ms=None) -> "PendingCheck | None":
     p = Pass(rows=np.arange(n), batch=lazy, member_rows=[])
     return PendingCheck(
         hb=lazy, err=err, now=now, passes=[[p, n, lazy, staged]],
-        clamped=clamped, rows=n,
+        clamped=clamped, rows=n, mark=act_fp,
     )
 
 
@@ -428,7 +435,9 @@ def prepare_check_columns(engine, cols, now_ms=None) -> PendingCheck:
         n = len(p.rows)
         batch, staged = engine.stage_pass(p.batch, n)
         passes.append([p, n, batch, staged])
-    return PendingCheck(hb=hb, err=err, now=now, passes=passes, clamped=clamped)
+    return PendingCheck(
+        hb=hb, err=err, now=now, passes=passes, clamped=clamped, mark=hb.fp
+    )
 
 
 def issue_check_columns(engine, pending: PendingCheck) -> PendingCheck:
@@ -438,6 +447,10 @@ def issue_check_columns(engine, pending: PendingCheck) -> PendingCheck:
     replaced by its pending (un-fetched) output handle."""
     if not isinstance(pending, PendingCheck):  # engine-specific pending
         return engine.issue_pending(pending)
+    if pending.mark is not None and getattr(engine, "ckpt", None) is not None:
+        # dirty-block marking for incremental checkpoints: same engine-
+        # thread job as the launches below (ops/checkpoint.py contract)
+        engine.ckpt.mark(pending.mark)
     for entry in pending.passes:
         _p, _n, batch, staged = entry
         entry[3] = engine.issue_staged(staged, _padded_rows(batch))
@@ -615,6 +628,11 @@ class LocalEngine:
         # ChangeSet of persisted fingerprints after every check — the
         # Store.OnChange analog (reference store.go:63-78, algorithms.go:148)
         self.store = store
+        # incremental-checkpoint epoch tracker (ops/checkpoint.EpochTracker),
+        # attached by service/checkpoint.CheckpointManager when the daemon
+        # runs with GUBER_CHECKPOINT_INTERVAL_MS > 0; None = zero marking
+        # cost on the serving path
+        self.ckpt = None
         self.stats = EngineStats()
         self._seen_pad_sizes: set = set()  # compiled batch shapes (for resize warm)
         # reason string when a failed donated launch left device state
@@ -623,11 +641,20 @@ class LocalEngine:
         # today, but the daemon reads it engine-agnostically.
         self.poisoned: Optional[str] = None
 
+    def _mark_dirty(self, fps) -> None:
+        """Checkpoint hook: record the touched fingerprints' blocks in the
+        epoch tracker (ops/checkpoint.py). Called on the engine thread in
+        the same job as the mutation it precedes, so marks and takes
+        interleave FIFO and no dirtied block falls between epochs."""
+        if self.ckpt is not None:
+            self.ckpt.mark(np.asarray(fps))
+
     def _decide_packed(self, hb: HostBatch) -> np.ndarray:
         """One dispatch → ONE host transfer each way: compact 5-lane int32
         wire block (or full packed (12, B) ingress) in, compact int32 (or
         packed (B+2, 4) i64) output fetched. Updates self.table; returns
         the host array (unpack_outputs dispatches on its dtype)."""
+        self._mark_dirty(hb.fp)
         if self._decide_fn is not None:
             # oracle engines return unpacked outputs; pack on device for the
             # same downstream shape
@@ -853,6 +880,7 @@ class LocalEngine:
             burst = np.asarray(limit, dtype=np.int64)
         if stamp is None:
             stamp = np.full(n, now, dtype=np.int64)
+        self._mark_dirty(fp)
         size = _pad_size(n)
 
         def pad(a, dtype):
@@ -918,6 +946,7 @@ class LocalEngine:
                 for r in range(int(rank.max()) + 1)
             )
         now = now_ms if now_ms is not None else ms_now()
+        self._mark_dirty(fps)
         size = _pad_size(n)
         fp_p = np.zeros(size, dtype=np.int64)
         fp_p[:n] = fps
@@ -946,6 +975,7 @@ class LocalEngine:
         n = fps.shape[0]
         if n == 0:
             return 0
+        self._mark_dirty(fps)
         size = _pad_size(n)
         fp_p = np.zeros(size, dtype=np.int64)
         fp_p[:n] = fps
@@ -989,6 +1019,29 @@ class LocalEngine:
                 f"snapshot shape {rows.shape} != table {tuple(self.table.rows.shape)}"
             )
         self.table = Table2(rows=jax.device_put(jnp.asarray(rows, dtype=jnp.int32)))
+        if self.ckpt is not None:
+            # a mid-life restore replaces state of unknown provenance: the
+            # next delta epoch must capture everything live, not just what
+            # was marked before (boot-time restores run with no tracker
+            # attached, so the warm path never pays this)
+            self.ckpt.mark_all()
+
+    def checkpoint_begin(self, gids: np.ndarray, now_ms: Optional[int] = None):
+        """LAUNCH half of a dirty-block checkpoint extract (engine thread —
+        reads a coherent table, costs only the enqueue); finish with
+        `checkpoint_finish` on any thread while serving keeps dispatching
+        (the telemetry_begin overlap pattern)."""
+        from gubernator_tpu.ops.checkpoint import extract_begin
+
+        now = now_ms if now_ms is not None else ms_now()
+        return extract_begin(self.table.rows, gids, self.ckpt.blk, now)
+
+    def checkpoint_finish(self, pending):
+        """FETCH half: (fps (N,) i64, slots (N, F) i32) — only the live
+        prefix of the dirty blocks crosses the device→host boundary."""
+        from gubernator_tpu.ops.checkpoint import finish_extract
+
+        return finish_extract(pending)
 
     def live_count(self, now_ms: Optional[int] = None) -> int:
         from gubernator_tpu.ops.table2 import live_count2
@@ -1021,6 +1074,11 @@ class LocalEngine:
         )
         self.table = Table2(rows=jax.device_put(jnp.asarray(new_rows)))
         self.stats.evicted_unexpired += dropped
+        if self.ckpt is not None:
+            # block ids do not survive a geometry change: fresh tracker,
+            # same epoch lineage, everything dirty (the next delta carries
+            # the rehashed live set once)
+            self.ckpt = self.ckpt.rebuild(self.table.rows.shape[0])
         # warm compiles for the new geometry with all-inactive dummy batches
         # (no state mutation — _decide_packed counts nothing itself, and all
         # rows are inactive). Both static math variants warm: algo=0 rows

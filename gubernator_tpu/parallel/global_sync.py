@@ -685,6 +685,11 @@ class GlobalShardedEngine(ShardedEngine):
         from gubernator_tpu.ops.engine import _stack_pass_outputs
 
         self._ensure_global_plane()
+        # checkpoint marking for the pipelined GLOBAL fork: replica-pinned
+        # rows are a harmless superset (dirty blocks only cost extract
+        # bytes), and marking here — the engine-thread job that launches —
+        # keeps the mark→mutate / take→extract FIFO contract
+        self._mark_dirty(pending.hb.fp)
         self._apply_queue(pending.queue)
         for entry in pending.passes:
             staged, table_attr = entry[3], entry[4]
@@ -904,6 +909,9 @@ class GlobalShardedEngine(ShardedEngine):
         if k:
             popped = self.pending[d].take(OUT)
             cfg, hits, reset = popped
+            # collective sync mutates owner shards (and replicas) for these
+            # keys — mark before the launch (engine thread, sync job)
+            self._mark_dirty(cfg.fp)
             box = pad_batch(cfg, OUT)
             box.hits[:k] = hits
             box.behavior[:k] |= reset

@@ -229,6 +229,32 @@ def make_sharded_merge(mesh: Mesh, write: Optional[str] = None):
     return jax.jit(fn, donate_argnums=(0,))
 
 
+def make_sharded_extract_dirty(mesh: Mesh, blk: int):
+    """All-shards dirty-block extract step (incremental checkpointing,
+    ops/checkpoint.py): each device gathers ITS dirty blocks' bucket rows,
+    filters live slots and packs them to the front — no slot row ever
+    crosses a device boundary; the host fetches only per-shard live
+    prefixes (ShardedEngine.checkpoint_finish). `bidx` is a (D, G) grid of
+    per-shard LOCAL block ids padded with the out-of-range sentinel
+    nblk_local (jnp.take mode="fill" zero-fills, and fp == 0 rows are
+    never live)."""
+
+    def per_device(rows, bidx, now):
+        from gubernator_tpu.ops.checkpoint import _extract_blocks_core
+
+        slots, fp, cnt = _extract_blocks_core(
+            rows[0], bidx[0], now[0], blk
+        )
+        return slots[None], fp[None], cnt[None]
+
+    spec = shard_spec(mesh)
+    fn = shard_map_compat(
+        per_device, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=(spec, spec, spec), check_vma=False
+    )
+    return jax.jit(fn)
+
+
 def make_sharded_tombstone(mesh: Mesh):
     """All-shards tombstone step (table2.tombstone_rows_impl): zero the
     slots holding acked handed-off fingerprints, routed per owning shard."""
@@ -365,6 +391,11 @@ class ShardedEngine:
         # handoff mesh steps, built lazily (most engines never rebalance)
         self._merge_fn = None
         self._tombstone_fn = None
+        # incremental-checkpoint plane (ops/checkpoint.py): epoch tracker
+        # attached by the daemon's CheckpointManager (None = zero marking
+        # cost), per-shard extract step built lazily on first checkpoint
+        self.ckpt = None
+        self._extract_dirty_fn = None
         self._batch_sharding = NamedSharding(mesh, shard_spec(mesh))
         self.max_exact_passes = max_exact_passes
         self.store = store  # write-through hook (gubernator_tpu.store.Store)
@@ -478,6 +509,13 @@ class ShardedEngine:
             return single_pass(hb)
         return plan_passes(hb, max_exact=self.max_exact_passes)
 
+    def _mark_dirty(self, fps) -> None:
+        """Checkpoint hook: record touched fingerprints' (shard, block)
+        pairs in the epoch tracker — engine thread, same job as the
+        mutation (ops/checkpoint.py ordering contract)."""
+        if self.ckpt is not None:
+            self.ckpt.mark(np.asarray(fps))
+
     # -------------------------------------------- staging cost accounting
 
     def _stage_time(self, key: str, dt_s: float) -> None:
@@ -573,6 +611,7 @@ class ShardedEngine:
             burst = np.asarray(limit, dtype=np.int64)
         if stamp is None:
             stamp = np.full(n, now, dtype=np.int64)
+        self._mark_dirty(fp)
         D = self.n_shards
         routed = shard_of(fp, D)
         order, rs, offset, b_local = _route_plan(routed, D)
@@ -617,6 +656,10 @@ class ShardedEngine:
         self.table = Table2(
             rows=jax.device_put(jnp.asarray(rows, dtype=jnp.int32), sharding)
         )
+        if self.ckpt is not None:
+            # mid-life restore: state of unknown provenance — next delta
+            # epoch captures the whole live set (cf. LocalEngine.restore)
+            self.ckpt.mark_all()
 
     def live_count(self, now_ms: Optional[int] = None) -> int:
         from gubernator_tpu.ops.table2 import live_count2
@@ -651,6 +694,7 @@ class ShardedEngine:
                 for r in range(int(rank.max()) + 1)
             )
         now = now_ms if now_ms is not None else ms_now()
+        self._mark_dirty(fps)
         D = self.n_shards
         routed = shard_of(fps, D)
         order, rs, offset, b_local = _route_plan(routed, D)
@@ -672,6 +716,7 @@ class ShardedEngine:
         n = fps.shape[0]
         if n == 0:
             return 0
+        self._mark_dirty(fps)
         D = self.n_shards
         routed = shard_of(fps, D)
         order, rs, offset, b_local = _route_plan(routed, D)
@@ -683,6 +728,60 @@ class ShardedEngine:
         self.table, found = self._tombstone_fn(self.table, put(fp_g), put(act_g))
         self.stats.dispatches += 1
         return int(np.asarray(found).sum())
+
+    # ------------------------------------------------------- checkpointing
+    # Same begin/finish split as LocalEngine (launch on the engine thread,
+    # fetch off it), but the extract runs PER SHARD under shard_map so no
+    # slot row crosses a device boundary; the tracker's global block ids
+    # (shard-major: gid = shard · nblk_local + local_block) regroup into a
+    # per-shard local-block grid here.
+
+    def checkpoint_begin(self, gids: np.ndarray, now_ms: Optional[int] = None):
+        now = now_ms if now_ms is not None else ms_now()
+        blk, nblk = self.ckpt.blk, self.ckpt.nblk
+        D = self.n_shards
+        shard = gids // nblk
+        local = gids % nblk
+        counts = np.bincount(shard, minlength=D)
+        G = _pad_size(int(max(counts.max(), 1)), floor=8)
+        bidx = np.full((D, G), nblk, dtype=np.int64)  # sentinel: zero-fill
+        order = np.argsort(shard, kind="stable")
+        rs = shard[order]
+        offset = np.arange(gids.shape[0]) - np.searchsorted(rs, rs)
+        bidx[rs, offset] = local[order]
+        if self._extract_dirty_fn is None:
+            self._extract_dirty_fn = make_sharded_extract_dirty(self.mesh, blk)
+        put = lambda x: jax.device_put(x, self._batch_sharding)
+        return self._extract_dirty_fn(
+            self.table.rows, put(bidx),
+            put(np.full(D, now, dtype=np.int64)),
+        )
+
+    def checkpoint_finish(self, pending):
+        """Fetch per-shard live prefixes (pow2-padded — the
+        extract_live_rows fetch rule, per shard) and concatenate."""
+        from gubernator_tpu.ops.table2 import F
+
+        slots_g, fp_g, cnt_g = pending
+        counts = np.asarray(cnt_g)
+        width = int(fp_g.shape[1])
+        fps_l, slots_l = [], []
+        for d in range(self.n_shards):
+            n = int(counts[d])
+            if n == 0:
+                continue
+            pad = 256
+            while pad < n:
+                pad *= 2
+            pad = min(pad, width)
+            fps_l.append(np.asarray(fp_g[d, :pad])[:n])
+            slots_l.append(np.asarray(slots_g[d, :pad])[:n])
+        if not fps_l:
+            return (
+                np.empty(0, dtype=np.int64),
+                np.empty((0, F), dtype=np.int32),
+            )
+        return np.concatenate(fps_l), np.concatenate(slots_l)
 
     # ----------------------------------------------------------- telemetry
 
@@ -1001,6 +1100,7 @@ class ShardedEngine:
         exhaust retries without ever being probed are not counted, matching
         the host path where such rows cannot exist."""
         n = batch.fp.shape[0]
+        self._mark_dirty(batch.fp)
         staged = self._stage(batch, shard)
         table, out = self._decide(getattr(self, table_attr), staged)
         setattr(self, table_attr, table)

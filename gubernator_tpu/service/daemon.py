@@ -174,6 +174,14 @@ class Daemon:
         from gubernator_tpu.service.region_manager import RegionManager
 
         self.region_manager = RegionManager(self)
+        # incremental-checkpoint plane (service/checkpoint.py): inert unless
+        # GUBER_CHECKPOINT_INTERVAL_MS > 0 — then a background loop appends
+        # dirty-block delta frames beside the base snapshot and restart
+        # replays base + deltas (docs/durability.md)
+        from gubernator_tpu.service.checkpoint import CheckpointManager
+
+        self.checkpointer = CheckpointManager(self)
+        self._checkpoint_task = None
         self._maintenance_task = None
         self._global_sync_task = None  # mesh-global collective sync tick
         self._telemetry_task = None  # background table-telemetry cadence
@@ -239,6 +247,10 @@ class Daemon:
                 log.info("OTLP trace export enabled → %s", exp.endpoint)
         d.maybe_restore()
         await d.warm_up()
+        if d.checkpointer.enabled:
+            # epoch tracker attaches BEFORE the listeners open: every
+            # serving mutation from the first request onward is marked
+            d.checkpointer.attach()
         from gubernator_tpu.service.server import start_servers
 
         await start_servers(d)
@@ -257,6 +269,13 @@ class Daemon:
             # it overlaps serving dispatches — never the serving path
             d._telemetry_task = asyncio.create_task(
                 d._telemetry_loop(), name="table-telemetry"
+            )
+        if d.checkpointer.enabled:
+            # incremental checkpoint cadence (docs/durability.md): extract
+            # launch on the engine thread, fetch + frame append off it —
+            # checkpointing overlaps serving like the telemetry scan does
+            d._checkpoint_task = asyncio.create_task(
+                d.checkpointer.loop(), name="checkpoint"
             )
         if d._client_creds is not None and conf.tls_cert_file:
             # rotation watcher: the gRPC server hot-reloads per handshake,
@@ -1405,6 +1424,20 @@ class Daemon:
             },
         }
 
+    def debug_durability(self) -> dict:
+        """Durability plane: checkpoint epoch freshness, delta-log volume,
+        compaction progress and the last persistence error — what an
+        operator checks before trusting a rolling restart (or after an
+        unclean one)."""
+        out = self.checkpointer.status()
+        self.metrics.checkpoint_epoch_age.set(
+            self.checkpointer.epoch_age_s() if self.checkpointer.enabled
+            else 0.0
+        )
+        loader = self._loader()
+        out["loader"] = type(loader).__name__ if loader is not None else None
+        return out
+
     def debug_global(self) -> dict:
         """GLOBAL behavior: cross-daemon queue ages + mesh outbox depth —
         the convergence-lag view behind the staleness gauge."""
@@ -1506,19 +1539,79 @@ class Daemon:
         return None
 
     def maybe_restore(self) -> None:
+        """Boot-time restore. The incremental plane replays base + delta
+        frames (service/checkpoint.py); the classic Loader path loads one
+        snapshot. EITHER degrades to a logged cold start on damage — a
+        snapshot whose geometry/schema no longer matches the configured
+        table (cache_size changed across restart), a corrupt file, or a
+        loader that throws must never kill the boot."""
+        if self.checkpointer.enabled:
+            self.checkpointer.restore()
+            return
         loader = self._loader()
         if loader is None:
             return
-        rows = loader.load()
-        if rows is not None:
-            self.engine.restore(rows)
+        try:
+            rows = loader.load()
+            if rows is not None:
+                self.engine.restore(np.asarray(rows))
+        except Exception:
+            log.warning(
+                "checkpoint restore failed; starting cold", exc_info=True
+            )
+            self.metrics.checkpoint_errors.labels(stage="restore").inc()
 
     def maybe_checkpoint(self) -> None:
+        """Shutdown snapshot through the Loader hook. Guarded: a failed
+        save (disk full, unwritable path) is logged + counted — it must
+        never wedge close() before _door.shutdown/runner.close run."""
         loader = self._loader()
-        if loader is not None:
+        if loader is None:
+            return
+        try:
             loader.save(self.runner.snapshot_sync())
+        except Exception:
+            log.exception("shutdown checkpoint failed; state not persisted")
+            self.metrics.checkpoint_errors.labels(stage="shutdown").inc()
 
     # ---------------------------------------------------------------- close
+    async def abort(self) -> None:
+        """Unclean-death surface for chaos tests — the in-process analog of
+        `kill -9`: listeners, loops and executors stop, but NOTHING runs
+        that a SIGKILL would skip — no drain, no GLOBAL flush, no handoff,
+        no final checkpoint. Whatever the incremental checkpoint plane
+        already made durable is ALL a restart gets; the recovery-bound
+        chaos test (tests/test_durability.py) drives this path."""
+        if self._shutting_down:
+            return
+        self._shutting_down = True
+        for t in (
+            self._cert_watch_task, self._maintenance_task,
+            self._global_sync_task, self._telemetry_task,
+            self._checkpoint_task, *self._handoff_tasks,
+        ):
+            if t is not None:
+                t.cancel()
+        if self._pool is not None:
+            await self._pool.close()
+        # kill the GLOBAL/region loops WITHOUT the flush their close() does
+        for t in (
+            *self.global_manager._tasks,
+            *( [self.region_manager._task]
+               if self.region_manager._task is not None else [] ),
+        ):
+            t.cancel()
+        await asyncio.gather(
+            *(c.shutdown() for c in self._peer_clients.values()),
+            *(c.shutdown() for c in self._orphaned_clients),
+            return_exceptions=True,
+        )
+        self._orphaned_clients = []
+        for s in self._servers:
+            await s.stop()
+        self._door.shutdown(wait=False)
+        self.runner.close()
+
     async def stop(self, drain: bool = False) -> None:
         """Graceful shutdown; `drain=True` additionally hands every owned
         live row to its ring successor before the listeners close (the
@@ -1565,6 +1658,12 @@ class Daemon:
                 await self._telemetry_task
             except asyncio.CancelledError:
                 pass
+        if self._checkpoint_task is not None:
+            self._checkpoint_task.cancel()
+            try:
+                await self._checkpoint_task
+            except asyncio.CancelledError:
+                pass
         if self._pool is not None:
             await self._pool.close()
         # in-flight rebalance handoffs yield to the final drain pass (or to
@@ -1595,7 +1694,17 @@ class Daemon:
             # final collective flush so queued GLOBAL hits reach their owner
             # shards before the checkpoint (global_manager.close analog)
             await self.runner.sync_global()
-        self.maybe_checkpoint()
+        if self.checkpointer.enabled:
+            # incremental plane: one last compaction folds the delta log
+            # into the base so a restart replays nothing. Guarded like
+            # maybe_checkpoint — shutdown always completes.
+            try:
+                await self.checkpointer.final_checkpoint()
+            except Exception:
+                log.exception("final checkpoint compaction failed")
+                self.metrics.checkpoint_errors.labels(stage="shutdown").inc()
+        else:
+            self.maybe_checkpoint()
         self._door.shutdown(wait=True)
         self.runner.close()
         if tracing.exporter is not None:

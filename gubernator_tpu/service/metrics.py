@@ -430,6 +430,48 @@ class DaemonMetrics:
             registry=r,
             buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
         )
+        # --- durability plane (service/checkpoint.py; docs/durability.md):
+        # the incremental checkpoint loop's cost, volume, and freshness —
+        # kind=delta for epoch frames, kind=base for compactions/shutdown
+        # snapshots. epoch_age is THE recovery-bound signal: a kill -9 loses
+        # at most the writes admitted in that window.
+        self.checkpoint_duration = Histogram(
+            "gubernator_tpu_checkpoint_duration_seconds",
+            "Seconds per checkpoint operation (dirty-block extract + frame "
+            "append, or base compaction)",
+            ["kind"],  # delta | base
+            registry=r,
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
+        )
+        self.checkpoint_bytes = Counter(
+            # renders as gubernator_tpu_checkpoint_bytes_total
+            "gubernator_tpu_checkpoint_bytes",
+            "Bytes written to the checkpoint plane (delta frames vs base "
+            "snapshots) — delta bytes track the write rate, not table size",
+            ["kind"],  # delta | base
+            registry=r,
+        )
+        self.checkpoint_rows = Counter(
+            # renders as gubernator_tpu_checkpoint_rows_total
+            "gubernator_tpu_checkpoint_rows",
+            "Live slot rows captured per checkpoint kind",
+            ["kind"],  # delta | base
+            registry=r,
+        )
+        self.checkpoint_epoch_age = Gauge(
+            "gubernator_tpu_checkpoint_epoch_age_seconds",
+            "Seconds since the last durable checkpoint epoch — the upper "
+            "bound on state a kill -9 can lose right now",
+            registry=r,
+        )
+        self.checkpoint_errors = Counter(
+            # renders as gubernator_tpu_checkpoint_errors_total
+            "gubernator_tpu_checkpoint_errors",
+            "Failed checkpoint operations by stage (the dirty set is "
+            "re-armed on delta failures, so dirt is deferred, not lost)",
+            ["stage"],  # delta | base | restore | shutdown
+            registry=r,
+        )
         # --- GLOBAL convergence lag (docs/observability.md): age of the
         # oldest un-synced GLOBAL hit across the cross-daemon queue
         # (service/global_manager.py) and the mesh outbox

@@ -60,6 +60,9 @@ class EngineRunner:
         # background telemetry fetches get their OWN single thread (lazy):
         # a table scan parked on a fetch worker would steal a pipeline slot
         self._telemetry: Optional[ThreadPoolExecutor] = None
+        # checkpoint-extract fetches likewise (lazy): the dirty-block
+        # fetch overlaps serving dispatches, never competes with them
+        self._ckpt: Optional[ThreadPoolExecutor] = None
 
     async def check(
         self, cols: RequestColumns, now_ms: Optional[int] = None, span=None
@@ -310,6 +313,55 @@ class EngineRunner:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(self._exec, self.engine.snapshot)
 
+    # ------------------------------------------------- incremental checkpoint
+    # (service/checkpoint.py) — split like telemetry: take+launch atomically
+    # on the engine thread, fetch on a dedicated lazy thread so the extract
+    # streams off-device WHILE serving dispatches keep issuing.
+
+    async def checkpoint_extract(self, now_ms: Optional[int] = None):
+        """One checkpoint epoch's dirty-block extract: (epoch, gids, fps,
+        slots). The tracker take() and the extract LAUNCH run in one
+        engine-thread job — the ordering contract that makes every
+        mark→mutate pair land wholly inside one epoch (ops/checkpoint.py)."""
+        loop = asyncio.get_running_loop()
+
+        def begin():
+            tracker = self.engine.ckpt
+            epoch, gids = tracker.take()
+            if gids.shape[0] == 0:
+                return epoch, gids, None
+            return epoch, gids, self.engine.checkpoint_begin(gids, now_ms)
+
+        epoch, gids, pending = await loop.run_in_executor(self._exec, begin)
+        if pending is None:
+            from gubernator_tpu.ops.table2 import F
+
+            return (
+                epoch, gids,
+                np.empty(0, dtype=np.int64), np.empty((0, F), dtype=np.int32),
+            )
+        if self._ckpt is None:
+            self._ckpt = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt"
+            )
+        fps, slots = await loop.run_in_executor(
+            self._ckpt, lambda: self.engine.checkpoint_finish(pending)
+        )
+        return epoch, gids, fps, slots
+
+    async def checkpoint_snapshot(self):
+        """(full table rows, epoch) read atomically on the engine thread —
+        the compaction input (rows coherent with the epoch counter)."""
+        loop = asyncio.get_running_loop()
+
+        def run():
+            tracker = self.engine.ckpt
+            return self.engine.snapshot(), (
+                tracker.epoch if tracker is not None else 0
+            )
+
+        return await loop.run_in_executor(self._exec, run)
+
     # ---------------------------------------------------------- handoff ops
     # All three mutate (or scan state coherent with) the device table, so
     # they serialize onto the engine thread like every dispatch.
@@ -345,6 +397,8 @@ class EngineRunner:
         return self._exec.submit(self.engine.snapshot).result()
 
     def close(self) -> None:
+        if self._ckpt is not None:
+            self._ckpt.shutdown(wait=True)
         if self._telemetry is not None:
             self._telemetry.shutdown(wait=True)
         self._prep.shutdown(wait=True)

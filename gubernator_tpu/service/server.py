@@ -231,6 +231,8 @@ def build_http_app(daemon, status_only: bool = False) -> web.Application:
                 return web.json_response(daemon.debug_peers())
             if kind == "global":
                 return web.json_response(daemon.debug_global())
+            if kind == "durability":
+                return web.json_response(daemon.debug_durability())
         except Exception as exc:  # pragma: no cover - defensive
             return web.json_response(
                 {"code": 13, "message": f"debug snapshot failed: {exc}"},
@@ -238,7 +240,7 @@ def build_http_app(daemon, status_only: bool = False) -> web.Application:
             )
         return web.json_response(
             {"code": 5, "message": f"unknown debug plane {kind!r}; one of: "
-             "table, pipeline, peers, global"},
+             "table, pipeline, peers, global, durability"},
             status=404,
         )
 

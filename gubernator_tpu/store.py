@@ -23,18 +23,22 @@ when asked (reference store.go:49-78, workers.go:335-540). The TPU analogs:
 from __future__ import annotations
 
 import os
+import struct
 import tempfile
+import zlib
 from dataclasses import dataclass
-from typing import Callable, Optional
+from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
 SNAPSHOT_MAGIC = "GUBTPU1"
 
 
-def save_snapshot(path: str, rows: np.ndarray) -> None:
+def save_snapshot(path: str, rows: np.ndarray, epoch: int = 0) -> None:
     """Atomically write a table snapshot (tmp + rename, so a crash mid-write
-    never leaves a torn file for the next boot)."""
+    never leaves a torn file for the next boot). `epoch` records the last
+    checkpoint epoch the snapshot includes (0 on the classic full-snapshot
+    path) so warm restart can skip already-compacted delta frames."""
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".gubtpu-snap-")
@@ -42,7 +46,7 @@ def save_snapshot(path: str, rows: np.ndarray) -> None:
         with os.fdopen(fd, "wb") as f:
             np.savez_compressed(f, magic=np.frombuffer(
                 SNAPSHOT_MAGIC.encode(), dtype=np.uint8
-            ), rows=rows)
+            ), rows=rows, epoch=np.int64(epoch))
         os.replace(tmp, path)
     except BaseException:
         try:
@@ -58,6 +62,177 @@ def load_snapshot(path: str) -> np.ndarray:
         if magic != SNAPSHOT_MAGIC:
             raise ValueError(f"{path}: not a gubernator-tpu snapshot")
         return z["rows"]
+
+
+def load_snapshot_meta(path: str) -> "Tuple[np.ndarray, int]":
+    """(rows, epoch) — epoch is 0 for snapshots written before the
+    incremental-checkpoint plane existed."""
+    with np.load(path) as z:
+        magic = bytes(z["magic"]).decode()
+        if magic != SNAPSHOT_MAGIC:
+            raise ValueError(f"{path}: not a gubernator-tpu snapshot")
+        epoch = int(z["epoch"]) if "epoch" in z.files else 0
+        return z["rows"], epoch
+
+
+# ------------------------------------------------------------- delta log
+#
+# The incremental-checkpoint append log (docs/durability.md): CRC-framed
+# packed slot rows — the table's own (N, F) int32 slot-field layout, the
+# same raw-LE buffer format the TransferState handoff wire uses — appended
+# beside the base snapshot by service/checkpoint.CheckpointManager. Warm
+# restart replays base + frames through kernel2.merge2 (remaining=min,
+# expiry=max, OVER sticks), so a torn tail, a duplicated frame, or a crash
+# between compaction steps can only UNDER-grant, never over-grant.
+
+DELTA_LOG_MAGIC = b"GUBTPUDL"  # 8-byte file header
+FRAME_MAGIC = 0x46445547  # "GUDF" little-endian
+FRAME_VERSION = 1
+# frame header: magic u32, version u32, n_rows u32, epoch i64, now_ms i64,
+# payload crc32 u32
+_FRAME_HEADER = struct.Struct("<IIIqqI")
+_SLOT_FIELDS = 16  # table2.F — frozen into the on-disk format by VERSION 1
+
+
+def fps_from_slots(slots: np.ndarray) -> np.ndarray:
+    """Fingerprints encoded in packed slot rows (fields FP_LO/FP_HI) — the
+    reason delta frames need no separate fp column."""
+    from gubernator_tpu.ops.table2 import FP_HI, FP_LO
+
+    lo = slots[:, FP_LO].astype(np.int64) & 0xFFFFFFFF
+    hi = slots[:, FP_HI].astype(np.int64)
+    return (hi << 32) | lo
+
+
+def encode_delta_frame(epoch: int, now_ms: int, slots: np.ndarray) -> bytes:
+    """One CRC-framed delta: header + raw little-endian (N, F) int32 slot
+    rows. 64 B/row — live rows of dirty blocks only, vs the base
+    snapshot's every-slot-of-every-bucket."""
+    payload = np.ascontiguousarray(slots, dtype="<i4").tobytes()
+    header = _FRAME_HEADER.pack(
+        FRAME_MAGIC, FRAME_VERSION, slots.shape[0], epoch, now_ms,
+        zlib.crc32(payload),
+    )
+    return header + payload
+
+
+class DeltaScan:
+    """Result of reading a delta log: the valid frame prefix plus what (if
+    anything) was skipped. A torn tail (crash mid-append) or a corrupt
+    frame stops the scan — replaying a prefix is always safe under merge2
+    semantics, while resynchronizing past a corrupt length field is not."""
+
+    def __init__(self):
+        self.frames: List[Tuple[int, int, np.ndarray]] = []  # (epoch, now, slots)
+        self.skipped_bytes = 0
+        self.error: Optional[str] = None
+
+    @property
+    def rows(self) -> int:
+        return sum(f[2].shape[0] for f in self.frames)
+
+
+def read_delta_frames(path: str) -> DeltaScan:
+    """Scan a delta log: every complete, CRC-clean frame in order. Never
+    raises on damage — a truncated or corrupt tail is recorded on the
+    returned DeltaScan and the clean prefix is still usable."""
+    scan = DeltaScan()
+    if not os.path.exists(path):
+        return scan
+    with open(path, "rb") as f:
+        head = f.read(len(DELTA_LOG_MAGIC))
+        if head != DELTA_LOG_MAGIC:
+            scan.error = "bad delta-log header"
+            scan.skipped_bytes = os.path.getsize(path)
+            return scan
+        while True:
+            pos = f.tell()
+            hdr = f.read(_FRAME_HEADER.size)
+            if not hdr:
+                break  # clean end
+            if len(hdr) < _FRAME_HEADER.size:
+                scan.error = "truncated frame header"
+                scan.skipped_bytes = os.path.getsize(path) - pos
+                break
+            magic, version, n_rows, epoch, now_ms, crc = _FRAME_HEADER.unpack(hdr)
+            if magic != FRAME_MAGIC or version != FRAME_VERSION:
+                scan.error = f"bad frame magic/version at offset {pos}"
+                scan.skipped_bytes = os.path.getsize(path) - pos
+                break
+            payload = f.read(n_rows * _SLOT_FIELDS * 4)
+            if len(payload) < n_rows * _SLOT_FIELDS * 4:
+                scan.error = "truncated frame payload"
+                scan.skipped_bytes = os.path.getsize(path) - pos
+                break
+            if zlib.crc32(payload) != crc:
+                scan.error = f"frame CRC mismatch at offset {pos}"
+                scan.skipped_bytes = os.path.getsize(path) - pos
+                break
+            slots = np.frombuffer(payload, dtype="<i4").reshape(
+                n_rows, _SLOT_FIELDS
+            ).astype(np.int32)
+            scan.frames.append((epoch, now_ms, slots))
+    return scan
+
+
+class DeltaLog:
+    """Append-only delta-frame log beside the base snapshot.
+
+    `append` opens/writes/fsyncs per call (checkpoint cadence, not request
+    cadence); `reset` atomically replaces the file with an empty header —
+    compaction writes the new base FIRST (atomic rename), so a crash
+    between the two steps leaves old deltas atop a newer base, which the
+    conservative replay merge renders harmless (and the epoch filter skips
+    outright)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def append(self, epoch: int, now_ms: int, slots: np.ndarray) -> int:
+        """Append one frame; returns bytes written (header included)."""
+        frame = encode_delta_frame(epoch, now_ms, slots)
+        fresh = not os.path.exists(self.path) or (
+            os.path.getsize(self.path) == 0
+        )
+        d = os.path.dirname(os.path.abspath(self.path)) or "."
+        os.makedirs(d, exist_ok=True)
+        with open(self.path, "ab") as f:
+            if fresh:
+                f.write(DELTA_LOG_MAGIC)
+            f.write(frame)
+            f.flush()
+            os.fsync(f.fileno())
+        return len(frame) + (len(DELTA_LOG_MAGIC) if fresh else 0)
+
+    def scan(self) -> DeltaScan:
+        return read_delta_frames(self.path)
+
+    def reset(self) -> None:
+        """Truncate to an empty log (post-compaction), atomically."""
+        d = os.path.dirname(os.path.abspath(self.path)) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".gubtpu-delta-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(DELTA_LOG_MAGIC)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def size_bytes(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def frame_count(self) -> int:
+        return len(self.scan().frames)
 
 
 @dataclass

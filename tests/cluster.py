@@ -113,6 +113,22 @@ class Cluster:
             d.set_peers([PeerInfo(**vars(p)) for p in peers])
         return new
 
+    async def crash_restart(self, i: int) -> Daemon:
+        """kill -9 analog (docs/durability.md): daemon i dies UNCLEANLY —
+        no drain, no GLOBAL flush, no shutdown checkpoint (Daemon.abort)
+        — and a replacement spawns on the same config, recovering only
+        what the incremental checkpoint plane already persisted. The
+        durability chaos tests bound over-admission across this edge."""
+        old = self.daemons[i]
+        conf = old.conf
+        await old.abort()
+        new = await Daemon.spawn(conf)
+        self.daemons[i] = new
+        peers = [d.peer_info() for d in self.daemons]
+        for d in self.daemons:
+            d.set_peers([PeerInfo(**vars(p)) for p in peers])
+        return new
+
     async def drain_restart(self, i: int, mid_handoff=None) -> Daemon:
         """Rolling-restart step with graceful state handoff (the reference
         has no analog — docs/robustness.md "Topology change & drain"):
