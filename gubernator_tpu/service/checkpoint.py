@@ -120,6 +120,28 @@ class CheckpointManager:
                 self.delta_path, scan.error, len(scan.frames),
                 scan.skipped_bytes,
             )
+            daemon.metrics.checkpoint_errors.labels(stage="restore").inc()
+            # repair BEFORE serving: appends land at the physical end of
+            # the file but replay stops at the first bad frame, so new
+            # frames written after a torn tail would be unreachable until
+            # the next compaction — a second unclean death before then
+            # would lose them, breaking the one-interval recovery bound
+            try:
+                self._log.repair(scan)
+                log.info(
+                    "delta log %s truncated to its %d-byte clean prefix",
+                    self.delta_path, scan.clean_bytes,
+                )
+            except Exception as exc:
+                self.last_error = f"delta-log repair: {exc}"
+                daemon.metrics.checkpoint_errors.labels(
+                    stage="restore"
+                ).inc()
+                log.warning(
+                    "delta log repair failed (%s); frames appended before "
+                    "the next compaction may not survive another unclean "
+                    "death", exc,
+                )
         from gubernator_tpu.store import fps_from_slots
 
         t0 = time.perf_counter()
@@ -241,15 +263,22 @@ class CheckpointManager:
 
             now_ms = daemon.now_ms()
 
-            def write_base() -> int:
+            def write_base():
+                # everything that touches disk stays off the event loop:
+                # snapshot write + rename, log reset, size stat
                 save_snapshot(self.base_path, rows, epoch)
+                self._log.reset()
                 # the rows are already host-side; the live count is one
                 # vectorized pass over memory the save just touched
-                return live_count2(Table2(rows=rows), now_ms)
+                return (
+                    live_count2(Table2(rows=rows), now_ms),
+                    os.path.getsize(self.base_path),
+                )
 
             try:
-                base_rows = await loop.run_in_executor(None, write_base)
-                self._log.reset()
+                base_rows, base_bytes = await loop.run_in_executor(
+                    None, write_base
+                )
             except Exception as exc:
                 self.last_error = f"compaction: {exc}"
                 daemon.metrics.checkpoint_errors.labels(stage="base").inc()
@@ -263,9 +292,7 @@ class CheckpointManager:
             self.last_error = None
             m = daemon.metrics
             m.checkpoint_duration.labels(kind="base").observe(dt)
-            m.checkpoint_bytes.labels(kind="base").inc(
-                os.path.getsize(self.base_path)
-            )
+            m.checkpoint_bytes.labels(kind="base").inc(base_bytes)
             m.checkpoint_rows.labels(kind="base").inc(base_rows)
             self._observe_age()
             log.info(

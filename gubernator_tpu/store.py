@@ -125,6 +125,7 @@ class DeltaScan:
     def __init__(self):
         self.frames: List[Tuple[int, int, np.ndarray]] = []  # (epoch, now, slots)
         self.skipped_bytes = 0
+        self.clean_bytes = 0  # file prefix (log header + clean frames)
         self.error: Optional[str] = None
 
     @property
@@ -147,6 +148,7 @@ def read_delta_frames(path: str) -> DeltaScan:
             return scan
         while True:
             pos = f.tell()
+            scan.clean_bytes = pos
             hdr = f.read(_FRAME_HEADER.size)
             if not hdr:
                 break  # clean end
@@ -206,6 +208,26 @@ class DeltaLog:
 
     def scan(self) -> DeltaScan:
         return read_delta_frames(self.path)
+
+    def repair(self, scan: DeltaScan) -> None:
+        """Truncate a damaged log to `scan`'s clean prefix, fsynced.
+
+        Appends land at the physical end of the file, but the scan stops
+        at the first bad frame — so without this, every frame written
+        after a torn tail sits behind the damage where no replay can
+        reach it until the next compaction. restore() repairs before
+        serving so subsequent appends extend a scannable log. A prefix
+        with no usable log header rewrites the log empty (atomically)
+        instead."""
+        if scan.skipped_bytes <= 0 or not os.path.exists(self.path):
+            return
+        if scan.clean_bytes < len(DELTA_LOG_MAGIC):
+            self.reset()
+            return
+        with open(self.path, "r+b") as f:
+            f.truncate(scan.clean_bytes)
+            f.flush()
+            os.fsync(f.fileno())
 
     def reset(self) -> None:
         """Truncate to an empty log (post-compaction), atomically."""

@@ -225,6 +225,45 @@ def test_delta_log_truncated_tail(tmp_path):
     assert len(scan.frames) == 1 and "magic" in scan.error
 
 
+def test_delta_log_repair_extends_after_torn_tail(tmp_path):
+    """A damaged log must be truncated to its clean prefix before new
+    appends — otherwise fresh frames land after the corrupt bytes, where
+    the prefix scan can never reach them."""
+    log = DeltaLog(str(tmp_path / "x.delta"))
+    rng = np.random.default_rng(3)
+    s1 = rng.integers(-(2**31), 2**31 - 1, size=(6, 16)).astype(np.int32)
+    s2 = rng.integers(-(2**31), 2**31 - 1, size=(9, 16)).astype(np.int32)
+    log.append(1, NOW, s1)
+    clean = log.size_bytes()
+    log.append(2, NOW, s2)
+    with open(log.path, "r+b") as f:  # crash mid-append: torn tail
+        f.truncate(log.size_bytes() - 8)
+    # appending WITHOUT repair strands the new frame behind the tear
+    log.append(3, NOW, s2)
+    scan = log.scan()
+    assert scan.error and len(scan.frames) == 1
+    assert scan.clean_bytes == clean
+    # repair truncates to the clean prefix; appends then extend a
+    # scannable log
+    log.repair(scan)
+    assert log.size_bytes() == clean
+    log.append(3, NOW, s2)
+    scan = log.scan()
+    assert scan.error is None and len(scan.frames) == 2
+    np.testing.assert_array_equal(scan.frames[1][2], s2)
+    # a log whose own header is damaged repairs to empty
+    with open(log.path, "r+b") as f:
+        f.seek(0)
+        f.write(b"\x00" * 4)
+    scan = log.scan()
+    assert scan.error and scan.clean_bytes == 0
+    log.repair(scan)
+    scan = log.scan()
+    assert scan.error is None and len(scan.frames) == 0
+    log.append(4, NOW, s1)
+    assert log.frame_count() == 1
+
+
 # ------------------------------------------------------------- extract pass
 
 
@@ -664,6 +703,77 @@ async def test_corrupt_snapshot_cold_start(tmp_path):
         assert r[0].remaining == 4
     finally:
         await d.close()
+
+
+@async_test
+async def test_restore_repairs_torn_delta_log(tmp_path):
+    """A kill -9 can tear the delta log mid-append; restore must truncate
+    it to the clean prefix before serving, or every frame the restarted
+    daemon appends lands behind the corrupt bytes where replay cannot
+    reach it — a SECOND kill -9 before compaction would then lose up to
+    compact_frames × interval of writes, not one interval."""
+    from gubernator_tpu.service.daemon import Daemon
+
+    conf = ckpt_config(tmp_path)
+    d = await Daemon.spawn(conf)
+    delta_path = d.checkpointer.delta_path
+    try:
+        await d.get_rate_limits([
+            pb.RateLimitReq(
+                name="t", unique_key="k0", hits=2, limit=10,
+                duration=3_600_000,
+            )
+        ])
+        await d.checkpointer.checkpoint_once()
+        await d.get_rate_limits([
+            pb.RateLimitReq(
+                name="t", unique_key="k1", hits=3, limit=10,
+                duration=3_600_000,
+            )
+        ])
+        await d.checkpointer.checkpoint_once()
+    finally:
+        await d.abort()
+    # crash mid-append: tear the second frame's tail
+    with open(delta_path, "r+b") as f:
+        f.truncate(os.path.getsize(delta_path) - 8)
+
+    d2 = await Daemon.spawn(conf)
+    try:
+        assert d2.checkpointer.restored == "delta"
+        assert d2.checkpointer.replayed_frames == 1
+        assert (
+            d2.metrics.checkpoint_errors.labels(stage="restore")
+            ._value.get() >= 1
+        )
+        # life 2 admits more writes and checkpoints them...
+        await d2.get_rate_limits([
+            pb.RateLimitReq(
+                name="t", unique_key="k2", hits=4, limit=10,
+                duration=3_600_000,
+            )
+        ])
+        await d2.checkpointer.checkpoint_once()
+        # ...onto a repaired, scannable log
+        assert DeltaLog(delta_path).scan().error is None
+    finally:
+        await d2.abort()
+
+    d3 = await Daemon.spawn(conf)
+    try:
+        # a SECOND unclean death still recovers life 2's writes: the frame
+        # appended after the repair replays
+        assert d3.checkpointer.replayed_frames == 2
+        for key, want in (("k0", 8), ("k2", 6)):
+            r = await d3.get_rate_limits([
+                pb.RateLimitReq(
+                    name="t", unique_key=key, hits=0, limit=10,
+                    duration=3_600_000,
+                )
+            ])
+            assert r[0].remaining == want, (key, r[0])
+    finally:
+        await d3.close()
 
 
 @async_test
