@@ -74,7 +74,9 @@ def make_req_batch(
         if algo is None
         else algo
     )
-    is_leaky = algo == int(Algorithm.LEAKY_BUCKET)
+    # burst defaults to limit for the tolerance-shaped algorithms (leaky —
+    # algorithms.go:259-261 — and GCRA; host packing rule)
+    bursty = (algo == int(Algorithm.LEAKY_BUCKET)) | (algo == int(Algorithm.GCRA))
     limit_arr = np.full(b, limit, dtype=np.int64)
     return ReqBatch(
         fp=jnp.asarray(fps),
@@ -84,8 +86,7 @@ def make_req_batch(
         ),
         hits=jnp.asarray(np.ones(b, dtype=np.int64) if hits is None else hits),
         limit=jnp.asarray(limit_arr),
-        # leaky burst defaults to limit (host packing rule, algorithms.go:259-261)
-        burst=jnp.asarray(np.where(is_leaky, limit_arr, 0)),
+        burst=jnp.asarray(np.where(bursty, limit_arr, 0)),
         duration=jnp.full(b, duration, dtype=jnp.int64),
         created_at=jnp.full(b, now, dtype=jnp.int64),
         expire_new=jnp.full(b, now + duration, dtype=jnp.int64),
@@ -1580,6 +1581,243 @@ def e2e_serving_case() -> dict:
     return out
 
 
+def algorithms_case(rng, now) -> dict:
+    """ISSUE-10 scenario-breadth phase: per-algorithm device throughput at
+    the headline geometry (10M live keys on TPU / 1M on CPU, 128K batch).
+
+    The acceptance headline is the GCRA-vs-token ratio: GCRA's decision
+    table runs one TAT compare-and-advance over a single raw-int64 lane
+    (fewer decode/writeback lanes than token's remaining/status machinery),
+    so its device decisions/s must be ≥ token bucket's at identical batch
+    and table geometry. Sliding-window and lease rates are recorded
+    alongside (both all-integer graphs)."""
+    on_tpu = jax.default_backend() == "tpu"
+    LIVE = 10_000_000 if on_tpu else 1 << 20
+    BATCH = 1 << 17
+    CAPACITY = 1 << 24 if on_tpu else 1 << 21
+    out: dict = {"live_keys": LIVE, "batch": BATCH}
+    rates: dict = {}
+    for label, algo_v, math in (
+        ("token_bucket", int(Algorithm.TOKEN_BUCKET), "token"),
+        ("gcra", int(Algorithm.GCRA), "gcra"),
+        ("sliding_window", int(Algorithm.SLIDING_WINDOW), "int"),
+        ("concurrency_lease", int(Algorithm.CONCURRENCY_LEASE), "int"),
+    ):
+        keyspace = rng.integers(1, (1 << 63) - 1, size=LIVE, dtype=np.int64)
+        perm = rng.permutation(LIVE)
+        algo = np.full(BATCH, algo_v, dtype=np.int32)
+        batches = [
+            jax.device_put(
+                make_req_batch(
+                    keyspace[perm[i * BATCH: (i + 1) * BATCH]], now,
+                    algo=algo, limit=1 << 20, duration=3_600_000,
+                )
+            )
+            for i in range(min(8, LIVE // BATCH))
+        ]
+        seed = [
+            jax.device_put(
+                make_req_batch(
+                    keyspace[i * BATCH: (i + 1) * BATCH], now, algo=algo,
+                    limit=1 << 20, duration=3_600_000,
+                )
+            )
+            for i in range(LIVE // BATCH)
+        ]
+        case = Case(f"algo-{label}", CAPACITY, batches, seed_batches=seed,
+                    math=math)
+        case.seed()
+        res = case.device_loop()
+        out[label] = res
+        if "device_decisions_per_sec" in res:
+            rates[label] = res["device_decisions_per_sec"]
+        # release this algorithm's table before the next seeds
+        case.table = None
+    if "gcra" in rates and "token_bucket" in rates:
+        ratio = rates["gcra"] / max(rates["token_bucket"], 1e-9)
+        out["gcra_vs_token_loop"] = round(ratio, 3)
+
+    # apples-to-apples kernel A/B (the acceptance comparison): the SAME
+    # batch of fps through one dispatch per algorithm against identical
+    # fresh tables — no loop-harness state drift, best-of-6 walls. GCRA's
+    # decision table (one TAT compare-and-advance, no new/existing fork,
+    # no sticky status) must not be slower than token's.
+    from gubernator_tpu.ops.batch import HostBatch, pack_host_batch
+    from gubernator_tpu.ops.kernel2 import decide2_packed_cols
+
+    fps = rng.integers(1, (1 << 63) - 1, size=BATCH, dtype=np.int64)
+    kernel_ms = {}
+    for label, algo_v, math in (
+        ("token_bucket", 0, "token"), ("gcra", 2, "gcra"),
+    ):
+        tbl = new_table2(CAPACITY)
+        rb = make_req_batch(fps, now, algo=np.full(BATCH, algo_v, np.int32),
+                            limit=1 << 20, duration=3_600_000)
+        hb = HostBatch(**{f: np.asarray(getattr(rb, f))
+                          for f in HostBatch._fields})
+        arr = jax.device_put(jnp.asarray(pack_host_batch(hb)))
+        tbl, o = decide2_packed_cols(tbl, arr, write=WRITE, math=math)
+        np.asarray(o)  # compile + seed
+        best = None
+        for _ in range(6):
+            t0 = time.perf_counter()
+            tbl, o = decide2_packed_cols(tbl, arr, write=WRITE, math=math)
+            np.asarray(o)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        kernel_ms[label] = best * 1e3
+        del tbl
+    out["token_kernel_ms"] = round(kernel_ms["token_bucket"], 2)
+    out["gcra_kernel_ms"] = round(kernel_ms["gcra"], 2)
+    kratio = kernel_ms["token_bucket"] / max(kernel_ms["gcra"], 1e-9)
+    out["gcra_vs_token"] = round(kratio, 3)
+    out["gcra_no_worse"] = bool(kratio >= 1.0)
+    log(f"[algorithms] gcra/token kernel ratio: {kratio:.3f} "
+        f"({'OK' if kratio >= 1.0 else 'BELOW TOKEN'}); "
+        f"loop-harness ratio {out.get('gcra_vs_token_loop')}")
+    return out
+
+
+def cascade_case(rng, now) -> dict:
+    """ISSUE-10 cascade phase: a 3-level cascade (per-user + per-tenant +
+    global) against three sequential single-level checks.
+
+    Two rungs: (a) ENGINE — one compact-wire dispatch carrying all levels
+    vs three dependent dispatches of the same rows (the kernel-launch
+    amortization); (b) E2E — a loopback daemon driven with one cascade RPC
+    per check vs three dependent RPCs (the round-trip amortization the
+    serving plane actually buys; acceptance ≥ 2.5x, gated in
+    ci/bench_cpu.py algo_smoke)."""
+    import asyncio
+
+    from gubernator_tpu.hashing import fingerprint
+    from gubernator_tpu.ops.batch import RequestColumns
+    from gubernator_tpu.ops.engine import LocalEngine
+
+    N = 1 << 12
+    out: dict = {"cascades": N}
+
+    def level_cols(tag, level, algo_v, n, t):
+        return RequestColumns(
+            fp=np.array(
+                [fingerprint("cph", f"{tag}{i}") for i in range(n)],
+                dtype=np.int64,
+            ),
+            algo=np.full(n, algo_v, dtype=np.int32),
+            behavior=np.full(n, level << 8, dtype=np.int32),
+            hits=np.ones(n, dtype=np.int64),
+            limit=np.full(n, 1 << 20, dtype=np.int64),
+            burst=np.zeros(n, dtype=np.int64),
+            duration=np.full(n, 3_600_000, dtype=np.int64),
+            created_at=np.full(n, t, dtype=np.int64),
+            err=np.zeros(n, dtype=np.int8),
+        )
+
+    def interleave(parts):
+        cols = [np.stack([p[k] for p in parts], axis=1).reshape(-1)
+                for k in range(len(parts[0]))]
+        return RequestColumns(*cols)
+
+    eng = LocalEngine(capacity=1 << 18, wire="compact")
+    u = lambda t: level_cols("u", 0, 0, N, t)
+    ten = lambda t: level_cols("t", 1, int(Algorithm.SLIDING_WINDOW), N, t)
+    gl = lambda t: level_cols("g", 2, int(Algorithm.GCRA), N, t)
+    casc = lambda t: interleave([u(t), ten(t), gl(t)])
+    # warm both shapes
+    eng.check_columns(casc(now), now_ms=now)
+    for f in (u, ten, gl):
+        eng.check_columns(f(now), now_ms=now)
+    K = 12
+
+    def wall(fn):
+        best = None
+        for r in range(3):
+            t0 = time.perf_counter()
+            for k in range(K):
+                fn(now + 1 + r * K + k)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
+    casc_s = wall(lambda t: eng.check_columns(casc(t), now_ms=t))
+    seq_s = wall(lambda t: [eng.check_columns(f(t), now_ms=t)
+                            for f in (u, ten, gl)])
+    d0 = eng.stats.dispatches
+    eng.check_columns(casc(now + 10_000_000), now_ms=now + 10_000_000)
+    out["engine_single_dispatch"] = int(eng.stats.dispatches - d0) == 1
+    out["engine_cascade_ms_per_batch"] = round(casc_s / K * 1e3, 3)
+    out["engine_sequential_ms_per_batch"] = round(seq_s / K * 1e3, 3)
+    out["engine_speedup"] = round(seq_s / max(casc_s, 1e-9), 3)
+
+    # ---- e2e rung: loopback daemon, dependent round trips
+    from gubernator_tpu.config import BehaviorConfig, DaemonConfig
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.service.daemon import Daemon
+
+    N_CHECKS, WORKERS = 384, 48
+
+    def creq(i, t):
+        r = pb.RateLimitReq(name="cph", unique_key=f"eu{i}", hits=1,
+                            limit=1 << 20, duration=3_600_000, created_at=t)
+        r.cascade.add(name="cph_t", unique_key=f"et{i % 16}", limit=1 << 20,
+                      duration=3_600_000, algorithm=pb.SLIDING_WINDOW)
+        r.cascade.add(name="cph_g", unique_key="all", limit=1 << 20,
+                      duration=3_600_000, algorithm=pb.GCRA)
+        return r
+
+    def sreqs(i, t):
+        return [
+            pb.RateLimitReq(name="cph", unique_key=f"eu{i}", hits=1,
+                            limit=1 << 20, duration=3_600_000, created_at=t),
+            pb.RateLimitReq(name="cph_t", unique_key=f"et{i % 16}", hits=1,
+                            limit=1 << 20, duration=3_600_000, created_at=t,
+                            algorithm=pb.SLIDING_WINDOW),
+            pb.RateLimitReq(name="cph_g", unique_key="all", hits=1,
+                            limit=1 << 20, duration=3_600_000, created_at=t,
+                            algorithm=pb.GCRA),
+        ]
+
+    async def run_e2e():
+        d = await Daemon.spawn(DaemonConfig(
+            grpc_address="127.0.0.1:0", http_address="",
+            cache_size=1 << 18,
+            behaviors=BehaviorConfig(batch_wait_ms=0.5),
+        ))
+
+        async def casc_worker(w, t):
+            for i in range(w, N_CHECKS, WORKERS):
+                await d.get_rate_limits_raw(pb.GetRateLimitsReq(
+                    requests=[creq(i, t)]).SerializeToString())
+
+        async def seq_worker(w, t):
+            for i in range(w, N_CHECKS, WORKERS):
+                for r in sreqs(i, t):
+                    await d.get_rate_limits_raw(pb.GetRateLimitsReq(
+                        requests=[r]).SerializeToString())
+
+        async def drive(worker, t):
+            t0 = time.perf_counter()
+            await asyncio.gather(*(worker(w, t) for w in range(WORKERS)))
+            return time.perf_counter() - t0
+
+        await drive(casc_worker, now)
+        await drive(seq_worker, now)
+        c = min([await drive(casc_worker, now + 20 + k) for k in range(3)])
+        s = min([await drive(seq_worker, now + 30 + k) for k in range(3)])
+        await d.close()
+        return c, s
+
+    e2e_c, e2e_s = asyncio.run(run_e2e())
+    out["e2e_cascade_checks_per_sec"] = round(N_CHECKS / e2e_c, 1)
+    out["e2e_sequential_checks_per_sec"] = round(N_CHECKS / e2e_s, 1)
+    out["e2e_speedup"] = round(e2e_s / max(e2e_c, 1e-9), 3)
+    out["e2e_accept_2_5x"] = bool(e2e_s / max(e2e_c, 1e-9) >= 2.5)
+    log(f"[cascade] engine {out['engine_speedup']}x, "
+        f"e2e {out['e2e_speedup']}x (accept >= 2.5x: "
+        f"{out['e2e_accept_2_5x']})")
+    return out
+
+
 def _attempt(label: str, fn, attempts: int = 2) -> dict:
     """Run one bench case, retrying ONCE on failure: the tunneled platform
     throws transient infra errors (observed: a remote_compile response cut
@@ -1677,6 +1915,18 @@ def main() -> None:
     matrix["durability"] = _attempt(
         "durability",
         lambda: durability_case(np.random.default_rng(52), now),
+    )
+
+    # scenario-breadth phases (ISSUE 10): per-algorithm device rates at
+    # headline geometry (GCRA >= token acceptance) + the cascade
+    # single-dispatch-vs-sequential ratio (docs/algorithms.md)
+    matrix["algorithms"] = _attempt(
+        "algorithms",
+        lambda: algorithms_case(np.random.default_rng(53), now),
+    )
+    matrix["cascade"] = _attempt(
+        "cascade",
+        lambda: cascade_case(np.random.default_rng(54), now),
     )
 
     # latency phase (sweep vs sparse vs xla device terms per table size);

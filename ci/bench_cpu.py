@@ -935,6 +935,232 @@ def durability_smoke() -> dict:
     return out
 
 
+def algo_smoke() -> dict:
+    """Scenario-breadth regression gate (ISSUE 10):
+
+    (a) **per-algorithm oracle parity at 1M live keys** — GCRA, sliding
+        window and concurrency leases must match the pure-Python oracles
+        decision-for-decision against a table already holding ~1M live
+        rows (the headline-geometry analog CI can afford);
+    (b) **cascade single-dispatch engaged** — an encodable 3-level cascade
+        batch rides the compact wire in ONE engine dispatch (zero
+        full-width fallbacks, in-trace verdict fold);
+    (c) **cascade-vs-sequential e2e ratio** — through a loopback daemon, N
+        3-level cascade checks (one RPC, one dispatch each) must clear
+        ≥ 2.5× the checks/s of the same N checks issued as three DEPENDENT
+        single-level round trips (the deployment pattern cascades replace).
+    """
+    import asyncio
+
+    from tests.oracle.algos import GcraOracle, LeaseOracle, SlidingWindowOracle
+
+    from gubernator_tpu.hashing import fingerprint
+    from gubernator_tpu.ops import wire as wire_mod
+    from gubernator_tpu.ops.batch import pack_columns
+    from gubernator_tpu.types import Algorithm
+
+    out: dict = {}
+    rng = np.random.default_rng(31)
+
+    def acols(fps, algo, hits, limit, dur, levels=None, now=NOW):
+        n = fps.shape[0]
+        return RequestColumns(
+            fp=fps.astype(np.int64),
+            algo=np.asarray(algo, dtype=np.int32) if np.ndim(algo) else
+            np.full(n, algo, dtype=np.int32),
+            behavior=np.array(
+                [lvl << 8 for lvl in (levels or [0] * n)], dtype=np.int32
+            ),
+            hits=np.asarray(hits, dtype=np.int64) if np.ndim(hits) else
+            np.full(n, hits, dtype=np.int64),
+            limit=np.full(n, limit, dtype=np.int64),
+            burst=np.zeros(n, dtype=np.int64),
+            duration=np.full(n, dur, dtype=np.int64),
+            created_at=np.full(n, now, dtype=np.int64),
+            err=np.zeros(n, dtype=np.int8),
+        )
+
+    # ---- (a) parity at ~1M live keys
+    eng = LocalEngine(capacity=1 << 20, write_mode="xla", wire="compact")
+    seed_fps = []
+    seed_b = 1 << 16
+    for i in range(16):  # ~1M distinct live rows, algorithm-striped
+        fps = rng.integers(1, (1 << 63) - 1, size=seed_b, dtype=np.int64)
+        seed_fps.append(fps)
+        algos = (np.arange(seed_b) % 4).astype(np.int32)
+        algos[algos == 1] = 4  # token/gcra/window/lease stripes (no leaky f64)
+        eng.check_columns(
+            acols(fps, 0, 1, 1 << 20, 3_600_000)._replace(algo=algos),
+            now_ms=NOW,
+        )
+    live = eng.live_count(now_ms=NOW)
+    out["seeded_live_keys"] = int(live)
+
+    oracles = {
+        int(Algorithm.GCRA): GcraOracle(),
+        int(Algorithm.SLIDING_WINDOW): SlidingWindowOracle(),
+        int(Algorithm.CONCURRENCY_LEASE): LeaseOracle(),
+    }
+    mismatches = 0
+    t = NOW
+    # parity keys from UNCONTESTED buckets: the near-capacity seed makes
+    # some buckets overflow their 8 slots, and GCRA/lease parity keys (exp
+    # near now by design) would be the soonest-expiring eviction victims —
+    # eviction behavior is the claim layer's contract (tests/test_kernel2),
+    # this gate pins the ALGORITHM math against the 1M-live geometry
+    NB = int(eng.table.rows.shape[0])
+    bucket_load = np.bincount(
+        (np.concatenate(seed_fps) % NB).astype(np.int64), minlength=NB
+    )
+
+    def calm_keys(a, want=512):
+        picked, i = [], 0
+        while len(picked) < want:
+            fp = fingerprint("algsm", f"{a}k{i}")
+            if bucket_load[fp % NB] <= 4:
+                picked.append(fp)
+            i += 1
+        return np.array(picked, dtype=np.int64)
+
+    keys = {a: calm_keys(a) for a in oracles}
+    for step in range(6):
+        t += int(rng.integers(100, 2_000))
+        for a, oracle in oracles.items():
+            hits = rng.integers(-2 if a == 4 else 0, 4, size=512)
+            rc = eng.check_columns(
+                acols(keys[a], a, hits, 16, 8_000, now=t), now_ms=t
+            )
+            for j in range(512):
+                st, rem, reset = oracle.check(
+                    int(keys[a][j]), t, int(hits[j]), 16, 8_000
+                )
+                if (int(rc.status[j]), int(rc.remaining[j]),
+                        int(rc.reset_time[j])) != (st, rem, reset):
+                    mismatches += 1
+    out["parity_mismatches"] = mismatches
+    if mismatches:
+        print(json.dumps({"error": "algo smoke: device/oracle parity "
+                          "mismatch at 1M keys", **out}))
+        sys.exit(1)
+
+    # ---- (b) cascade single-dispatch, compact wire, zero fallbacks
+    def cascade_batch(n_casc, now, tag="c"):
+        # distinct keys per level: the single-device engine host-plans
+        # duplicate (fp, level) groups into sequential passes for exact
+        # semantics — shared tenant/global keys aggregate to one dispatch
+        # on the mesh engines' in-trace dedup path (tests/test_algorithms
+        # test_same_level_cascade_rows_aggregate)
+        rows = []
+        for i in range(n_casc):
+            rows.extend([
+                (fingerprint("casc", f"{tag}u{i}"), 0, 0, 100),
+                (fingerprint("casc", f"{tag}t{i}"), int(Algorithm.SLIDING_WINDOW), 1, 10_000),
+                (fingerprint("casc", f"{tag}g{i}"), int(Algorithm.GCRA), 2, 1 << 20),
+            ])
+        n = len(rows)
+        return RequestColumns(
+            fp=np.array([r[0] for r in rows], dtype=np.int64),
+            algo=np.array([r[1] for r in rows], dtype=np.int32),
+            behavior=np.array([r[2] << 8 for r in rows], dtype=np.int32),
+            hits=np.ones(n, dtype=np.int64),
+            limit=np.array([r[3] for r in rows], dtype=np.int64),
+            burst=np.zeros(n, dtype=np.int64),
+            duration=np.full(n, 60_000, dtype=np.int64),
+            created_at=np.full(n, now, dtype=np.int64),
+            err=np.zeros(n, dtype=np.int8),
+        )
+
+    ceng = LocalEngine(capacity=1 << 15, write_mode="xla", wire="compact")
+    cb = cascade_batch(64, NOW)
+    hb, errs = pack_columns(cb, NOW)
+    enc = wire_mod.wire_encodable(hb, wire_mod.pick_base(hb))
+    d0 = ceng.stats.dispatches
+    rc = ceng.check_columns(cb, now_ms=NOW)
+    out["cascade_encodable"] = bool(enc)
+    out["cascade_dispatches"] = int(ceng.stats.dispatches - d0)
+    if not enc or ceng.stats.dispatches - d0 != 1 or rc.err.any():
+        print(json.dumps({"error": "algo smoke: encodable 3-level cascade "
+                          "did not resolve in one compact dispatch", **out}))
+        sys.exit(1)
+
+    # ---- (c) cascade vs three dependent sequential checks, e2e loopback
+    from gubernator_tpu.config import BehaviorConfig, DaemonConfig
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.service.daemon import Daemon
+
+    def creq(i, now):
+        r = pb.RateLimitReq(name="cas", unique_key=f"u{i}", hits=1,
+                            limit=1 << 20, duration=60_000, created_at=now)
+        r.cascade.add(name="cas_t", unique_key=f"t{i % 8}", limit=1 << 20,
+                      duration=60_000, algorithm=pb.SLIDING_WINDOW)
+        r.cascade.add(name="cas_g", unique_key="all", limit=1 << 20,
+                      duration=60_000, algorithm=pb.GCRA)
+        return r
+
+    def sreqs(i, now):
+        return [
+            pb.RateLimitReq(name="cas", unique_key=f"u{i}", hits=1,
+                            limit=1 << 20, duration=60_000, created_at=now),
+            pb.RateLimitReq(name="cas_t", unique_key=f"t{i % 8}", hits=1,
+                            limit=1 << 20, duration=60_000, created_at=now,
+                            algorithm=pb.SLIDING_WINDOW),
+            pb.RateLimitReq(name="cas_g", unique_key="all", hits=1,
+                            limit=1 << 20, duration=60_000, created_at=now,
+                            algorithm=pb.GCRA),
+        ]
+
+    N_CHECKS, WORKERS = 256, 32
+
+    async def run_e2e():
+        d = await Daemon.spawn(DaemonConfig(
+            grpc_address="127.0.0.1:0", http_address="",
+            cache_size=1 << 15,
+            behaviors=BehaviorConfig(batch_wait_ms=0.5),
+        ))
+
+        async def casc_worker(w, now):
+            for i in range(w, N_CHECKS, WORKERS):
+                data = pb.GetRateLimitsReq(
+                    requests=[creq(i, now)]
+                ).SerializeToString()
+                await d.get_rate_limits_raw(data)
+
+        async def seq_worker(w, now):
+            for i in range(w, N_CHECKS, WORKERS):
+                # three DEPENDENT round trips — each level waits for the
+                # previous verdict, the pattern a cascade replaces
+                for r in sreqs(i, now):
+                    data = pb.GetRateLimitsReq(
+                        requests=[r]
+                    ).SerializeToString()
+                    await d.get_rate_limits_raw(data)
+
+        async def wall(worker, now) -> float:
+            t0 = time.perf_counter()
+            await asyncio.gather(*(worker(w, now) for w in range(WORKERS)))
+            return time.perf_counter() - t0
+
+        # warm both shapes, then best-of-3 each
+        await wall(casc_worker, NOW)
+        await wall(seq_worker, NOW)
+        casc = min([await wall(casc_worker, NOW + 1 + k) for k in range(3)])
+        seq = min([await wall(seq_worker, NOW + 10 + k) for k in range(3)])
+        await d.close()
+        return casc, seq
+
+    casc_s, seq_s = asyncio.run(run_e2e())
+    ratio = seq_s / max(casc_s, 1e-9)
+    out["cascade_wall_s"] = round(casc_s, 4)
+    out["sequential_wall_s"] = round(seq_s, 4)
+    out["cascade_speedup"] = round(ratio, 2)
+    if ratio < 2.5:
+        print(json.dumps({"error": "algo smoke: 3-level cascade under 2.5x "
+                          "the checks/s of three sequential round trips",
+                          **out}))
+        sys.exit(1)
+    return out
+
+
 def main() -> None:
     eng = LocalEngine(capacity=1 << 15, write_mode="xla")
     rng = np.random.default_rng(0)
@@ -960,6 +1186,7 @@ def main() -> None:
         "telemetry_smoke": telemetry_smoke(),
         "mesh_smoke": mesh_smoke(),
         "durability_smoke": durability_smoke(),
+        "algo_smoke": algo_smoke(),
     }))
 
 

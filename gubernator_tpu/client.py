@@ -40,6 +40,16 @@ def to_pb(r: Union[RateLimitRequest, Dict, "pb.RateLimitReq"]) -> "pb.RateLimitR
     if r.metadata:
         for k, v in r.metadata.items():
             msg.metadata[k] = v
+    if getattr(r, "cascade", None):
+        for lvl in r.cascade:
+            msg.cascade.add(
+                name=lvl.name,
+                unique_key=lvl.unique_key,
+                limit=lvl.limit,
+                duration=lvl.duration,
+                algorithm=int(lvl.algorithm),
+                burst=lvl.burst,
+            )
     return msg
 
 

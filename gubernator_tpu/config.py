@@ -213,6 +213,11 @@ class DaemonConfig:
     # framing per 1000 rows). The rejection string keeps the reference's
     # exact wording either way.
     max_batch_size: int = 1000
+    # total limit levels (the request itself + its cascade entries) one
+    # cascaded check may carry (GUBER_CASCADE_MAX_LEVELS;
+    # docs/algorithms.md "Cascades"). Cascades up to 4 levels ride the
+    # compact wire; deeper ones fall back to the full-width grids.
+    cascade_max_levels: int = 8
     cache_size: int = 50_000  # CacheSize (config.go:151) → table capacity
     # auto-grow: double the device table when live keys pass 60% of capacity
     # (0 = fixed size like the reference's LRU; >0 = growth ceiling in slots)
@@ -439,6 +444,11 @@ class DaemonConfig:
             raise ConfigError("GUBER_BATCH_COALESCE_LIMIT must be positive")
         if self.max_batch_size <= 0:
             raise ConfigError("GUBER_MAX_BATCH_SIZE must be positive")
+        if not (2 <= self.cascade_max_levels <= 256):
+            raise ConfigError(
+                "GUBER_CASCADE_MAX_LEVELS must be in [2, 256] (the level "
+                "field is 8 bits)"
+            )
         if self.behaviors.front_workers < 0:
             raise ConfigError("GUBER_FRONT_WORKERS must be >= 0 (0 = auto)")
         if self.behaviors.batch_close_rows < 0:
@@ -522,6 +532,7 @@ def setup_daemon_config(
         data_center=_get(env, "GUBER_DATA_CENTER", ""),
         instance_id=_get(env, "GUBER_INSTANCE_ID", ""),
         max_batch_size=_get_int(env, "GUBER_MAX_BATCH_SIZE", 1000),
+        cascade_max_levels=_get_int(env, "GUBER_CASCADE_MAX_LEVELS", 8),
         cache_size=_get_int(env, "GUBER_CACHE_SIZE", 50_000),
         cache_max_size=_get_int(env, "GUBER_CACHE_MAX_SIZE", 0),
         engine=_get(env, "GUBER_ENGINE", "local"),

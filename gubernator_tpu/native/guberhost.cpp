@@ -158,13 +158,24 @@ enum { ERR_OK = 0, ERR_EMPTY_KEY = 1, ERR_EMPTY_NAME = 2 };
 // 5-lane int32 ingress row IN THE SAME PASS so the serving path can stage a
 // dispatch grid without ever materializing per-column int64 arrays; the
 // created_at delta (lane 4 bits 18-29) is left zero — the flush loop ORs it
-// in once the batch base is known.
-static const int64_t WIRE_DUR_MASK = (1LL << 30) - 1;   // ops/wire.DUR_BITS
+// in once the batch base is known. Lane 3 is duration[0:27] | algo << 27
+// (3 bits — five in-kernel algorithms) | cascade_level << 30; the parser
+// always emits level 0 (cascade requests take the pb path — see field 11
+// below).
+static const int64_t WIRE_DUR_MASK = (1LL << 27) - 1;   // ops/wire.DUR_BITS
 static const int64_t WIRE_HITS_MASK = (1LL << 18) - 1;  // ops/wire.HITS_BITS
 static const int64_t WIRE_I32_MAX = 2147483647LL;
 // RESET_REMAINING | DRAIN_OVER_LIMIT | kernel-inert bits (ops/wire.py
 // _ENCODABLE_BEHAVIOR); anything else (Gregorian, unknown) → full-width
 static const int32_t WIRE_ENC_BEHAVIOR = 8 | 32 | 1 | 2 | 16;
+// known client-facing behavior flag bits (types.Behavior values 1..32) —
+// anything above is masked at ingress: the behavior word's high bits carry
+// the INTERNAL cascade level (types.CASCADE_LEVEL_SHIFT), which clients
+// must not be able to forge
+static const int32_t BEHAVIOR_CLIENT_MASK = 63;
+// highest algorithm enum this build speaks (types.MAX_ALGORITHM); larger
+// values are per-item errors on the full path, so never fused
+static const int32_t MAX_ALGORITHM = 4;
 
 struct Item {
   const uint8_t* name = nullptr; size_t name_len = 0;
@@ -172,6 +183,7 @@ struct Item {
   const uint8_t* traceparent = nullptr; size_t traceparent_len = 0;
   int64_t hits = 0, limit = 0, duration = 0, burst = 0, created_at = 0;
   int32_t algorithm = 0, behavior = 0;
+  bool has_cascade = false;  // repeated CascadeLevel cascade = 11 present
   size_t start = 0, len = 0;  // byte span of the item message in the input
 };
 
@@ -231,6 +243,11 @@ static bool parse_item(Cursor& c, Item& it) {
         break;
       }
       case 10: it.created_at = (int64_t)c.varint(); break;
+      case 11:  // repeated CascadeLevel cascade — flag it; the daemon
+                // materializes the pb item and expands the levels itself
+        it.has_cascade = true;
+        if (!c.skip(wt)) return false;
+        break;
       default:
         if (!c.skip(wt)) return false;
     }
@@ -240,12 +257,14 @@ static bool parse_item(Cursor& c, Item& it) {
 
 // parse_get_rate_limits(data: bytes)
 //   -> (n, fp, algo, behavior, hits, limit, burst, duration, created_at,
-//       err, ring_hash, spans, traceparent, lanes, enc)
+//       err, ring_hash, spans, traceparent, lanes, enc, casc)
 // Buffer layouts (np.frombuffer): fp/hits/limit/burst/duration/created_at
 // int64; algo/behavior int32; err int8; ring_hash uint32; spans int64 pairs
 // (start, len) of each item's bytes for lazy pb materialization; lanes a
 // (5, n) row-major int32 pre-packed compact-wire image (ops/wire.py lanes,
-// created-delta field zero); enc int8 per-item compact-wire encodability.
+// created-delta field zero); enc int8 per-item compact-wire encodability;
+// casc int8 per-item "carries a cascade field" flag (such batches take the
+// pb path, where the daemon expands the levels).
 // The scan + fill loops run with the GIL RELEASED — N front-door workers
 // parse concurrently (service/daemon.py door pool).
 static PyObject* parse_get_rate_limits(PyObject*, PyObject* args) {
@@ -300,7 +319,7 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* args) {
     tp = Py_None;
     Py_INCREF(Py_None);
   }
-  PyObject* out = PyTuple_New(15);
+  PyObject* out = PyTuple_New(16);
   PyObject* fp_b = PyBytes_FromStringAndSize(nullptr, n * 8);
   PyObject* algo_b = PyBytes_FromStringAndSize(nullptr, n * 4);
   PyObject* beh_b = PyBytes_FromStringAndSize(nullptr, n * 4);
@@ -314,8 +333,10 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* args) {
   PyObject* span_b = PyBytes_FromStringAndSize(nullptr, n * 16);
   PyObject* lanes_b = PyBytes_FromStringAndSize(nullptr, n * 5 * 4);
   PyObject* enc_b = PyBytes_FromStringAndSize(nullptr, n);
+  PyObject* casc_b = PyBytes_FromStringAndSize(nullptr, n);
   if (!out || !fp_b || !algo_b || !beh_b || !hits_b || !lim_b || !burst_b ||
-      !dur_b || !ca_b || !err_b || !ring_b || !span_b || !lanes_b || !enc_b) {
+      !dur_b || !ca_b || !err_b || !ring_b || !span_b || !lanes_b || !enc_b ||
+      !casc_b) {
     PyBuffer_Release(&buf);
     Py_XDECREF(out);
     return nullptr;
@@ -333,13 +354,17 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* args) {
   int64_t* span = (int64_t*)PyBytes_AS_STRING(span_b);
   int32_t* lanes = (int32_t*)PyBytes_AS_STRING(lanes_b);
   int8_t* enc = (int8_t*)PyBytes_AS_STRING(enc_b);
+  int8_t* casc = (int8_t*)PyBytes_AS_STRING(casc_b);
 
   Py_BEGIN_ALLOW_THREADS;
   std::string hk;
   for (size_t i = 0; i < n; i++) {
     const Item& it = items[i];
     algo[i] = it.algorithm;
-    beh[i] = it.behavior;
+    // client-facing flag bits only: the high bits are the internal cascade
+    // level field, which must never arrive from the wire
+    beh[i] = it.behavior & BEHAVIOR_CLIENT_MASK;
+    casc[i] = it.has_cascade ? 1 : 0;
     hits[i] = it.hits;
     lim[i] = it.limit;
     burst[i] = it.burst;
@@ -367,13 +392,17 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* args) {
     // flush loop checks it). Validation-error fields (|limit|/|burst|
     // beyond int32) ALSO fall back: the full path turns them into
     // per-item errors the fused path has no pack stage to produce.
-    bool e = (it.behavior & ~WIRE_ENC_BEHAVIOR) == 0 &&
+    bool e = (beh[i] & ~WIRE_ENC_BEHAVIOR) == 0 &&
              it.duration >= 0 && it.duration <= WIRE_DUR_MASK &&
              it.hits >= 0 && it.hits <= WIRE_HITS_MASK &&
              it.limit >= 0 && it.limit <= WIRE_I32_MAX &&
              it.burst >= -WIRE_I32_MAX && it.burst <= WIRE_I32_MAX &&
-             (it.algorithm == 0 || it.algorithm == 1) &&
-             (it.algorithm == 0 || it.burst == 0);
+             (it.algorithm >= 0 && it.algorithm <= MAX_ALGORITHM) &&
+             // burst lane rules: token ignores burst; leaky/GCRA default
+             // burst 0 → limit in-trace (explicit bursts → full-width);
+             // window/lease never read burst (keep 0 for byte fidelity)
+             (it.algorithm == 0 || it.burst == 0) &&
+             !it.has_cascade;
     enc[i] = e ? 1 : 0;
     // pre-packed 5-lane int32 row (ops/wire.pack_wire_rows layout);
     // lane 4's created-delta bits stay 0 until the flush stamps them
@@ -383,7 +412,7 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* args) {
     lanes[2 * n + i] = (int32_t)it.limit;
     lanes[3 * n + i] = (int32_t)(uint32_t)(
         ((uint64_t)(it.duration & WIRE_DUR_MASK)) |
-        ((uint64_t)(uint32_t)it.algorithm << 30));
+        ((uint64_t)(uint32_t)it.algorithm << 27));
     uint32_t l4 = (uint32_t)(it.hits & WIRE_HITS_MASK);
     if (it.behavior & 8) l4 |= 1u << 30;   // RESET_REMAINING
     if (it.behavior & 32) l4 |= 1u << 31;  // DRAIN_OVER_LIMIT
@@ -407,6 +436,7 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* args) {
   PyTuple_SET_ITEM(out, 12, tp);
   PyTuple_SET_ITEM(out, 13, lanes_b);
   PyTuple_SET_ITEM(out, 14, enc_b);
+  PyTuple_SET_ITEM(out, 15, casc_b);
   return out;
 }
 

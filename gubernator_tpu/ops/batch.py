@@ -34,6 +34,12 @@ CREATED_AT_TOLERANCE_MS = 5 * 60 * 1000
 _created_at_tolerance_ms = CREATED_AT_TOLERANCE_MS
 
 
+def _max_algorithm() -> int:
+    from gubernator_tpu.types import MAX_ALGORITHM
+
+    return MAX_ALGORITHM
+
+
 def set_created_at_tolerance_ms(ms: int) -> None:
     """Configure the accepted client clock skew (GUBER_CREATED_AT_TOLERANCE).
     Replayed/queued traffic with legitimately old timestamps can raise it."""
@@ -143,6 +149,16 @@ ERR_LIMIT_I32 = 3
 ERR_BURST_I32 = 4
 ERR_GREGORIAN = 5
 ERR_DROPPED = 6
+# forward-compat: an `algorithm` enum value this build doesn't speak (a
+# NEWER peer's request in a mixed-version cluster) is a per-item error row,
+# never a failed batch — the reference isolates invalid items the same way
+# (gubernator.go:215-237) and its algorithm switch rejects unknown values
+# with this wording
+ERR_ALGORITHM = 7
+# a cascade request carrying more levels than GUBER_CASCADE_MAX_LEVELS —
+# the daemon parameterizes the message with the configured cap
+# (service/wire.cascade_too_deep_error); this entry is the generic default
+ERR_CASCADE_DEEP = 8
 
 # wording parity with the reference where it has fixed strings
 # (gubernator.go:215-224); ERR_DROPPED is this design's own failure mode
@@ -154,6 +170,8 @@ ERROR_STRINGS = {
     ERR_BURST_I32: "field 'burst' must fit int32",
     ERR_GREGORIAN: "invalid gregorian duration",
     ERR_DROPPED: "rate limit state could not be persisted (contended table); retry",
+    ERR_ALGORITHM: "invalid rate limit algorithm",
+    ERR_CASCADE_DEEP: "cascade levels list too large",
 }
 
 
@@ -210,11 +228,25 @@ def pack_columns(
         (cols.burst > INT32_MAX) | (cols.burst < -INT32_MAX)
     )
     err[bad_burst] = ERR_BURST_I32
+    # forward-compat: unknown algorithm enum values (a newer peer's traffic)
+    # become per-item "invalid rate limit algorithm" rows, never a failed
+    # batch and never a silent fall-through into some other algorithm's math
+    from gubernator_tpu.types import MAX_ALGORITHM
+
+    bad_algo = (err == ERR_OK) & (
+        (cols.algo < 0) | (cols.algo > MAX_ALGORITHM)
+    )
+    err[bad_algo] = ERR_ALGORITHM
 
     created = np.where(cols.created_at == 0, now_ms, cols.created_at)
     created = np.clip(created, now_ms - tol, now_ms + tol)
-    leaky = cols.algo == int(Algorithm.LEAKY_BUCKET)
-    burst = np.where(leaky & (cols.burst == 0), cols.limit, cols.burst)
+    # burst defaults to limit for the tolerance-shaped algorithms: leaky
+    # (reference algorithms.go:259-261) and GCRA, whose delay-variation
+    # tolerance tau = T·burst degenerates to "deny everything" at burst 0
+    bursty = (cols.algo == int(Algorithm.LEAKY_BUCKET)) | (
+        cols.algo == int(Algorithm.GCRA)
+    )
+    burst = np.where(bursty & (cols.burst == 0), cols.limit, cols.burst)
 
     expire_new = created + cols.duration
     greg_interval = np.zeros(n, dtype=np.int64)
@@ -355,6 +387,9 @@ def pack_requests(
         if not (-INT32_MAX <= r.burst <= INT32_MAX):
             errors[i] = "field 'burst' must fit int32"
             continue
+        if not (0 <= int(r.algorithm) <= _max_algorithm()):
+            errors[i] = ERROR_STRINGS[ERR_ALGORITHM]
+            continue
         created = r.created_at if r.created_at is not None and r.created_at != 0 else now_ms
         if created > now_ms + tol:
             created = now_ms + tol
@@ -367,7 +402,10 @@ def pack_requests(
         b.limit[i] = r.limit
         b.duration[i] = r.duration
         b.created_at[i] = created
-        if int(r.algorithm) == Algorithm.LEAKY_BUCKET and r.burst == 0:
+        if (
+            int(r.algorithm) in (Algorithm.LEAKY_BUCKET, Algorithm.GCRA)
+            and r.burst == 0
+        ):
             b.burst[i] = r.limit
         else:
             b.burst[i] = r.burst

@@ -88,11 +88,70 @@ def _occurrence_rank(fps: np.ndarray) -> np.ndarray:
 
 
 def _math_mode(hb: HostBatch) -> str:
-    """Static kernel specialization chosen host-side per dispatch: an
-    all-token batch (the common case — token is the reference's default
-    algorithm) compiles the decision graph without the emulated-f64 leaky
-    lanes (ops/math.bucket_math). Padding rows carry algo=0 (token)."""
-    return "mixed" if hb.algo.any() else "token"
+    """Static kernel specialization chosen host-side per dispatch
+    (ops/math.bucket_math): an all-token batch (the common case — token is
+    the reference's default algorithm) compiles ONLY the token lanes;
+    GCRA / sliding-window / lease rows add the all-integer lanes; only a
+    leaky row forces the emulated-f64 graph. Padding rows carry algo=0
+    (token)."""
+    algo = hb.algo
+    if not algo.any():
+        return "token"
+    if (algo == 1).any():
+        return "mixed"
+    # all-GCRA specialization (headline single-algorithm traffic): only
+    # the TAT lanes compile. Padding rows carry algo=0, so the check masks
+    # to ACTIVE rows — inactive rows ride the gcra lanes harmlessly.
+    act = algo[np.asarray(hb.active)]
+    if act.size and (act == 2).all():
+        return "gcra"
+    return "int"
+
+
+def _has_cascade(hb) -> bool:
+    """Whether a packed batch carries cascade level bits (behavior bits
+    8-15, types.CASCADE_LEVEL_SHIFT)."""
+    return bool((hb.behavior & np.int32(0xFF00)).any())
+
+
+def _fold_cascades_host(
+    behavior: np.ndarray,
+    status: np.ndarray,
+    remaining: np.ndarray,
+    reset: np.ndarray,
+    err: np.ndarray,
+) -> None:
+    """Host-side cascade verdict fold over assembled response columns:
+    each carrier row (level 0) takes deny-if-any status, min remaining and
+    the latest reset among denying levels of its group (members = the
+    level>0 rows immediately following it). IDEMPOTENT over an already
+    in-trace-folded carrier (kernel2.fold_cascade_packed), which is what
+    lets it run unconditionally as the authoritative fold — it completes
+    partial folds left by multi-pass plans, dropped-row retries and the
+    mesh programs (whose routed/exchanged row order cannot fold in-trace).
+    Rows with validation errors are excluded from the reductions; arrays
+    mutate in place."""
+    lvl = (behavior.astype(np.int64) >> 8) & 0xFF
+    if not lvl.any():
+        return
+    n = lvl.shape[0]
+    member = lvl > 0
+    idx = np.arange(n)
+    carrier = np.maximum.accumulate(np.where(~member, idx, -1))
+    carrier = np.where(carrier < 0, idx, carrier)
+    ok = err == 0
+    mrows = np.nonzero(member & ok)[0]
+    if mrows.size == 0:
+        return
+    c = carrier[mrows]
+    np.maximum.at(status, c, status[mrows])
+    np.minimum.at(remaining, c, remaining[mrows])
+    deny = mrows[status[mrows] != 0]
+    if deny.size:
+        deny_reset = np.zeros(n, dtype=reset.dtype)
+        np.maximum.at(deny_reset, carrier[deny], reset[deny])
+        crows = np.nonzero(~member & ok & (status != 0))[0]
+        reset[crows] = np.maximum(reset[crows], deny_reset[crows])
 
 
 @dataclass
@@ -148,10 +207,12 @@ def _plan(engine, hb):
 def serve_columns(engine, cols, now_ms, dispatch) -> ResponseColumns:
     """The shared columns-in/columns-out serving loop: pack + clamp-count,
     plan same-key passes, dispatch each (member-row fan-out, ERR_DROPPED for
-    unpersisted rows), fire the Store hooks. `dispatch(pass_batch, n_rows)`
-    returns (status, limit, remaining, reset, dropped, cache_hit) over the
-    pass rows — the only thing that differs between the single-device and
-    mesh engines."""
+    unpersisted rows), fold cascade verdicts, fire the Store hooks.
+    `dispatch(pass_batch, n_rows, cascade=False)` returns (status, limit,
+    remaining, reset, dropped, cache_hit) over the pass rows — the only
+    thing that differs between the single-device and mesh engines;
+    `cascade` asks for the in-trace verdict fold (single-device engines
+    honor it, mesh engines ignore it and lean on the host fold)."""
     now = now_ms if now_ms is not None else ms_now()
     hb, err = pack_columns(cols, now, tolerance_ms=engine.created_at_tolerance_ms)
     engine.stats.created_at_clamped += int(
@@ -162,9 +223,19 @@ def serve_columns(engine, cols, now_ms, dispatch) -> ResponseColumns:
     limit_o = np.zeros(n, dtype=np.int64)
     remaining = np.zeros(n, dtype=np.int64)
     reset = np.zeros(n, dtype=np.int64)
-    for pi, p in enumerate(_plan(engine, hb)):
+    passes = _plan(engine, hb)
+    has_casc = _has_cascade(hb)
+    # the in-trace cascade fold needs the whole batch in one dispatch
+    # (carrier adjacency) AND an engine whose program preserves row order;
+    # multi-pass plans and mesh engines rely on the idempotent host fold
+    # below instead
+    casc_intrace = (
+        has_casc and len(passes) == 1
+        and getattr(engine, "supports_cascade_intrace", False)
+    )
+    for pi, p in enumerate(passes):
         np_ = len(p.rows)
-        outs = dispatch(p.batch, np_)
+        outs = dispatch(p.batch, np_, cascade=casc_intrace)
         if pi == 0 and engine.store is not None:
             # cache miss → consult the store and re-apply against hydrated
             # state (reference algorithms.go:45-51). Only pass 0 can miss:
@@ -214,6 +285,10 @@ def serve_columns(engine, cols, now_ms, dispatch) -> ResponseColumns:
                     stamp=hb.created_at[keep],
                 )
             )
+    if has_casc:
+        # authoritative fold AFTER the Store hook (the store records each
+        # KEY's own state; only the carrier's RESPONSE takes the verdict)
+        _fold_cascades_host(hb.behavior, status, remaining, reset, err)
     return ResponseColumns(
         status=status, limit=limit_o, remaining=remaining,
         reset_time=reset, err=err,
@@ -278,9 +353,13 @@ class PendingCheck:
 
     __slots__ = (
         "hb", "err", "now", "passes", "clamped", "stacked", "rows", "mark",
+        "casc", "casc_intrace",
     )
 
-    def __init__(self, hb, err, now, passes, clamped, rows=None, mark=None):
+    def __init__(
+        self, hb, err, now, passes, clamped, rows=None, mark=None,
+        casc=False, casc_intrace=False,
+    ):
         self.stacked = None  # same-shape pass outputs fused for ONE fetch
         self.hb = hb
         self.err = err
@@ -294,6 +373,12 @@ class PendingCheck:
         # the launches, so a dirtied block can never fall between epochs
         # (ops/checkpoint.py ordering contract)
         self.mark = mark
+        # cascade bookkeeping: `casc` = the batch carries level bits;
+        # `casc_intrace` = the dispatches fold verdicts in-trace (single
+        # pass), so the finish half only re-folds host-side after a
+        # dropped-row retry invalidated a carrier
+        self.casc = casc
+        self.casc_intrace = casc_intrace
 
 
 class _LazyWireBatch:
@@ -400,12 +485,19 @@ def prepare_check_wire(engine, parts, now_ms=None) -> "PendingCheck | None":
     grid = wire_mod.assemble_wire_grid(
         [p.lanes for p in parts], clipped, base, pad, active
     )
-    staged = engine.stage_wire(grid, wire_mod.grid_math_mode(grid, n))
+    # cascade batches normally take the pb path (the native parser routes
+    # them there), but an engine-level caller may assemble level-bit lanes
+    # directly — the unique-fp contract above makes them single-pass, so
+    # the in-trace fold is always sound here
+    casc = wire_mod.grid_has_cascade(grid, n)
+    staged = engine.stage_wire(
+        grid, wire_mod.grid_math_mode(grid, n), cascade=casc
+    )
     lazy = _LazyWireBatch(cols_list, now, tol, pad)
     p = Pass(rows=np.arange(n), batch=lazy, member_rows=[])
     return PendingCheck(
         hb=lazy, err=err, now=now, passes=[[p, n, lazy, staged]],
-        clamped=clamped, rows=n, mark=act_fp,
+        clamped=clamped, rows=n, mark=act_fp, casc=casc, casc_intrace=casc,
     )
 
 
@@ -430,13 +522,20 @@ def prepare_check_columns(engine, cols, now_ms=None) -> PendingCheck:
     clamped = int(
         ((cols.created_at != 0) & (hb.created_at != cols.created_at)).sum()
     )
+    plan = _plan(engine, hb)
+    casc = _has_cascade(hb)
+    casc_intrace = (
+        casc and len(plan) == 1
+        and getattr(engine, "supports_cascade_intrace", False)
+    )
     passes = []
-    for p in _plan(engine, hb):
+    for p in plan:
         n = len(p.rows)
-        batch, staged = engine.stage_pass(p.batch, n)
+        batch, staged = engine.stage_pass(p.batch, n, cascade=casc_intrace)
         passes.append([p, n, batch, staged])
     return PendingCheck(
-        hb=hb, err=err, now=now, passes=passes, clamped=clamped, mark=hb.fp
+        hb=hb, err=err, now=now, passes=passes, clamped=clamped, mark=hb.fp,
+        casc=casc, casc_intrace=casc_intrace,
     )
 
 
@@ -525,6 +624,7 @@ def finish_check_columns(
     remaining = np.zeros(n, dtype=np.int64)
     reset = np.zeros(n, dtype=np.int64)
     delta = EngineStats(created_at_clamped=pending.clamped, checks=n)
+    retried_any = False
     for pi, (p, np_, batch, pend) in enumerate(pending.passes):
         (s, l, r, t, dropped, hit), st, uncounted = engine.finish_staged(
             pend, np_
@@ -538,6 +638,7 @@ def finish_check_columns(
             # contended-claim retries mutate the table → engine thread;
             # _redispatch_rows counts dispatches/evictions only, exactly
             # like the sync path's retry loop
+            retried_any = True
             rows = np.nonzero(dropped)[0]
 
             def retry(rows=rows, batch=batch, uncounted=uncounted):
@@ -568,6 +669,15 @@ def finish_check_columns(
             remaining[rows] = r[:np_]
             reset[rows] = t[:np_]
             err[rows[dropped[:np_]]] = ERR_DROPPED
+    if pending.casc and (retried_any or not pending.casc_intrace):
+        # the in-trace fold (when it ran) predates any dropped-row retry;
+        # the idempotent host fold makes the carriers authoritative again.
+        # Fused wire batches materialize their HostBatch only on this rare
+        # path (cascade batch AND a claim drop).
+        hbm = pending.hb
+        if not isinstance(hbm, HostBatch):
+            hbm = hbm._materialize()
+        _fold_cascades_host(hbm.behavior, status, remaining, reset, err)
     rc = ResponseColumns(
         status=status, limit=limit_o, remaining=remaining,
         reset_time=reset, err=err,
@@ -649,11 +759,13 @@ class LocalEngine:
         if self.ckpt is not None:
             self.ckpt.mark(np.asarray(fps))
 
-    def _decide_packed(self, hb: HostBatch) -> np.ndarray:
+    def _decide_packed(self, hb: HostBatch, cascade: bool = False) -> np.ndarray:
         """One dispatch → ONE host transfer each way: compact 5-lane int32
         wire block (or full packed (12, B) ingress) in, compact int32 (or
         packed (B+2, 4) i64) output fetched. Updates self.table; returns
-        the host array (unpack_outputs dispatches on its dtype)."""
+        the host array (unpack_outputs dispatches on its dtype). `cascade`
+        compiles the in-trace verdict fold into the dispatch (single-pass
+        batches with level bits only — the fold needs carrier adjacency)."""
         self._mark_dirty(hb.fp)
         if self._decide_fn is not None:
             # oracle engines return unpacked outputs; pack on device for the
@@ -662,7 +774,9 @@ class LocalEngine:
             return np.asarray(pack_outputs(resp, stats))
         dev, wired = self._stage_ingress(hb)
         return np.asarray(
-            self._issue_from_dev(dev, int(hb.fp.shape[0]), _math_mode(hb), wired)
+            self._issue_from_dev(
+                dev, int(hb.fp.shape[0]), _math_mode(hb), wired, cascade
+            )
         )
 
     def _stage_ingress(self, batch: HostBatch):
@@ -685,7 +799,8 @@ class LocalEngine:
         return jax.device_put(pack_host_batch(batch)), False
 
     def _issue_from_dev(
-        self, dev_arr, batch_rows: int, math: str, wired: bool = False
+        self, dev_arr, batch_rows: int, math: str, wired: bool = False,
+        cascade: bool = False,
     ) -> "jax.Array":
         """Issue one dispatch from a staged ingress array WITHOUT fetching:
         the table advances immediately; the packed output is fetched later
@@ -694,11 +809,13 @@ class LocalEngine:
             from gubernator_tpu.ops.wire import decide2_wire_cols
 
             self.table, packed = decide2_wire_cols(
-                self.table, dev_arr, write=self.write_mode, math=math
+                self.table, dev_arr, write=self.write_mode, math=math,
+                cascade=cascade,
             )
             return packed
         self.table, packed = decide2_packed_cols(
-            self.table, dev_arr, write=self.write_mode, math=math
+            self.table, dev_arr, write=self.write_mode, math=math,
+            cascade=cascade,
         )
         return packed
 
@@ -707,12 +824,21 @@ class LocalEngine:
     # (fetch thread); the packed single-transfer layout stays private to the
     # engine so mesh engines can substitute routed grids (parallel/sharded.py).
 
-    def stage_pass(self, pass_batch: HostBatch, n: int):
-        """(padded batch, staged ingress array + static math/wire modes)
-        for one unique-fp pass."""
+    def stage_pass(self, pass_batch: HostBatch, n: int, cascade: bool = False):
+        """(padded batch, staged ingress array + static math/wire/cascade
+        modes) for one unique-fp pass."""
         batch = pad_batch(pass_batch, _pad_size(n))
         dev, wired = self._stage_ingress(batch)
-        return batch, (dev, _math_mode(batch), wired)
+        return batch, (dev, _math_mode(batch), wired, cascade)
+
+    @property
+    def supports_cascade_intrace(self) -> bool:
+        """Single-device dispatches preserve batch row order, so the
+        kernel-side cascade fold (fold_cascade_packed) is sound here; mesh
+        engines route/exchange rows and leave the fold to the host
+        (_fold_cascades_host). Oracle engines (decide_fn) predate the
+        packed entries and never fold in-trace."""
+        return self._decide_fn is None
 
     @property
     def supports_wire_ingress(self) -> bool:
@@ -723,18 +849,18 @@ class LocalEngine:
         per-shard grids the front door cannot pre-assemble."""
         return self.wire == "compact" and self._decide_fn is None
 
-    def stage_wire(self, grid: np.ndarray, math: str):
+    def stage_wire(self, grid: np.ndarray, math: str, cascade: bool = False):
         """Stage a fused front-door grid (ops/wire.assemble_wire_grid
-        output) — same staged triple as stage_pass's, issued by
+        output) — same staged tuple as stage_pass's, issued by
         issue_staged unchanged."""
         import jax
 
-        return jax.device_put(grid), math, True
+        return jax.device_put(grid), math, True, cascade
 
     def issue_staged(self, staged, batch_rows: int):
-        dev, math, wired = staged
+        dev, math, wired, cascade = staged
         self._seen_pad_sizes.add(batch_rows)
-        return self._issue_from_dev(dev, batch_rows, math, wired)
+        return self._issue_from_dev(dev, batch_rows, math, wired, cascade)
 
     def finish_staged(self, pending, n: int):
         """Materialize one pass's packed output → ((s, l, r, t, dropped,
@@ -822,20 +948,20 @@ class LocalEngine:
         Per-request validation errors come back as ERR_* codes instead of
         failing the batch (reference gubernator.go:215-237)."""
 
-        def dispatch(pass_batch, n_rows: int):
+        def dispatch(pass_batch, n_rows: int, cascade: bool = False):
             batch = pad_batch(pass_batch, _pad_size(n_rows))
-            return self._dispatch_with_retry(batch, n_rows)
+            return self._dispatch_with_retry(batch, n_rows, cascade)
 
         return serve_columns(self, cols, now_ms, dispatch)
 
-    def _dispatch_with_retry(self, batch, n: int):
+    def _dispatch_with_retry(self, batch, n: int, cascade: bool = False):
         """Run one unique-fp pass; rows the claim auction dropped (contended
         bucket within a single dispatch) are re-dispatched — the decision is
         only authoritative once persisted. Rows still unpersisted after
         `max_claim_retries` surface a per-item error (`ERR_NOT_PERSISTED`)."""
         self._seen_pad_sizes.add(int(batch.fp.shape[0]))
         (status, limit, remaining, reset, dropped, hit), st = unpack_outputs(
-            self._decide_packed(batch), n
+            self._decide_packed(batch, cascade), n
         )
         self.stats.cache_hits += st[0]
         self.stats.cache_misses += st[1]
@@ -1081,14 +1207,16 @@ class LocalEngine:
             self.ckpt = self.ckpt.rebuild(self.table.rows.shape[0])
         # warm compiles for the new geometry with all-inactive dummy batches
         # (no state mutation — _decide_packed counts nothing itself, and all
-        # rows are inactive). Both static math variants warm: algo=0 rows
-        # compile the token graph, a leaky row the mixed one (_math_mode).
+        # rows are inactive). Three static math variants warm: algo=0 rows
+        # compile the token graph, a GCRA-marked row the all-integer one, a
+        # leaky row the mixed one (_math_mode; the all-GCRA "gcra" variant
+        # needs an ACTIVE row, so a rare pure-GCRA batch right after a
+        # resize pays its own compile).
         for size in sorted(self._seen_pad_sizes):
             z64 = np.zeros(size, dtype=np.int64)
-            for leaky in (False, True):
+            for probe_algo in (0, 2, 1):
                 algo = np.zeros(size, dtype=np.int32)
-                if leaky:
-                    algo[0] = 1
+                algo[0] = probe_algo
                 dummy = HostBatch(
                     fp=z64, algo=algo,
                     behavior=np.zeros(size, dtype=np.int32), hits=z64,

@@ -29,9 +29,12 @@ per 131K-row dispatch on v5e) with the design measured fastest on real TPU
      GUBER_WRITE_SPARSE_CROSSOVER). XLA scatter fallback (`write="xla"`)
      keeps identical semantics for CPU meshes/tests.
 
-Dispatches are additionally specialized host-side by `math="token"|"mixed"`
-(engine._math_mode): all-token batches — the common case — compile a decision
-graph with no emulated-float64 leaky lanes (see ops/math.bucket_math).
+Dispatches are additionally specialized host-side by
+`math="token"|"int"|"mixed"` (engine._math_mode): all-token batches — the
+common case — compile a decision graph with ONLY the token lanes; batches
+mixing in GCRA / sliding-window / concurrency-lease rows compile the
+all-integer graph; only a leaky row forces the emulated-float64 lanes
+(see ops/math.bucket_math).
 
 Same decision semantics as v1 (reference algorithms.go:37-492 via
 ops/math.py). Documented divergence from v1: slot-vacancy uses the exact
@@ -637,18 +640,34 @@ def decide2_impl(
         exp=s_exp,
         rem_f=jax.lax.bitcast_convert_type(g(REMF_HI), f32).astype(f64)
         + jax.lax.bitcast_convert_type(g(REMF_LO), f32).astype(f64),
+        # the SAME lane pair reinterpreted as a raw int64 — GCRA's TAT and
+        # the sliding window's previous count live here (ops/math.py
+        # storage convention); dead code (DCE'd) under math="token"
+        aux=_join64(g(REMF_LO), g(REMF_HI)),
     )
-    d = bucket_math(stored, req, exists, token_only=math == "token")
+    d = bucket_math(stored, req, exists, mode=math)
 
     # ---- build update payload rows
     sat32 = lambda x: jnp.clip(x, -(2**31), 2**31 - 1).astype(i32)
+    # REMF lane pair by algorithm family: zeros for token-only batches,
+    # the raw aux int64 (GCRA TAT / window prev) for int batches, and the
+    # leaky f64 split merged in per row for mixed ones
     if math == "token":
-        # token items store no fractional remainder — skip the f64 split
-        remf_hi = jnp.zeros(B, dtype=f32)
-        remf_lo = jnp.zeros(B, dtype=f32)
+        remf_hi_i = jnp.zeros(B, dtype=i32)
+        remf_lo_i = jnp.zeros(B, dtype=i32)
+    elif math in ("gcra", "int"):
+        remf_hi_i = _hi32(d.aux_out)
+        remf_lo_i = _lo32(d.aux_out)
     else:
-        remf_hi = d.rem_f_out.astype(f32)
-        remf_lo = (d.rem_f_out - remf_hi.astype(f64)).astype(f32)
+        f_hi = d.rem_f_out.astype(f32)
+        f_lo = (d.rem_f_out - f_hi.astype(f64)).astype(f32)
+        is_leaky = req.algo == 1
+        remf_hi_i = jnp.where(
+            is_leaky, jax.lax.bitcast_convert_type(f_hi, i32), _hi32(d.aux_out)
+        )
+        remf_lo_i = jnp.where(
+            is_leaky, jax.lax.bitcast_convert_type(f_lo, i32), _lo32(d.aux_out)
+        )
     my_lo = _lo32(req.fp)
     my_hi = _hi32(req.fp)
     zero = jnp.zeros_like(my_lo)
@@ -666,8 +685,8 @@ def decide2_impl(
             _hi32(d.stamp_out),
             jnp.where(d.remove, 0, _lo32(d.exp_out)),
             jnp.where(d.remove, 0, _hi32(d.exp_out)),
-            jax.lax.bitcast_convert_type(remf_hi, i32),
-            jax.lax.bitcast_convert_type(remf_lo, i32),
+            remf_hi_i,
+            remf_lo_i,
             zero,
             zero,
         ],
@@ -799,16 +818,25 @@ def req_from_arr(arr: jnp.ndarray) -> ReqBatch:
 
 
 def decide2_packed_cols_impl(
-    table: Table2, arr: jnp.ndarray, *, write: str = "sweep", math: str = "mixed"
+    table: Table2, arr: jnp.ndarray, *, write: str = "sweep",
+    math: str = "mixed", cascade: bool = False,
 ) -> Tuple[Table2, jnp.ndarray]:
     """Single-transfer serving entry: packed ingress array in, packed
     output array out — one host→device put and one device→host fetch per
-    dispatch regardless of column count."""
-    return decide2_packed_impl(table, req_from_arr(arr), write=write, math=math)
+    dispatch regardless of column count. `cascade=True` folds cascade
+    groups' combined verdicts into their carrier rows in-trace (set by the
+    engine for order-preserving single-device dispatches whose batch
+    carries level bits — see fold_cascade_packed)."""
+    table, packed = decide2_packed_impl(
+        table, req_from_arr(arr), write=write, math=math
+    )
+    if cascade:
+        packed = fold_cascade_packed(packed, arr)
+    return table, packed
 
 
 decide2_packed_cols = functools.partial(
-    jax.jit, donate_argnums=(0,), static_argnames=("write", "math")
+    jax.jit, donate_argnums=(0,), static_argnames=("write", "math", "cascade")
 )(decide2_packed_cols_impl)
 
 
@@ -834,19 +862,31 @@ decide2_packed_cols = functools.partial(
 # dedup="device" ≍ plan_passes(max_exact=1)).
 
 RESET_REMAINING_BIT = 8  # Behavior.RESET_REMAINING (shared with ops/plan.py)
+# cascade level field of the behavior word (types.CASCADE_LEVEL_SHIFT): the
+# discriminator that keeps two LEVELS of one cascade from aggregating even
+# when their keys collide on a fingerprint — dedup groups on (fp, level),
+# and same-(fp, level) rows across different cascades still aggregate
+# (tenant/global levels of many users' cascades collapse to one kernel row)
+CASCADE_LEVEL_SHIFT = 8
 
 
 def dedup_packed_cols(arr: jnp.ndarray):
-    """Aggregate duplicate fingerprints of a packed (12, n) ingress array
-    in-trace. Returns (deduped arr, carrier, member):
+    """Aggregate duplicate (fingerprint, cascade-level) groups of a packed
+    (12, n) ingress array in-trace. Returns (deduped arr, carrier, member):
 
-    * deduped arr — same shape/order; each key's CARRIER row (its newest
+    * deduped arr — same shape/order; each group's CARRIER row (its newest
       member, plan_passes' config rule) stays active carrying the summed
       hits and OR-ed RESET_REMAINING bit; all other duplicates are
       deactivated (fp→0) so the kernel sees unique fingerprints;
     * carrier — (n,) i32, each row's carrier index (itself when unique);
     * member — (n,) bool, active rows whose response must be fanned out
       from their carrier (fanout_packed).
+
+    Keying on (fp, level) instead of fp alone is what keeps the cascade
+    machinery sound under key collisions: a user-level key that collides
+    with a tenant-level key of the SAME cascade stays two kernel rows (they
+    then conflict in the claim and the loser retries — sequential
+    semantics), instead of silently merging two different limit configs.
     """
     fp = arr[0]
     active = arr[11] != 0
@@ -855,9 +895,15 @@ def dedup_packed_cols(arr: jnp.ndarray):
     # inactive rows key to 0 (below every real fp, hashing.py keeps fps ≥ 1):
     # they sort into one leading segment that no active row can join
     key = jnp.where(active, fp, i64(0))
-    key_s, idx_s = jax.lax.sort((key, idx), num_keys=1)
+    lvl = jnp.where(
+        active, (arr[2] >> CASCADE_LEVEL_SHIFT) & 0xFF, i64(0)
+    ).astype(i32)
+    key_s, lvl_s, idx_s = jax.lax.sort((key, lvl, idx), num_keys=2)
     first = jnp.concatenate(
-        [jnp.ones((1,), dtype=bool), key_s[1:] != key_s[:-1]]
+        [
+            jnp.ones((1,), dtype=bool),
+            (key_s[1:] != key_s[:-1]) | (lvl_s[1:] != lvl_s[:-1]),
+        ]
     )
     seg = jnp.cumsum(first.astype(i32)) - 1
     act_s = active[idx_s]
@@ -905,18 +951,92 @@ def fanout_packed(
     return jnp.concatenate([rows, packed[n:]], axis=0)
 
 
+# ------------------------------------------------------- cascade fold
+#
+# A CASCADE request expands into one row per limit level at the front door
+# (level 0 = the carrier, levels ≥ 1 = member rows immediately following it
+# — types.CASCADE_LEVEL_SHIFT). Every level is evaluated independently by
+# the kernel in the SAME launch; the fold below then computes the combined
+# verdict in-trace: the carrier row's status becomes OVER if ANY level
+# denied, its remaining the minimum across levels, and its reset the
+# latest reset among denying levels — while member rows keep their own
+# per-level response (the "per-level remaining/reset" the response
+# surfaces). This is the dedup/FLAG_MEMBER carrier machinery run in the
+# opposite direction: members fold INTO their carrier's verdict instead of
+# reading from it.
+#
+# The fold requires rows in ORIGINAL batch order (carrier adjacency), so it
+# runs only in order-preserving traces — the single-device entries below
+# with cascade=True, staged by the engine when the batch carries level bits.
+# Mesh programs (routed/exchanged row order) skip it; the engine's shared
+# response assembly applies the same fold host-side there
+# (ops/engine._fold_cascades_host), and that host fold is idempotent over
+# an already-folded carrier, so the two layers compose.
+
+
+def cascade_groups(arr: jnp.ndarray):
+    """(carrier, member) from a packed ingress array's behavior level bits.
+    member rows are level > 0 regardless of activity (an errored member
+    must not break its group's adjacency chain); carrier[i] is the nearest
+    preceding level-0 row (itself for carriers/standalone rows)."""
+    level = (arr[2] >> CASCADE_LEVEL_SHIFT) & 0xFF
+    n = arr.shape[1]
+    idx = jnp.arange(n, dtype=i32)
+    member = level > 0
+    carrier = _cummax(jnp.where(~member, idx, i32(-1)))
+    # a leading orphan member (malformed input) folds onto itself
+    carrier = jnp.where(carrier < 0, idx, carrier).astype(i32)
+    return carrier, member
+
+
+def fold_cascade_packed(packed: jnp.ndarray, arr: jnp.ndarray) -> jnp.ndarray:
+    """Fold each cascade group's per-level verdicts into its carrier row of
+    the packed (n+2, 4) output array: status OR (deny-if-any), remaining
+    min, reset = latest reset among denying levels (the retry-after bound)
+    when any level denies. Inactive rows (validation errors) are excluded
+    from the reductions; member rows are untouched."""
+    n = arr.shape[1]
+    carrier, member = cascade_groups(arr)
+    active = arr[11] != 0
+    rows = packed[:n]
+    flags = rows[:, 3]
+    status = jnp.where(active, flags & i64(FLAG_STATUS), i64(0))
+    over = jax.ops.segment_max(status, carrier, num_segments=n)
+    big = jnp.int64(2**62)
+    rem = jnp.where(active, rows[:, 1], big)
+    rem_min = jax.ops.segment_min(rem, carrier, num_segments=n)
+    deny_reset = jnp.where(active & (status != 0), rows[:, 2], i64(0))
+    reset_max = jax.ops.segment_max(deny_reset, carrier, num_segments=n)
+    is_carrier = ~member & active
+    new_flags = jnp.where(is_carrier, flags | over, flags)
+    new_rem = jnp.where(
+        is_carrier & (rem_min < big), jnp.minimum(rows[:, 1], rem_min), rows[:, 1]
+    )
+    new_reset = jnp.where(
+        is_carrier & (over != 0), jnp.maximum(rows[:, 2], reset_max), rows[:, 2]
+    )
+    rows = jnp.stack([rows[:, 0], new_rem, new_reset, new_flags], axis=1)
+    return jnp.concatenate([rows, packed[n:]], axis=0)
+
+
 def decide2_packed_dedup_impl(
-    table: Table2, arr: jnp.ndarray, *, write: str = "sweep", math: str = "mixed"
+    table: Table2, arr: jnp.ndarray, *, write: str = "sweep",
+    math: str = "mixed", cascade: bool = False,
 ) -> Tuple[Table2, jnp.ndarray]:
     """Single-transfer serving entry with IN-TRACE duplicate aggregation:
     raw (possibly duplicate-keyed) packed ingress in, packed outputs out
     with member rows answered from their aggregation carrier. The mesh
     engines build their per-device programs on this when dedup="device"
     (parallel/sharded.py, parallel/a2a.py), which lets the host skip
-    plan_passes entirely (ops/plan.single_pass)."""
+    plan_passes entirely (ops/plan.single_pass). `cascade=True`
+    additionally folds cascade groups' verdicts into their carriers
+    (order-preserving traces only — see fold_cascade_packed)."""
     ded, carrier, member = dedup_packed_cols(arr)
     table, packed = decide2_packed_cols_impl(table, ded, write=write, math=math)
-    return table, fanout_packed(packed, carrier, member, arr.shape[1])
+    packed = fanout_packed(packed, carrier, member, arr.shape[1])
+    if cascade:
+        packed = fold_cascade_packed(packed, arr)
+    return table, packed
 
 
 # -------------------------------------------------------------------- install
@@ -940,19 +1060,44 @@ def install2_impl(
     c = _probe_claim2(table.rows, inst.fp, inst.now, inst.active, blk, u)
 
     is_token = inst.algo == int(Algorithm.TOKEN_BUCKET)
-    rem_i = jnp.where(is_token, inst.remaining, i64(0))
-    rem_f = jnp.where(is_token, f64(0.0), inst.remaining.astype(f64))
-    burst = jnp.where(is_token, i64(0), inst.burst)
+    is_leaky = inst.algo == int(Algorithm.LEAKY_BUCKET)
+    is_gcra = inst.algo == int(Algorithm.GCRA)
+    is_win = inst.algo == int(Algorithm.SLIDING_WINDOW)
+    # REM_I is remaining-style for every integer algorithm (ops/math.py
+    # storage convention), so the wire rebuild installs `remaining`
+    # verbatim for token, sliding-window and lease rows; only leaky keeps
+    # its float lane and GCRA its TAT.
+    rem_i = jnp.where(is_leaky | is_gcra, i64(0), inst.remaining)
+    rem_f = jnp.where(is_leaky, inst.remaining.astype(f64), f64(0.0))
+    # GCRA: with the wire rebuild's burst == limit, reset_time IS the
+    # authoritative TAT (tau = limit·T ⇒ reset = tat, ops/math.py) — the
+    # owner's verdict rebuilds exactly. Sliding window: the previous-window
+    # count has no wire field; 0 is the permissive rebuild, tightened by
+    # the next owner broadcast (same spirit as the reference's Burst=Limit
+    # lossy rebuild, gubernator.go:434-474).
+    aux = jnp.where(is_gcra, inst.reset_time, i64(0))
+    burst = jnp.where(is_token | is_win, i64(0), inst.burst)
     # expiry: token items expire at their authoritative reset (ExpireAt =
     # CreatedAt + Duration = reset, store.go:29-35); leaky items at
     # stamp + duration (UpdatedAt basis, cache.go:35-40) — NOT reset_time,
     # whose leaky meaning (createdAt + (limit-rem)*rate) can lie in the past
-    # for a near-full bucket and would expire the install on arrival
-    exp = jnp.where(is_token, inst.reset_time, inst.stamp + inst.duration)
+    # for a near-full bucket and would expire the install on arrival. GCRA
+    # state self-expires at its TAT (= reset); window/lease keep the
+    # stamp + duration rule (window interpolation state is rebuilt fresh,
+    # lease reset_time == expiry by construction).
+    exp = jnp.where(
+        is_token | is_gcra, inst.reset_time, inst.stamp + inst.duration
+    )
     flags = inst.algo | (inst.status << 8)
     sat32 = lambda x: jnp.clip(x, -(2**31), 2**31 - 1).astype(i32)
-    remf_hi = rem_f.astype(f32)
-    remf_lo = (rem_f - remf_hi.astype(f64)).astype(f32)
+    remf_hi_f = rem_f.astype(f32)
+    remf_lo_f = (rem_f - remf_hi_f.astype(f64)).astype(f32)
+    remf_hi = jnp.where(
+        is_leaky, jax.lax.bitcast_convert_type(remf_hi_f, i32), _hi32(aux)
+    )
+    remf_lo = jnp.where(
+        is_leaky, jax.lax.bitcast_convert_type(remf_lo_f, i32), _lo32(aux)
+    )
     zero = jnp.zeros((B,), dtype=i32)
     new16 = jnp.stack(
         [
@@ -968,8 +1113,8 @@ def install2_impl(
             _hi32(inst.stamp),
             _lo32(exp),
             _hi32(exp),
-            jax.lax.bitcast_convert_type(remf_hi, i32),
-            jax.lax.bitcast_convert_type(remf_lo, i32),
+            remf_hi,
+            remf_lo,
             zero,
             zero,
         ],
@@ -1003,11 +1148,14 @@ def merge2_impl(
     merge can only ever TIGHTEN admission — the invariant that makes a
     retried, duplicated, or crossed transfer unable to grant extra capacity:
 
-      * remaining  = min(stored, incoming)   (integer and leaky-float lanes)
+      * remaining  = min(stored, incoming)   (integer and leaky-float lanes;
+        REM_I is remaining-style for every integer algorithm, so min
+        uniformly tightens)
+      * raw aux lane (GCRA TAT / sliding-window prev count) = max — a later
+        TAT or larger previous count can only deny more
       * expiry     = max(stored, incoming)   (state lives at least as long)
       * OVER_LIMIT sticks (status = max)
       * config (limit/burst/duration/algo) — newest stamp wins
-      * stamp      = max(stored, incoming)
 
     Absent keys install the incoming slot verbatim (claim/evict machinery
     shared with install2). Incoming rows already expired at the receiver's
@@ -1051,17 +1199,47 @@ def merge2_impl(
     status = jnp.where(
         exists, jnp.maximum(i_flags >> 8, s_flags >> 8), i_flags >> 8
     )
+    # REM_I is remaining-style for EVERY integer algorithm (ops/math.py
+    # storage convention: token remaining, limit-current for sliding
+    # windows, limit-inflight for leases), so min is uniformly the
+    # tightening direction here
     rem_i = jnp.where(exists, jnp.minimum(g_i(REM_I), g_s(REM_I)), g_i(REM_I))
     to_f64 = lambda g: (
         jax.lax.bitcast_convert_type(g(REMF_HI), f32).astype(f64)
         + jax.lax.bitcast_convert_type(g(REMF_LO), f32).astype(f64)
     )
     rem_f = jnp.where(exists, jnp.minimum(to_f64(g_i), to_f64(g_s)), to_f64(g_i))
+    # the raw-int REMF pair (GCRA TAT / sliding-window previous count): the
+    # tightening direction is MAX — a later theoretical arrival time or a
+    # larger previous-window count can only deny more. Replaying a STALE
+    # checkpoint frame (smaller TAT) therefore under-grants, never over.
+    # When the two sides disagree on the algorithm the config winner's raw
+    # value is kept verbatim (cross-algorithm arithmetic is meaningless);
+    # the float lane keeps its historical unconditional min, which for a
+    # same-algo leaky pair is the conservative direction and for an algo
+    # flip is "legitimately tighter than serving" (docs/durability.md).
+    to_aux = lambda g: _join64(g(REMF_LO), g(REMF_HI))
+    s_aux, i_aux = to_aux(g_s), to_aux(g_i)
+    same_algo = exists & ((s_flags & 0xFF) == (i_flags & 0xFF))
+    aux = jnp.where(
+        same_algo,
+        jnp.maximum(s_aux, i_aux),
+        jnp.where(keep_stored, s_aux, i_aux),
+    )
     exp = jnp.where(exists, jnp.maximum(s_exp, i_exp), i_exp)
     stamp = jnp.where(exists, jnp.maximum(s_stamp, i_stamp), i_stamp)
 
-    remf_hi = rem_f.astype(f32)
-    remf_lo = (rem_f - remf_hi.astype(f64)).astype(f32)
+    remf_hi_f = rem_f.astype(f32)
+    remf_lo_f = (rem_f - remf_hi_f.astype(f64)).astype(f32)
+    from gubernator_tpu.types import Algorithm as _Algo
+
+    aux_algo = (algo == int(_Algo.GCRA)) | (algo == int(_Algo.SLIDING_WINDOW))
+    remf_hi = jnp.where(
+        aux_algo, _hi32(aux), jax.lax.bitcast_convert_type(remf_hi_f, i32)
+    )
+    remf_lo = jnp.where(
+        aux_algo, _lo32(aux), jax.lax.bitcast_convert_type(remf_lo_f, i32)
+    )
     zero = jnp.zeros((B,), dtype=i32)
     new16 = jnp.stack(
         [
@@ -1077,8 +1255,8 @@ def merge2_impl(
             _hi32(stamp),
             _lo32(exp),
             _hi32(exp),
-            jax.lax.bitcast_convert_type(remf_hi, i32),
-            jax.lax.bitcast_convert_type(remf_lo, i32),
+            remf_hi,
+            remf_lo,
             zero,
             zero,
         ],

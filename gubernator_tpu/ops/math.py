@@ -1,11 +1,17 @@
-"""Branchless token/leaky bucket decision math — shared by both kernel
-generations (ops/kernel.py v1 f32-carrier planes, ops/kernel2.py v2 packed
-rows).
+"""Branchless decision math for every in-kernel algorithm — shared by both
+kernel generations (ops/kernel.py v1 f32-carrier planes, ops/kernel2.py v2
+packed rows).
 
-This is the exact decision table of the reference's algorithms.go, expressed
-as masked vector arithmetic over per-row stored state + request fields. All
-file:line citations are /root/reference/algorithms.go unless noted. The
-deliberate divergences are documented in ops/kernel2.py's module docstring.
+Token and leaky bucket are the exact decision tables of the reference's
+algorithms.go, expressed as masked vector arithmetic over per-row stored
+state + request fields; all file:line citations are
+/root/reference/algorithms.go unless noted. GCRA, sliding-window counters
+and concurrency leases are this repo's extensions (docs/algorithms.md has
+the per-algorithm derivations); GCRA follows the ATM Forum virtual-
+scheduling formulation as popularized by brandur/throttled (one
+theoretical-arrival-time compare-and-advance per row, integer-ms exact).
+The deliberate divergences are documented in ops/kernel2.py's module
+docstring.
 """
 
 from __future__ import annotations
@@ -26,13 +32,30 @@ class StoredState(NamedTuple):
 
     limit: jnp.ndarray  # int64
     burst: jnp.ndarray  # int64
-    rem_i: jnp.ndarray  # int64 (token remaining)
+    rem_i: jnp.ndarray  # int64 (remaining-style integer lane; see below)
     algo: jnp.ndarray  # int32
     status: jnp.ndarray  # int32
     duration: jnp.ndarray  # int64
-    stamp: jnp.ndarray  # int64 (CreatedAt / UpdatedAt)
+    stamp: jnp.ndarray  # int64 (CreatedAt / UpdatedAt; window start for
+    # SLIDING_WINDOW rows)
     exp: jnp.ndarray  # int64 (ExpireAt, ms exact)
-    rem_f: jnp.ndarray  # float64 (leaky remaining)
+    rem_f: jnp.ndarray  # float64 (leaky remaining — REMF lane pair as f32+f32)
+    # int64 (REMF lane pair RAW: GCRA theoretical arrival time;
+    # SLIDING_WINDOW previous-window count; 0 otherwise). Defaults to None
+    # for legacy token/leaky-only callers (the v1 oracle kernel), which is
+    # treated as all-zeros.
+    aux: jnp.ndarray = None
+
+
+# Integer-lane storage convention (docs/algorithms.md "State layout"): the
+# REM_I lane always stores a REMAINING-style value — token remaining,
+# sliding-window `limit - current_count`, lease `limit - inflight` — so the
+# conservative-merge rule `remaining = min` (kernel2.merge2) and the
+# checkpoint-replay bound tighten admission for EVERY algorithm without
+# per-algo cases on the merge's integer lane. The REMF pair is algorithm-
+# typed: leaky splits its float64 remainder into two f32 lanes; GCRA and
+# sliding-window store a raw int64 (TAT / previous-window count) in the
+# same two cells — `aux` above.
 
 
 class Decision(NamedTuple):
@@ -47,7 +70,9 @@ class Decision(NamedTuple):
     exp_out: jnp.ndarray  # int64
     burst_out: jnp.ndarray  # int64
     flags_out: jnp.ndarray  # int32 (algo | status << 8)
-    remove: jnp.ndarray  # bool — slot is removed (token RESET_REMAINING)
+    remove: jnp.ndarray  # bool — slot is removed (RESET_REMAINING)
+    aux_out: jnp.ndarray  # int64 — raw REMF pair writeback (GCRA TAT /
+    # sliding-window previous count; 0 for token/leaky/lease)
     # response
     resp_status: jnp.ndarray  # int32
     resp_rem: jnp.ndarray  # int64
@@ -55,24 +80,38 @@ class Decision(NamedTuple):
 
 
 def bucket_math(
-    s: StoredState, req, exists: jnp.ndarray, *, token_only: bool = False
+    s: StoredState, req, exists: jnp.ndarray, *, mode: str = "mixed"
 ) -> Decision:
     """One decision per row. `req` is a ReqBatch (ops/batch.py); `exists` marks
     rows whose slot held a live matching item (lazy-expiry already applied).
 
-    `token_only` is a STATIC specialization: the leaky path runs on float64,
-    which TPUs emulate in software, and the branchless merge pays that for
-    every row even in all-token traffic. The serving engine checks the
-    batch's algorithms host-side (free) and dispatches the token-only graph
-    — no leaky lanes, no f64 ops — when no leaky row is present. A runtime
-    `lax.cond` was measured WORSE than the branchless merge (+~2.6 ms at
-    131K rows): the HLO conditional materializes its operand tuple (the
-    gathered slots among them) and blocks fusion across the boundary."""
-    return _bucket_math_impl(s, req, exists, token_only=token_only)
+    `mode` is a STATIC specialization picked host-side per dispatch
+    (engine._math_mode):
+
+    * "token" — every row is a token bucket (the common case): no other
+      algorithm's lanes are traced, and in particular no emulated-float64
+      op is emitted.
+    * "gcra" — every ACTIVE row is GCRA: only the TAT compare-and-advance
+      lanes are traced (padding rows carry algo=0 and ride them harmlessly
+      — inactive rows are never written or counted). The single-algorithm
+      specialization that makes GCRA's smaller decision table actually
+      pay at the headline geometry.
+    * "int" — token + GCRA + sliding-window + lease lanes (all int64), but
+      no leaky float64 path.
+    * "mixed" — everything, including the leaky f64 lanes TPUs emulate in
+      software.
+
+    A runtime `lax.cond` was measured WORSE than the branchless merge
+    (+~2.6 ms at 131K rows): the HLO conditional materializes its operand
+    tuple (the gathered slots among them) and blocks fusion across the
+    boundary."""
+    if mode not in ("token", "gcra", "int", "mixed"):
+        raise ValueError(f"unknown math mode {mode!r}")
+    return _bucket_math_impl(s, req, exists, mode=mode)
 
 
 def _bucket_math_impl(
-    s: StoredState, req, exists: jnp.ndarray, *, token_only: bool
+    s: StoredState, req, exists: jnp.ndarray, *, mode: str
 ) -> Decision:
     now = req.created_at
     is_greg = (req.behavior & int(Behavior.DURATION_IS_GREGORIAN)) != 0
@@ -86,10 +125,80 @@ def _bucket_math_impl(
     # algorithms", go:96-105,307-317).
     algo_match = exists & (s.algo == req.algo)
 
-    # ==================================================== token bucket
-    # reference algorithms.go:37-252
     OVER = jnp.int32(int(Status.OVER_LIMIT))
     UNDER = jnp.int32(int(Status.UNDER_LIMIT))
+
+    # ==================================================== GCRA
+    # Virtual scheduling (ATM Forum / brandur-throttled formulation), all
+    # int64 ms arithmetic over ONE stored field — the theoretical arrival
+    # time (TAT, StoredState.aux). Emission interval T = duration/limit;
+    # tolerance tau = T·burst (burst defaults to limit at pack, so tau ≈
+    # duration). A request of h hits advances TAT by h·T from max(TAT, now)
+    # and conforms iff the advanced TAT stays within tau of now. State is
+    # self-expiring: once now ≥ TAT the bucket is indistinguishable from a
+    # fresh one, so ExpireAt = TAT and TTL eviction reclaims exactly the
+    # rows whose state no longer matters (docs/algorithms.md "GCRA").
+    # Factored out because it serves TWO static modes: the all-GCRA
+    # specialization below (only these lanes traced — the headline
+    # single-algorithm graph) and the int/mixed merges further down.
+    def gcra_lanes():
+        s_aux = s.aux if s.aux is not None else jnp.zeros_like(s.stamp)
+        g_T = jnp.maximum(req.duration // jnp.maximum(req.limit, 1), i64(1))
+        g_tau = g_T * req.burst
+        # fresh/expired/switched-algo rows behave as TAT = now — the
+        # new-item rule and the existing-item rule are the same
+        # compare-and-advance
+        g_tat0 = jnp.maximum(jnp.where(algo_match, s_aux, now), now)
+        g_tat1 = g_tat0 + h * g_T
+        g_deny = (h > 0) & (g_tat1 - g_tau > now)
+        # deny: rejected hits don't advance (unless DRAIN_OVER_LIMIT, which
+        # consumes the whole tolerance — the "drain to empty" analog of
+        # token's remaining=0 rule)
+        g_tat_out = jnp.where(
+            g_deny, jnp.where(is_drain, now + g_tau, g_tat0), g_tat1
+        )
+        g_rem = jnp.clip((now + g_tau - g_tat_out) // g_T, 0, req.burst)
+        # fully-available time; with the default burst == limit this is
+        # exactly the TAT (tau = limit·T), mirroring token's "reset =
+        # window expiry"
+        g_reset = g_tat_out - g_tau + g_T * req.limit
+        g_status = jnp.where(g_deny, OVER, UNDER)
+        # RESET_REMAINING removes the item outright and reports a full
+        # bucket (token semantics, go:82-94)
+        g_rm = exists & is_reset
+        return dict(
+            tat=g_tat_out,
+            exp=jnp.maximum(g_tat_out, now),
+            status=g_status,
+            remove=g_rm,
+            resp_status=jnp.where(g_rm, UNDER, g_status),
+            resp_rem=jnp.where(g_rm, req.burst, g_rem),
+            resp_reset=jnp.where(g_rm, i64(0), g_reset),
+        )
+
+    if mode == "gcra":
+        # every active row is GCRA (engine._math_mode): no token lanes, no
+        # f64, no window/lease arithmetic — padding rows (algo=0) ride the
+        # TAT lanes harmlessly (never written, never counted)
+        g = gcra_lanes()
+        return Decision(
+            status_out=g["status"],
+            rem_i_out=jnp.zeros_like(s.rem_i),
+            rem_f_out=jnp.zeros_like(s.rem_f),
+            stamp_out=jnp.broadcast_to(now, s.stamp.shape),
+            dur_out=req.duration,
+            exp_out=g["exp"],
+            burst_out=req.burst,
+            flags_out=req.algo | (g["status"] << 8),
+            remove=g["remove"],
+            aux_out=g["tat"],
+            resp_status=g["resp_status"],
+            resp_rem=g["resp_rem"],
+            resp_reset=g["resp_reset"],
+        )
+
+    # ==================================================== token bucket
+    # reference algorithms.go:37-252
 
     # --- existing item (go:107-194)
     # limit change: add the delta to remaining, clamp at 0 (go:108-115)
@@ -145,8 +254,8 @@ def _bucket_math_impl(
     tok_resp_rem = jnp.where(tok_reset_rm, req.limit, tok_resp_rem)
     tok_resp_reset = jnp.where(tok_reset_rm, i64(0), tok_resp_reset)
 
-    if token_only:
-        # all request rows are token buckets: the leaky lanes of the merge
+    if mode == "token":
+        # all request rows are token buckets: every other algorithm's lanes
         # collapse to constants and no float64 op is emitted on this branch
         zero_f = jnp.zeros_like(s.rem_f)
         return Decision(
@@ -159,9 +268,122 @@ def _bucket_math_impl(
             burst_out=jnp.zeros_like(s.burst),
             flags_out=req.algo | (tok_status_out << 8),
             remove=tok_reset_rm,
+            aux_out=jnp.zeros_like(s.stamp),
             resp_status=tok_resp_status,
             resp_rem=tok_resp_rem,
             resp_reset=tok_resp_reset,
+        )
+
+    # ==================================================== GCRA (shared
+    # lanes — see gcra_lanes above)
+    s_aux = s.aux if s.aux is not None else jnp.zeros_like(s.stamp)
+    _g = gcra_lanes()
+    g_tat_out, g_exp, g_status = _g["tat"], _g["exp"], _g["status"]
+    g_reset_rm = _g["remove"]
+    g_resp_status, g_resp_rem, g_resp_reset = (
+        _g["resp_status"], _g["resp_rem"], _g["resp_reset"]
+    )
+
+    # ==================================================== sliding window
+    # Previous+current window interpolation (docs/algorithms.md "Sliding
+    # window"): windows align to duration boundaries (ws = now - now % dur);
+    # the stored stamp is the window start, REM_I stores limit - current
+    # count (remaining-style — see the storage convention above) and the
+    # previous window's count rides the aux lane. The previous window
+    # contributes pro-rata for the fraction of it the sliding window still
+    # covers; deny iff weighted_prev + current + h > limit.
+    w_dur = jnp.maximum(req.duration_eff, i64(1))
+    w_ws = now - now % w_dur
+    w_elapsed = now - w_ws
+    w_same = algo_match & (s.stamp == w_ws)
+    w_roll1 = algo_match & (s.stamp == w_ws - w_dur)
+    w_cur_s = s.limit - s.rem_i  # stored count, decoded from remaining-style
+    w_prev = jnp.where(w_same, s_aux, jnp.where(w_roll1, w_cur_s, i64(0)))
+    w_cur = jnp.where(w_same, w_cur_s, i64(0))
+    w_used = w_cur + (w_prev * (w_dur - w_elapsed)) // w_dur
+    w_deny = (h > 0) & (w_used + h > req.limit)
+    w_take = jnp.where(w_deny & ~is_drain, i64(0), h)
+    w_cur_out = w_cur + w_take
+    w_rem = jnp.clip(req.limit - (w_used + w_take), 0, req.limit)
+    w_reset = w_ws + w_dur
+    w_status = jnp.where(w_deny, OVER, UNDER)
+    w_reset_rm = exists & is_reset
+    w_resp_status = jnp.where(w_reset_rm, UNDER, w_status)
+    w_resp_rem = jnp.where(w_reset_rm, req.limit, w_rem)
+    w_resp_reset = jnp.where(w_reset_rm, i64(0), w_reset)
+
+    # ==================================================== concurrency lease
+    # Inflight acquire/release (docs/algorithms.md "Concurrency leases"):
+    # hits > 0 acquires that many leases (deny iff inflight + h > limit),
+    # hits < 0 releases (clamped at zero), hits == 0 queries. REM_I stores
+    # limit - inflight (remaining-style). Acquires refresh ExpireAt to
+    # now + duration; a slot that expires reclaims every outstanding lease
+    # — the table's TTL eviction IS the abandoned-lease reclamation.
+    l_inflight_s = jnp.where(algo_match, s.limit - s.rem_i, i64(0))
+    l_deny = (h > 0) & (l_inflight_s + h > req.limit)
+    l_take = jnp.where(l_deny & ~is_drain, i64(0), h)
+    l_inflight = jnp.maximum(l_inflight_s + l_take, i64(0))
+    l_refresh = (h > 0) & ~(l_deny & ~is_drain)
+    l_exp = jnp.where(
+        algo_match & ~l_refresh, s.exp, now + req.duration_eff
+    )
+    l_rem = jnp.clip(req.limit - l_inflight, 0, req.limit)
+    l_status = jnp.where(l_deny, OVER, UNDER)
+    l_reset_rm = exists & is_reset
+    l_resp_status = jnp.where(l_reset_rm, UNDER, l_status)
+    l_resp_rem = jnp.where(l_reset_rm, req.limit, l_rem)
+    l_resp_reset = jnp.where(l_reset_rm, i64(0), l_exp)
+
+    # ------------------------------------------------ int-algo select masks
+    is_gcra = req.algo == int(Algorithm.GCRA)
+    is_win = req.algo == int(Algorithm.SLIDING_WINDOW)
+    is_lease = req.algo == int(Algorithm.CONCURRENCY_LEASE)
+
+    def pick5(tok, g, w, le, lk):
+        """Per-row algorithm select: token / gcra / window / lease / leaky
+        (front-door validation guarantees no sixth value reaches the
+        kernel; inactive padding rows carry algo=0 → token)."""
+        return jnp.where(
+            is_token,
+            tok,
+            jnp.where(is_gcra, g, jnp.where(is_win, w, jnp.where(is_lease, le, lk))),
+        )
+
+    w_rem_store = req.limit - w_cur_out
+    l_rem_store = req.limit - l_inflight
+    remove_all = (
+        (tok_reset_rm & is_token)
+        | (g_reset_rm & is_gcra)
+        | (w_reset_rm & is_win)
+        | (l_reset_rm & is_lease)
+    )
+
+    if mode == "int":
+        # no leaky row in the batch: the f64 lanes are never traced — the
+        # leaky slot of each pick5 reuses the token value (unreachable)
+        status_out = pick5(tok_status_out, g_status, w_status, l_status,
+                           tok_status_out)
+        return Decision(
+            status_out=status_out,
+            rem_i_out=pick5(tok_rem_store, i64(0), w_rem_store, l_rem_store,
+                            tok_rem_store),
+            rem_f_out=jnp.zeros_like(s.rem_f),
+            stamp_out=pick5(tok_created_out, now, w_ws, now, tok_created_out),
+            dur_out=req.duration,
+            exp_out=pick5(tok_exp_out, g_exp, w_ws + 2 * w_dur, l_exp,
+                          tok_exp_out),
+            burst_out=jnp.where(is_gcra, req.burst, i64(0)),
+            flags_out=req.algo | (status_out << 8),
+            remove=remove_all,
+            aux_out=jnp.where(
+                is_gcra, g_tat_out, jnp.where(is_win, w_prev, i64(0))
+            ),
+            resp_status=pick5(tok_resp_status, g_resp_status, w_resp_status,
+                              l_resp_status, tok_resp_status),
+            resp_rem=pick5(tok_resp_rem, g_resp_rem, w_resp_rem, l_resp_rem,
+                           tok_resp_rem),
+            resp_reset=pick5(tok_resp_reset, g_resp_reset, w_resp_reset,
+                             l_resp_reset, tok_resp_reset),
         )
 
     # ==================================================== leaky bucket
@@ -233,18 +455,27 @@ def _bucket_math_impl(
     lk_resp_reset = jnp.where(lk_is_new, lkn_reset, lk_resp_reset)
 
     # ==================================================== merge
-    status_out = jnp.where(is_token, tok_status_out, UNDER)
+    is_leaky = req.algo == int(Algorithm.LEAKY_BUCKET)
+    status_out = pick5(tok_status_out, g_status, w_status, l_status, UNDER)
     return Decision(
         status_out=status_out,
-        rem_i_out=jnp.where(is_token, tok_rem_store, i64(0)),
-        rem_f_out=jnp.where(is_token, f64(0.0), lk_rem_store),
-        stamp_out=jnp.where(is_token, tok_created_out, lk_stamp_out),
-        dur_out=jnp.where(is_token, req.duration, lk_dur_out),
-        exp_out=jnp.where(is_token, tok_exp_out, lk_exp_out),
-        burst_out=jnp.where(is_token, i64(0), req.burst),
+        rem_i_out=pick5(tok_rem_store, i64(0), w_rem_store, l_rem_store,
+                        i64(0)),
+        rem_f_out=jnp.where(is_leaky, lk_rem_store, f64(0.0)),
+        stamp_out=pick5(tok_created_out, now, w_ws, now, lk_stamp_out),
+        dur_out=jnp.where(is_leaky, lk_dur_out, req.duration),
+        exp_out=pick5(tok_exp_out, g_exp, w_ws + 2 * w_dur, l_exp,
+                      lk_exp_out),
+        burst_out=jnp.where(is_leaky | is_gcra, req.burst, i64(0)),
         flags_out=req.algo | (status_out << 8),
-        remove=tok_reset_rm & is_token,
-        resp_status=jnp.where(is_token, tok_resp_status, lk_resp_status),
-        resp_rem=jnp.where(is_token, tok_resp_rem, lk_resp_rem),
-        resp_reset=jnp.where(is_token, tok_resp_reset, lk_resp_reset),
+        remove=remove_all,
+        aux_out=jnp.where(
+            is_gcra, g_tat_out, jnp.where(is_win, w_prev, i64(0))
+        ),
+        resp_status=pick5(tok_resp_status, g_resp_status, w_resp_status,
+                          l_resp_status, lk_resp_status),
+        resp_rem=pick5(tok_resp_rem, g_resp_rem, w_resp_rem, l_resp_rem,
+                       lk_resp_rem),
+        resp_reset=pick5(tok_resp_reset, g_resp_reset, w_resp_reset,
+                         l_resp_reset, lk_resp_reset),
     )

@@ -81,8 +81,14 @@ def plan_passes(b: HostBatch, max_exact: int = 8) -> List[Pass]:
     tail_pos = np.nonzero(occ >= max_exact - 1)[0]
     if tail_pos.size:
         tail = act[tail_pos]
-        tail_inv = inv[tail_pos]
-        tuniq, tinv = np.unique(tail_inv, return_inverse=True)
+        # aggregation groups key on (fp, cascade level) — two LEVELS of one
+        # cascade whose keys collide on a fingerprint carry different limit
+        # configs and must not merge (kernel2.dedup_packed_cols applies the
+        # same discriminator in-trace). `inv` indexes unique fps; pairing it
+        # with the level keeps the group id dense enough for np.unique.
+        tail_lvl = (b.behavior[tail].astype(np.int64) >> 8) & 0xFF
+        tail_key = inv[tail_pos].astype(np.int64) * 256 + tail_lvl
+        tuniq, tinv = np.unique(tail_key, return_inverse=True)
         # newest member of each group carries the config (clients send the full
         # config with every request; latest wins)
         last_rows = np.zeros(tuniq.size, dtype=np.int64)

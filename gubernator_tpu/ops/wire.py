@@ -12,7 +12,7 @@ directions with an in-trace-decoded packed layout:
   lane 1  fp_hi          high 32 bits (fp == 0 ⇒ inactive row — the packing
                          invariant every serving path already maintains)
   lane 2  limit          full int32 (front-door validated to int32)
-  lane 3  duration[0:30] | algo << 30
+  lane 3  duration[0:27] | algo << 27 (3 bits) | cascade_level << 30 (2 bits)
   lane 4  hits[0:18] | (created_delta + 2048) << 18 | RESET << 30 | DRAIN << 31
 
   column B (the +1): cells [0, B], [1, B] carry the batch's created_at BASE
@@ -22,11 +22,17 @@ The decode (decode_wire_block) reconstructs the full 12-column int64 ingress
 array INSIDE the kernel's jit, where the redundant fields are recomputed
 instead of shipped: created_at = base + delta, expire_new = created +
 duration, duration_eff = duration, greg_interval = 0, burst = limit for
-leaky rows (the burst==0→limit defaulting every leaky client config hits),
-0 for token rows (token math never reads burst — ops/math.py). Behavior
-ships as exactly the two bits the decision math consumes (RESET_REMAINING,
-DRAIN_OVER_LIMIT); kernel-inert bits (NO_BATCHING, GLOBAL, MULTI_REGION)
-are dropped on the wire.
+leaky and GCRA rows (the burst==0→limit defaulting both algorithms' packs
+apply), 0 otherwise (no other algorithm reads burst — ops/math.py).
+Behavior ships as exactly the two bits the decision math consumes
+(RESET_REMAINING, DRAIN_OVER_LIMIT) plus the 2-bit cascade level (levels
+above CASCADE_WIRE_MAX_LEVEL ride full-width); kernel-inert bits
+(NO_BATCHING, GLOBAL, MULTI_REGION) are dropped on the wire.
+
+The algo field grew from 2 to 3 bits (the five in-kernel algorithms) and
+the cascade level took the remaining 2, paid for by narrowing the duration
+budget from 2^30 to 2^27 ms (~37 hours — daily quotas still fit; multi-day
+windows fall back to full-width, exactly like weekly ones always did).
 
 **Egress — (B+2, 4) int32 (16 B/row), same row layout as kernel2.pack_outputs:**
 
@@ -75,14 +81,21 @@ i64 = jnp.int64
 
 WIRE_LANES = 5  # ingress int32 lanes per row (20 B) — + 1 base column/grid
 WIRE_EGRESS_ROW_BYTES = 16  # (·, 4) int32 egress rows
-DUR_BITS = 30  # duration < 2^30 ms (~12.4 days); beyond → full-width
+DUR_BITS = 27  # duration < 2^27 ms (~37 hours); beyond → full-width
+ALGO_BITS = 3  # five in-kernel algorithms (types.Algorithm)
+LEVEL_SHIFT = DUR_BITS + ALGO_BITS  # cascade level, 2 bits (30, 31)
+LEVEL_MAX = 3  # types.CASCADE_WIRE_MAX_LEVEL — deeper cascades → full-width
 HITS_BITS = 18  # hits in [0, 2^18) — covers host-aggregated 131K-row carriers
 DELTA_BITS = 12  # created_at - base in [-2048, 2047] ms
 DELTA_BIAS = 1 << (DELTA_BITS - 1)
 _DUR_MASK = (1 << DUR_BITS) - 1
+_ALGO_MASK = (1 << ALGO_BITS) - 1
 _HITS_MASK = (1 << HITS_BITS) - 1
 _DELTA_MASK = (1 << DELTA_BITS) - 1
 RESET_SENTINEL = -(2**31)  # egress reset_delta value for reset_time == 0
+# behavior-word cascade level field (types.CASCADE_LEVEL_SHIFT)
+_BEH_LEVEL_SHIFT = 8
+_MAX_ALGO = 4  # types.MAX_ALGORITHM — wire-encodable algorithm range
 
 # Behavior bits (gubernator_tpu.types.Behavior values, frozen by the proto)
 _RESET = 8  # RESET_REMAINING — consumed by the decision math
@@ -133,9 +146,14 @@ def wire_encodable(b: HostBatch, base: int) -> bool:
     fp = b.fp[act]
     if (fp == 0).any():
         return False  # active ⟺ fp != 0 is the decode's activity rule
-    beh = b.behavior[act]
-    if (beh & ~np.int32(_ENCODABLE_BEHAVIOR)).any():
+    beh = b.behavior[act].astype(np.int64)
+    # bits 0..7 are behavior flags, 8..15 the cascade level (compact lane
+    # carries 2 level bits); anything above is unknown → full-width
+    if (beh & ~np.int64((0xFF << _BEH_LEVEL_SHIFT) | _ENCODABLE_BEHAVIOR)).any():
         return False  # Gregorian (host-resolved calendar fields) or unknown
+    lvl = (beh >> _BEH_LEVEL_SHIFT) & 0xFF
+    if (lvl > LEVEL_MAX).any():
+        return False  # cascade deeper than the 2-bit lane budget
     if (b.greg_interval[act] != 0).any():
         return False
     dur = b.duration[act]
@@ -151,18 +169,22 @@ def wire_encodable(b: HostBatch, base: int) -> bool:
         return False
     hits = b.hits[act]
     if ((hits < 0) | (hits > _HITS_MASK)).any():
-        return False
+        return False  # negative hits (lease releases) ride full-width
     limit = b.limit[act]
     if ((limit < 0) | (limit > I32_MAX)).any():
         return False  # negative limits keep the full-width path's exact
         # (pathological) arithmetic; positive is the serving domain
     algo = b.algo[act]
-    if ((algo < 0) | (algo > 1)).any():
+    if ((algo < 0) | (algo > _MAX_ALGO)).any():
         return False
-    leaky = algo == 1
-    if leaky.any() and (b.burst[act][leaky] != limit[leaky]).any():
-        return False  # leaky burst defaults to limit (pack rule); explicit
+    burst = b.burst[act]
+    bursty = (algo == 1) | (algo == 2)  # leaky / GCRA: burst lane-derived
+    if bursty.any() and (burst[bursty] != limit[bursty]).any():
+        return False  # burst defaults to limit (pack rule); explicit
         # bursts are rare enough to ship full-width
+    nob = (algo == 3) | (algo == 4)  # window / lease: burst unused, keep 0
+    if nob.any() and (burst[nob] != 0).any():
+        return False
     return True
 
 
@@ -183,7 +205,12 @@ def pack_wire_rows(
     arr[0] = fp.astype(np.int64).astype(np.int32)  # low 32, wrap cast
     arr[1] = (fp >> 32).astype(np.int32)
     arr[2] = np.where(act, b.limit, 0).astype(np.int32)
-    l3 = (b.duration & _DUR_MASK) | (b.algo.astype(np.int64) << DUR_BITS)
+    lvl = (b.behavior.astype(np.int64) >> _BEH_LEVEL_SHIFT) & 0xFF
+    l3 = (
+        (b.duration & _DUR_MASK)
+        | (b.algo.astype(np.int64) << DUR_BITS)
+        | (lvl << LEVEL_SHIFT)
+    )
     arr[3] = np.where(act, l3, 0).astype(np.int64).astype(np.int32)
     reset = (b.behavior & _RESET) != 0
     drain = (b.behavior & _DRAIN) != 0
@@ -246,10 +273,27 @@ def assemble_wire_grid(
 
 
 def grid_math_mode(grid: np.ndarray, n: int) -> str:
-    """Static kernel math variant for an assembled wire grid: any leaky row
-    (algo bit in lane 3) compiles the mixed graph — the lane-level twin of
-    engine._math_mode."""
-    return "mixed" if ((grid[3, :n] >> DUR_BITS) != 0).any() else "token"
+    """Static kernel math variant for an assembled wire grid — the
+    lane-level twin of engine._math_mode: all-token → the token-only
+    graph, a leaky row → the mixed (f64) graph, any other algorithm →
+    the all-integer graph."""
+    algo = (grid[3, :n].astype(np.int64) >> DUR_BITS) & _ALGO_MASK
+    if (algo == 1).any():
+        return "mixed"
+    if not algo.any():
+        return "token"
+    # active rows are fp != 0 (lanes 0/1); inactive lanes are all-zero
+    act = algo[(grid[0, :n] != 0) | (grid[1, :n] != 0)]
+    if act.size and (act == 2).all():
+        return "gcra"
+    return "int"
+
+
+def grid_has_cascade(grid: np.ndarray, n: int) -> bool:
+    """Whether an assembled wire grid carries cascade level bits (lane 3
+    bits 30-31) — the engine then compiles the in-trace verdict fold into
+    the dispatch (kernel2.fold_cascade_packed)."""
+    return bool(((grid[3, :n].astype(np.int64) >> LEVEL_SHIFT) & 3).any())
 
 
 def stamp_base(block: np.ndarray, base: int) -> None:
@@ -275,13 +319,20 @@ def decode_wire_block(blk: jnp.ndarray):
     fp = _join64(l0, l1)
     limit = l2.astype(i64)
     dur = (l3 & _DUR_MASK).astype(i64)
-    algo = (l3 >> DUR_BITS) & 3
+    algo = (l3 >> DUR_BITS) & _ALGO_MASK
+    level = (l3 >> LEVEL_SHIFT) & 3
     hits = (l4 & _HITS_MASK).astype(i64)
     delta = (((l4 >> HITS_BITS) & _DELTA_MASK) - DELTA_BIAS).astype(i64)
-    behavior = ((l4 >> 30) & 1) * _RESET | ((l4 >> 31) & 1) * _DRAIN
+    behavior = (
+        ((l4 >> 30) & 1) * _RESET
+        | ((l4 >> 31) & 1) * _DRAIN
+        | (level << _BEH_LEVEL_SHIFT)
+    )
     created = base + delta
     active = fp != 0
-    burst = jnp.where(algo == 1, limit, i64(0))
+    # burst reconstructs to limit for the tolerance-shaped algorithms
+    # (leaky, GCRA — the pack-side defaulting), 0 otherwise
+    burst = jnp.where((algo == 1) | (algo == 2), limit, i64(0))
     arr12 = jnp.stack(
         [
             fp,
@@ -339,10 +390,15 @@ def decode_wire_host(lanes: np.ndarray, base: int) -> dict:
     l0, l1, l2, l3, l4 = (lanes[i].astype(np.int64) for i in range(WIRE_LANES))
     fp = (l0 & 0xFFFFFFFF) | (l1 << 32)
     dur = l3 & _DUR_MASK
-    algo = (l3 >> DUR_BITS) & 3
+    algo = (l3 >> DUR_BITS) & _ALGO_MASK
+    level = (l3 >> LEVEL_SHIFT) & 3
     hits = l4 & _HITS_MASK
     delta = ((l4 >> HITS_BITS) & _DELTA_MASK) - DELTA_BIAS
-    behavior = ((l4 >> 30) & 1) * _RESET | ((l4 >> 31) & 1) * _DRAIN
+    behavior = (
+        ((l4 >> 30) & 1) * _RESET
+        | ((l4 >> 31) & 1) * _DRAIN
+        | (level << _BEH_LEVEL_SHIFT)
+    )
     created = base + delta
     return {
         "fp": fp,
@@ -387,23 +443,32 @@ def unpack_wire_out(arr: np.ndarray, n: int):
 # --------------------------------------------------- single-device entries
 
 
-def decide2_wire_cols_impl(table, carr, *, write="sweep", math="mixed"):
+def decide2_wire_cols_impl(
+    table, carr, *, write="sweep", math="mixed", cascade=False
+):
     """Compact single-transfer serving entry: (5, B+1) int32 wire block in,
     (B+2, 4) int32 compact outputs out — the narrow-wire twin of
-    kernel2.decide2_packed_cols_impl."""
+    kernel2.decide2_packed_cols_impl. `cascade=True` folds cascade verdicts
+    in-trace on the wide packed array BEFORE the egress narrowing."""
     arr12, base = decode_wire_block(carr)
-    table, packed = decide2_packed_cols_impl(table, arr12, write=write, math=math)
+    table, packed = decide2_packed_cols_impl(
+        table, arr12, write=write, math=math, cascade=cascade
+    )
     return table, encode_wire_out(packed, base)
 
 
-def decide2_wire_dedup_impl(table, carr, *, write="sweep", math="mixed"):
+def decide2_wire_dedup_impl(
+    table, carr, *, write="sweep", math="mixed", cascade=False
+):
     """Compact entry with in-trace duplicate aggregation (the mesh
     engines' dedup="device" program built on the narrow wire)."""
     arr12, base = decode_wire_block(carr)
-    table, packed = decide2_packed_dedup_impl(table, arr12, write=write, math=math)
+    table, packed = decide2_packed_dedup_impl(
+        table, arr12, write=write, math=math, cascade=cascade
+    )
     return table, encode_wire_out(packed, base)
 
 
 decide2_wire_cols = functools.partial(
-    jax.jit, donate_argnums=(0,), static_argnames=("write", "math")
+    jax.jit, donate_argnums=(0,), static_argnames=("write", "math", "cascade")
 )(decide2_wire_cols_impl)

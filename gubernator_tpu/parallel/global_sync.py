@@ -578,6 +578,13 @@ class GlobalShardedEngine(ShardedEngine):
         reset[g] = t
         err[g[dropped]] = ERR_DROPPED
         self.stats.checks += int(g.size)
+        from gubernator_tpu.ops.engine import _fold_cascades_host
+
+        # host fold over the REASSEMBLED batch order (the GLOBAL/local
+        # split above preserves original row positions)
+        _fold_cascades_host(
+            np.asarray(cols.behavior), status, remaining, reset, err
+        )
         return ResponseColumns(
             status=status, limit=limit, remaining=remaining,
             reset_time=reset, err=err,
@@ -766,6 +773,11 @@ class GlobalShardedEngine(ShardedEngine):
                 remaining[rows_f] = r[:np_]
                 reset[rows_f] = t[:np_]
                 err[rows_f[dropped[:np_]]] = ERR_DROPPED
+        from gubernator_tpu.ops.engine import _fold_cascades_host
+
+        # cascade verdicts fold host-side on the mesh-global path (the
+        # replica/owner fork re-orders rows, so no in-trace fold ran)
+        _fold_cascades_host(hb.behavior, status, remaining, reset, err)
         rc = ResponseColumns(
             status=status, limit=limit_o, remaining=remaining,
             reset_time=reset, err=err,

@@ -495,7 +495,10 @@ class ShardedEngine:
     ) -> ResponseColumns:
         from gubernator_tpu.ops.engine import serve_columns
 
-        def dispatch(pass_batch, n_rows: int):
+        def dispatch(pass_batch, n_rows: int, cascade: bool = False):
+            # mesh programs never fold cascades in-trace (routed/exchanged
+            # row order breaks carrier adjacency); serve_columns' host fold
+            # computes the combined verdicts instead
             _, vals = self._dispatch(pass_batch)
             return vals
 
@@ -813,10 +816,12 @@ class ShardedEngine:
 
     supports_pipeline = True
 
-    def stage_pass(self, pass_batch: HostBatch, n: int):
+    def stage_pass(self, pass_batch: HostBatch, n: int, cascade: bool = False):
         """(padded batch, staged route) for one unique-fp pass. No row
         padding is needed: the compiled shape depends only on the pow2
-        per-shard width b_local, not on n."""
+        per-shard width b_local, not on n. `cascade` is accepted for
+        protocol parity and ignored — mesh programs rely on the host-side
+        verdict fold (engine._fold_cascades_host)."""
         staged = self._stage(pass_batch, None)
         return pass_batch, staged
 

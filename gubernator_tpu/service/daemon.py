@@ -51,6 +51,8 @@ from gubernator_tpu.service.runner import EngineRunner
 from gubernator_tpu.service.wire import (
     batch_too_large_error,
     columns_from_pb,
+    expand_cascades,
+    pb_from_cascade_response_columns,
     pb_from_response_columns,
     subset_columns,
 )
@@ -439,24 +441,27 @@ class Daemon:
         """Compile the decision + install kernels for the smallest batch shape
         BEFORE serving: the first XLA compile takes seconds, which would blow
         the 500 ms peer-RPC budgets (global_timeout, batch_timeout) and drop
-        the first GLOBAL sync round of a fresh daemon. Both static math
+        the first GLOBAL sync round of a fresh daemon. All three static math
         variants compile (engine._math_mode picks per dispatch): an all-token
-        warm batch alone would leave the first leaky-carrying request to pay
-        the mixed graph's compile on the request path."""
-        for algo in (
-            np.zeros(1, dtype=np.int32),  # math="token" graph
-            np.ones(1, dtype=np.int32),  # math="mixed" graph
+        warm batch alone would leave the first leaky- or GCRA-carrying
+        request to pay the mixed/int graph's compile on the request path."""
+        for algos in (
+            [0],  # math="token" graph
+            [2],  # math="gcra" graph (all-GCRA specialization)
+            [2, 3],  # math="int" graph (mixed integer algorithms)
+            [1],  # math="mixed" graph
         ):
+            n = len(algos)
             warm = RequestColumns(
-                fp=np.asarray([1], dtype=np.int64),
-                algo=algo,
-                behavior=np.zeros(1, dtype=np.int32),
-                hits=np.zeros(1, dtype=np.int64),
-                limit=np.ones(1, dtype=np.int64),
-                burst=np.zeros(1, dtype=np.int64),
-                duration=np.ones(1, dtype=np.int64),  # expires ~immediately
-                created_at=np.zeros(1, dtype=np.int64),
-                err=np.zeros(1, dtype=np.int8),
+                fp=np.arange(1, n + 1, dtype=np.int64),
+                algo=np.asarray(algos, dtype=np.int32),
+                behavior=np.zeros(n, dtype=np.int32),
+                hits=np.zeros(n, dtype=np.int64),
+                limit=np.ones(n, dtype=np.int64),
+                burst=np.zeros(n, dtype=np.int64),
+                duration=np.ones(n, dtype=np.int64),  # expires ~immediately
+                created_at=np.zeros(n, dtype=np.int64),
+                err=np.zeros(n, dtype=np.int8),
             )
             await self.runner.check_columns(warm)
         await self.runner.install_columns(
@@ -822,7 +827,7 @@ class Daemon:
         tasks = []
         if local_rows:
             rows = np.asarray(local_rows)
-            tasks.append(self._check_rows(cols, rows, out))
+            tasks.append(self._check_rows(cols, rows, out, items))
         if global_rows:
             rows = np.asarray(global_rows)
             # answer from local state with GLOBAL stripped + NO_BATCHING
@@ -834,7 +839,7 @@ class Daemon:
             )
             for i in global_rows:
                 self.global_manager.queue_hit(hash_keys[i], items[i])
-            tasks.append(self._check_subset(gcols, rows, out))
+            tasks.append(self._check_subset(gcols, rows, out, items))
         for row, key, item in forwards:
             tasks.append(self._forward(row, key, item, out))
         if tasks:
@@ -1112,14 +1117,37 @@ class Daemon:
         except asyncio.QueueFull:
             self.events_dropped += 1
 
-    async def _check_rows(self, cols, rows: np.ndarray, out) -> None:
-        await self._check_subset(subset_columns(cols, rows), rows, out)
+    async def _check_rows(self, cols, rows: np.ndarray, out, items=None) -> None:
+        await self._check_subset(subset_columns(cols, rows), rows, out, items)
 
-    async def _check_subset(self, sub, rows: np.ndarray, out) -> None:
-        rc = await self.batcher.check(sub)
-        resps = pb_from_response_columns(rc)
+    async def _check_subset(self, sub, rows: np.ndarray, out, items=None) -> None:
+        """Serve a column subset through the batcher. `items` (the full pb
+        item list, indexed by the ORIGINAL row ids in `rows`) enables
+        cascade expansion: every level of a cascade request becomes one
+        engine row — all levels of all requests still resolve in a single
+        engine dispatch — and the per-level responses contract back into
+        the top-level response's `cascade` list."""
+        resps = await self._serve_items(sub, (
+            None if items is None else [items[int(i)] for i in rows]
+        ))
         for j, i in enumerate(rows):
             out[int(i)] = resps[j]
+
+    async def _serve_items(self, cols, items) -> "List[pb.RateLimitResp]":
+        """Columns (+ aligned pb items, for cascade expansion) → pb
+        responses via one batcher dispatch."""
+        exp, counts = expand_cascades(
+            cols, items, self.conf.cascade_max_levels
+        )
+        rc = await self.batcher.check(exp)
+        if counts is None:
+            return pb_from_response_columns(rc)
+        for m in counts:
+            if m:
+                self.metrics.cascade_depth.observe(1 + m)
+        return pb_from_cascade_response_columns(
+            rc, counts, self.conf.cascade_max_levels
+        )
 
     async def _forward(self, row: int, key: str, item, out) -> None:
         """Forward to the owner with ownership re-resolution on failure
@@ -1138,8 +1166,7 @@ class Daemon:
             if self.is_self(info):
                 # ownership moved to us mid-flight — serve locally
                 cols, _ = columns_from_pb([item])
-                rc = await self.batcher.check(cols)
-                out[row] = pb_from_response_columns(rc)[0]
+                out[row] = (await self._serve_items(cols, [item]))[0]
                 return
             client = self.peer_client(info)
             if client is None:
@@ -1215,8 +1242,7 @@ class Daemon:
         clients keep getting rate-limit answers during partitions, each
         marked degraded so callers can tell it is not owner-authoritative."""
         cols, _ = columns_from_pb([item])
-        rc = await self.batcher.check(cols)
-        resp = pb_from_response_columns(rc)[0]
+        resp = (await self._serve_items(cols, [item]))[0]
         resp.metadata["degraded"] = "true"
         self.metrics.degraded_responses.inc()
         return resp
@@ -1260,9 +1286,12 @@ class Daemon:
                 self._local_picker.hash_fn,
             )
         # strip GLOBAL before the local check so the engine path does not
-        # depend on it; broadcast queueing happens below
+        # depend on it; broadcast queueing happens below. Forwarded cascade
+        # requests execute owner-side HERE — same expansion/contraction as
+        # the front door, so the forwarder receives the folded verdict +
+        # per-level sub-responses over the peer wire unchanged.
         cols = cols._replace(behavior=cols.behavior & ~np.int32(int(Behavior.GLOBAL)))
-        rc = await self.batcher.check(cols)
+        resps = await self._serve_items(cols, items)
         for i, it in enumerate(items):
             if cols.err[i] != 0:
                 continue
@@ -1273,7 +1302,6 @@ class Daemon:
             # MULTI_REGION stripped by RegionManager, so no ping-pong)
             if has_behavior(it.behavior, Behavior.MULTI_REGION):
                 self.region_manager.queue_hit(hash_keys[i], it)
-        resps = pb_from_response_columns(rc)
         if self.event_channel is not None:
             # peer-batch execution is owner-side too (the reference's event
             # fires inside getLocalRateLimit, on every owner execution)
@@ -1394,6 +1422,10 @@ class Daemon:
                 "dispatches": eng.stats.dispatches,
                 "dropped": eng.stats.dropped,
             },
+            # per-algorithm decision counts (live view of
+            # gubernator_tpu_decisions_total) — scenario breadth at a glance
+            "decisions_by_algorithm": dict(self.runner.algo_counts),
+            "cascade_max_levels": self.conf.cascade_max_levels,
             "pipeline_inflight": self.conf.behaviors.pipeline_inflight,
             "concurrent_checks": self.metrics.concurrent_checks._value.get(),
         }

@@ -175,6 +175,22 @@ class DaemonMetrics:
             registry=r,
             buckets=LATENCY_BUCKETS,
         )
+        self.decisions_total = Counter(
+            # renders as gubernator_tpu_decisions_total
+            "gubernator_tpu_decisions",
+            "Rate-limit decisions served, by algorithm (cascade levels "
+            "count one decision per level — docs/algorithms.md)",
+            ["algorithm"],  # token_bucket | leaky_bucket | gcra |
+            # sliding_window | concurrency_lease | invalid
+            registry=r,
+        )
+        self.cascade_depth = Histogram(
+            "gubernator_tpu_cascade_depth",
+            "Levels per cascaded multi-limit check (the request's own "
+            "level plus its cascade entries)",
+            registry=r,
+            buckets=(2, 3, 4, 6, 8, 16, 32),
+        )
         self.wire_bytes = Counter(
             # renders as gubernator_tpu_wire_bytes_total
             "gubernator_tpu_wire_bytes",

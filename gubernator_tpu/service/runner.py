@@ -30,6 +30,13 @@ def _exemplar(span) -> Optional[dict]:
     return {"trace_id": span.trace_id} if span is not None else None
 
 
+# gubernator_tpu_decisions_total label values (types.Algorithm order)
+_ALGO_LABELS = (
+    "token_bucket", "leaky_bucket", "gcra", "sliding_window",
+    "concurrency_lease", "invalid",
+)
+
+
 class EngineRunner:
     """Serializes engine table access onto one thread; async façade.
 
@@ -63,6 +70,28 @@ class EngineRunner:
         # checkpoint-extract fetches likewise (lazy): the dirty-block
         # fetch overlaps serving dispatches, never competes with them
         self._ckpt: Optional[ThreadPoolExecutor] = None
+        # cumulative per-algorithm decision counts (the debug-plane mirror
+        # of gubernator_tpu_decisions_total; /v1/debug/pipeline)
+        self.algo_counts = {k: 0 for k in _ALGO_LABELS}
+
+    def _count_decisions(self, algo_col) -> None:
+        """Per-algorithm decision accounting (the
+        gubernator_tpu_decisions_total{algorithm} family) — one vectorized
+        bincount per dispatch, never per row. Cascade member rows carry
+        their own algorithm, so every level counts as one decision."""
+        a = np.asarray(algo_col)
+        if a.size == 0:
+            return
+        lab = np.where((a >= 0) & (a < len(_ALGO_LABELS) - 1), a,
+                       len(_ALGO_LABELS) - 1)
+        counts = np.bincount(lab, minlength=len(_ALGO_LABELS))
+        for v, c in enumerate(counts):
+            if c:
+                self.algo_counts[_ALGO_LABELS[v]] += int(c)
+                if self.metrics is not None:
+                    self.metrics.decisions_total.labels(
+                        algorithm=_ALGO_LABELS[v]
+                    ).inc(int(c))
 
     async def check(
         self, cols: RequestColumns, now_ms: Optional[int] = None, span=None
@@ -88,6 +117,7 @@ class EngineRunner:
             or (can is not None and not can(cols))
         ):
             return await self.check_columns(cols, now_ms=now_ms)
+        self._count_decisions(cols.algo)
         from gubernator_tpu.ops.engine import prepare_check_columns
 
         loop = asyncio.get_running_loop()
@@ -133,6 +163,8 @@ class EngineRunner:
         prepared = await loop.run_in_executor(self._prep, prepare)
         if prepared is None:
             return None
+        for p in parts:
+            self._count_decisions(p.cols.algo)
         return await self._issue_and_finish(prepared, span=span)
 
     def _observe_stage(self, stage: str, t0: float, span) -> None:
@@ -235,6 +267,7 @@ class EngineRunner:
     async def check_columns(
         self, cols: RequestColumns, now_ms: Optional[int] = None
     ) -> ResponseColumns:
+        self._count_decisions(cols.algo)
         loop = asyncio.get_running_loop()
 
         def run():

@@ -14,6 +14,7 @@ import numpy as np
 
 from gubernator_tpu.hashing import fingerprint
 from gubernator_tpu.ops.batch import (
+    ERR_CASCADE_DEEP,
     ERR_EMPTY_KEY,
     ERR_EMPTY_NAME,
     ERROR_STRINGS,
@@ -92,7 +93,10 @@ def columns_from_pb(
         hash_keys[i] = r.name + "_" + r.unique_key
         fp[i] = fingerprint(r.name, r.unique_key)
         algo[i] = r.algorithm
-        behavior[i] = r.behavior
+        # client-facing flag bits only (native parser applies the same
+        # mask): the behavior word's high bits carry the INTERNAL cascade
+        # level, which must never arrive from the wire
+        behavior[i] = r.behavior & 63
         hits[i] = min(max(r.hits, -clip), clip)
         limit[i] = min(max(r.limit, -clip), clip)
         burst[i] = min(max(r.burst, -clip), clip)
@@ -174,6 +178,147 @@ def peer_req_pb(items: Sequence["pb.RateLimitReq"]) -> "peers_pb.GetPeerRateLimi
     return peers_pb.GetPeerRateLimitsReq(requests=items)
 
 
+# ------------------------------------------------------------- cascades
+#
+# A cascade request (RateLimitReq.cascade — per-tenant, global, … levels on
+# top of the request's own level-0 limit) expands into one engine row per
+# level, carrier first then members in level order, with the level riding
+# the behavior word's high bits (types.CASCADE_LEVEL_SHIFT). Expansion
+# happens AFTER peer routing (the whole cascade lives on the level-0 key's
+# owner) and the engine evaluates every level in ONE dispatch, folding the
+# combined verdict into the carrier row (deny-if-any; kernel2
+# fold_cascade_packed / engine._fold_cascades_host). Contraction maps the
+# rows back: carrier → the top-level RateLimitResp, member rows → its
+# `cascade` list.
+
+# behavior bits a cascade level inherits from its parent request: the
+# kernel-visible flags plus the routing bits (whatever routing treatment
+# the parent received applies to the whole group — levels must never split
+# across the GLOBAL/local forks, or the host verdict fold would misgroup).
+# DURATION_IS_GREGORIAN is deliberately NOT inherited: level durations are
+# always milliseconds.
+_CASCADE_INHERIT = int(
+    Behavior.NO_BATCHING
+    | Behavior.GLOBAL
+    | Behavior.RESET_REMAINING
+    | Behavior.MULTI_REGION
+    | Behavior.DRAIN_OVER_LIMIT
+)
+
+
+def cascade_too_deep_error(cap: int) -> str:
+    return f"Cascade levels list too large; max size is '{cap}'"
+
+
+def expand_cascades(
+    cols: RequestColumns, items, max_levels: int
+) -> Tuple[RequestColumns, Optional[List[int]]]:
+    """Expand cascade requests of a column batch into per-level rows.
+
+    `items` are the pb RateLimitReq objects aligned with `cols` rows (None
+    when the caller knows no cascades are present). Returns
+    (expanded_cols, member_counts): member_counts[j] is the number of
+    member rows inserted after original row j, or None when nothing
+    expanded (the common case — zero-copy). A cascade deeper than
+    `max_levels` total levels errors the CARRIER row (reference-style
+    per-item isolation); invalid level keys error their member row, which
+    surfaces in that level's sub-response."""
+    if items is None or not any(len(it.cascade) for it in items):
+        return cols, None
+    n = cols.fp.shape[0]
+    parts: List[RequestColumns] = []
+    counts: List[int] = []
+    for j in range(n):
+        it = items[j]
+        m = len(it.cascade)
+        row = subset_columns(cols, np.array([j]))
+        if m == 0 or row.err[0] != 0:
+            # no levels, or the carrier itself failed validation: the
+            # request errors whole — no level is evaluated (or consumed)
+            parts.append(row)
+            counts.append(0)
+            continue
+        if 1 + m > max_levels:
+            # per-item isolation, like the reference's oversized-batch rule:
+            # the carrier row becomes an error, no level is evaluated
+            parts.append(row._replace(
+                fp=np.zeros(1, dtype=np.int64),
+                err=np.full(1, ERR_CASCADE_DEEP, dtype=np.int8),
+            ))
+            counts.append(0)
+            continue
+        inherit = int(row.behavior[0]) & _CASCADE_INHERIT
+        fp = np.zeros(1 + m, dtype=np.int64)
+        err = np.zeros(1 + m, dtype=np.int8)
+        algo = np.zeros(1 + m, dtype=np.int32)
+        behavior = np.zeros(1 + m, dtype=np.int32)
+        hits = np.full(1 + m, row.hits[0], dtype=np.int64)
+        limit = np.zeros(1 + m, dtype=np.int64)
+        burst = np.zeros(1 + m, dtype=np.int64)
+        duration = np.zeros(1 + m, dtype=np.int64)
+        created_at = np.full(1 + m, row.created_at[0], dtype=np.int64)
+        fp[0] = row.fp[0]
+        err[0] = row.err[0]
+        algo[0] = row.algo[0]
+        behavior[0] = row.behavior[0]
+        limit[0] = row.limit[0]
+        burst[0] = row.burst[0]
+        duration[0] = row.duration[0]
+        clip = 1 << 62
+        for k, lvl in enumerate(it.cascade, start=1):
+            if lvl.unique_key == "":
+                err[k] = ERR_EMPTY_KEY
+            elif lvl.name == "":
+                err[k] = ERR_EMPTY_NAME
+            else:
+                fp[k] = fingerprint(lvl.name, lvl.unique_key)
+            algo[k] = lvl.algorithm
+            behavior[k] = inherit | (min(k, 255) << 8)
+            limit[k] = min(max(lvl.limit, -clip), clip)
+            burst[k] = min(max(lvl.burst, -clip), clip)
+            duration[k] = min(max(lvl.duration, -clip), clip)
+        parts.append(RequestColumns(
+            fp=fp, algo=algo, behavior=behavior, hits=hits, limit=limit,
+            burst=burst, duration=duration, created_at=created_at, err=err,
+        ))
+        counts.append(m)
+    return concat_columns(parts), counts
+
+
+def pb_from_cascade_response_columns(
+    rc: ResponseColumns, counts: List[int], max_levels: int
+) -> List["pb.RateLimitResp"]:
+    """Contract an expanded response back to per-request RateLimitResp
+    messages: the carrier row (already folded to the combined verdict)
+    becomes the top-level response; its member rows become the `cascade`
+    sub-responses in level order."""
+    out: List[pb.RateLimitResp] = []
+    off = 0
+    for m in counts:
+        top = _resp_at(rc, off, max_levels)
+        for k in range(1, m + 1):
+            top.cascade.append(_resp_at(rc, off + k, max_levels))
+        out.append(top)
+        off += 1 + m
+    return out
+
+
+def _resp_at(rc: ResponseColumns, i: int, max_levels: int) -> "pb.RateLimitResp":
+    code = int(rc.err[i])
+    msg = (
+        cascade_too_deep_error(max_levels)
+        if code == ERR_CASCADE_DEEP
+        else ERROR_STRINGS[code]
+    )
+    return pb.RateLimitResp(
+        status=int(rc.status[i]),
+        limit=int(rc.limit[i]),
+        remaining=int(rc.remaining[i]),
+        reset_time=int(rc.reset_time[i]),
+        error=msg,
+    )
+
+
 # ------------------------------------------------------------ state handoff
 
 
@@ -228,12 +373,15 @@ def transfer_chunk_arrays(req):
 def wire_batch_from_wire(data: bytes):
     """Native parse of GetRateLimitsReq wire bytes (gubernator_tpu.native):
     → (WireBatch, ring_points uint32, spans (n,2) int64, traceparent) or
-    None when the extension is unavailable. ring_points are fnv1a_32 of each
-    item's hash key (the ring lookup hash) and spans are each item's byte
-    range in `data` for lazy pb materialization — only items that must
-    travel as messages (forwards, GLOBAL queue entries) ever become Python
-    objects. The WireBatch additionally carries the parser's pre-packed
-    compact-wire lanes — the "parse once, stage once" ingress image."""
+    None when the extension is unavailable OR any item carries a cascade —
+    cascade requests need their levels expanded from the full pb message,
+    so such batches take the pb path (Daemon._route) end to end.
+    ring_points are fnv1a_32 of each item's hash key (the ring lookup hash)
+    and spans are each item's byte range in `data` for lazy pb
+    materialization — only items that must travel as messages (forwards,
+    GLOBAL queue entries) ever become Python objects. The WireBatch
+    additionally carries the parser's pre-packed compact-wire lanes — the
+    "parse once, stage once" ingress image."""
     from gubernator_tpu import native
 
     m = native.load()
@@ -241,8 +389,10 @@ def wire_batch_from_wire(data: bytes):
         return None
     (
         n, fp, algo, beh, hits, lim, burst, dur, ca, err, ring, span,
-        traceparent, lanes, enc,
+        traceparent, lanes, enc, casc,
     ) = m.parse_get_rate_limits(data)
+    if n and np.frombuffer(casc, np.int8).any():
+        return None  # cascade batch → pb path (level expansion needs items)
     # np.frombuffer over bytes is read-only; routing mutates behavior/err
     cols = RequestColumns(
         fp=np.frombuffer(fp, np.int64),
@@ -348,12 +498,15 @@ def sync_wire_pb(
         if (
             not it.HasField("created_at")
             or it.behavior & ~_SYNC_WIRE_BEHAVIOR
-            or it.algorithm not in (0, 1)
+            or not (0 <= it.algorithm <= wire_mod._MAX_ALGO)
+            or it.hits < 0  # lease releases keep the proto fallback
             or not (0 <= it.duration <= wire_mod._DUR_MASK)
             or not (0 <= it.limit <= wire_mod.I32_MAX)
             or it.metadata  # trace propagation has no compact lane
+            or len(it.cascade)  # cascade levels need the full message
             or not (
-                it.burst == 0 or (it.algorithm == 1 and it.burst == it.limit)
+                it.burst == 0
+                or (it.algorithm in (1, 2) and it.burst == it.limit)
             )
             or it.name == ""
             or it.unique_key == ""

@@ -17,6 +17,47 @@ class Algorithm(enum.IntEnum):
     # reference gubernator.proto:64-68
     TOKEN_BUCKET = 0
     LEAKY_BUCKET = 1
+    # ---- extensions beyond the reference enum (docs/algorithms.md).
+    # Values are part of the proto surface (proto/gubernator.proto); a peer
+    # running an older build answers requests carrying them with a per-item
+    # "invalid rate limit algorithm" error row instead of failing the batch.
+    GCRA = 2  # virtual-scheduling (theoretical arrival time) rate limiting
+    SLIDING_WINDOW = 3  # previous+current window interpolation counters
+    CONCURRENCY_LEASE = 4  # inflight acquire/release with TTL reclamation
+
+
+# highest algorithm value this build's kernel speaks — anything above is a
+# per-item validation error (ops/batch.ERR_ALGORITHM), the forward-compat
+# contract for mixed-version clusters
+MAX_ALGORITHM = int(Algorithm.CONCURRENCY_LEASE)
+
+
+# ---- cascaded multi-limit checks (docs/algorithms.md "Cascades").
+# A cascade request expands into one engine row per limit level (per-user,
+# per-tenant, global, …) sharing a request carrier; the level rides the
+# behavior word's high bits so it survives every packed-ingress layout and
+# the a2a ownership exchange unchanged. Level 0 = the carrier (or any
+# standalone request); levels >= 1 are member rows that immediately follow
+# their carrier in batch order.
+CASCADE_LEVEL_SHIFT = 8
+CASCADE_LEVEL_MASK = 0xFF
+# deepest level the compact wire can carry (2 spare lane bits — ops/wire.py);
+# deeper cascades ride the full-width grids with identical semantics
+CASCADE_WIRE_MAX_LEVEL = 3
+
+
+def cascade_level(behavior: int) -> int:
+    """The cascade level encoded in a behavior word (0 = carrier/standalone)."""
+    return (int(behavior) >> CASCADE_LEVEL_SHIFT) & CASCADE_LEVEL_MASK
+
+
+def with_cascade_level(behavior: int, level: int) -> int:
+    """Behavior word with the cascade level field set."""
+    if not (0 <= level <= CASCADE_LEVEL_MASK):
+        raise ValueError(f"cascade level {level} out of range")
+    return (int(behavior) & ~(CASCADE_LEVEL_MASK << CASCADE_LEVEL_SHIFT)) | (
+        level << CASCADE_LEVEL_SHIFT
+    )
 
 
 class Behavior(enum.IntFlag):
@@ -65,9 +106,22 @@ HOUR = 60 * MINUTE
 
 
 @dataclass
+class CascadeLevel:
+    """One additional limit level of a cascaded multi-limit check
+    (proto CascadeLevel — docs/algorithms.md "Cascades")."""
+
+    name: str = ""
+    unique_key: str = ""
+    limit: int = 0
+    duration: int = 0  # milliseconds (never Gregorian)
+    algorithm: int = Algorithm.TOKEN_BUCKET
+    burst: int = 0
+
+
+@dataclass
 class RateLimitRequest:
     """One rate-limit check. Field-for-field parity with reference
-    RateLimitReq (gubernator.proto:144-190)."""
+    RateLimitReq (gubernator.proto:144-190) plus the cascade extension."""
 
     name: str = ""
     unique_key: str = ""
@@ -76,9 +130,14 @@ class RateLimitRequest:
     duration: int = 0  # milliseconds, or a Gregorian enum when flagged
     algorithm: int = Algorithm.TOKEN_BUCKET
     behavior: int = 0
-    burst: int = 0  # leaky bucket burst; 0 → defaults to limit
+    burst: int = 0  # leaky/GCRA burst; 0 → defaults to limit
     metadata: Optional[Dict[str, str]] = None
     created_at: Optional[int] = None  # epoch ms; stamped at ingress if unset
+    # additional limit levels checked atomically with this request (the
+    # request's own fields are level 0); served via the daemon surface —
+    # the embedded engine API evaluates levels but callers must expand
+    # them into rows themselves (service/wire.expand_cascades)
+    cascade: Optional[list] = None  # List[CascadeLevel]
 
     def hash_key(self) -> str:
         # reference client.go:39-41 — cache key is name + "_" + unique_key

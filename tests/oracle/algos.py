@@ -1,0 +1,133 @@
+"""Pure-Python reference oracles for the extended in-kernel algorithms.
+
+One class per algorithm, dict-of-key state, integer-millisecond arithmetic
+mirroring the masked decision tables in ops/math.py EXACTLY (same rounding,
+same clamps, same expiry rules) — the parity contract every device
+implementation (local + 8-dev mesh, full + compact wire) is tested against
+in tests/test_algorithms.py. The token/leaky oracles live in
+tests/oracle/kernel_v1.py (the v1 plane kernel); these cover the ISSUE-10
+extensions: GCRA, sliding-window counters, concurrency leases.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Tuple
+
+
+def _clip(v: int, lo: int, hi: int) -> int:
+    return max(lo, min(v, hi))
+
+
+@dataclass
+class GcraOracle:
+    """Virtual scheduling: one theoretical-arrival-time (TAT) per key.
+
+    T = duration // limit (ms per token), tau = T * burst. State is
+    self-expiring — once now >= TAT the bucket is indistinguishable from a
+    fresh one, which is exactly how the kernel's ExpireAt = TAT interacts
+    with lazy expiry, so the oracle needs no explicit expiry handling:
+    max(TAT, now) covers both."""
+
+    tat: Dict[int, int] = field(default_factory=dict)
+
+    def check(
+        self, key: int, now: int, hits: int, limit: int, duration: int,
+        burst: int = 0, drain: bool = False,
+    ) -> Tuple[int, int, int]:
+        burst = burst or limit
+        T = max(duration // max(limit, 1), 1)
+        tau = T * burst
+        tat0 = max(self.tat.get(key, now), now)
+        tat1 = tat0 + hits * T
+        deny = hits > 0 and tat1 - tau > now
+        if deny:
+            out = now + tau if drain else tat0
+        else:
+            out = tat1
+        self.tat[key] = out
+        rem = _clip((now + tau - out) // T, 0, burst)
+        reset = out - tau + T * limit
+        return (1 if deny else 0, rem, reset)
+
+
+@dataclass
+class SlidingWindowOracle:
+    """Previous+current window interpolation; windows align to duration
+    boundaries. State: (window_start, current_count, previous_count)."""
+
+    state: Dict[int, Tuple[int, int, int]] = field(default_factory=dict)
+
+    def check(
+        self, key: int, now: int, hits: int, limit: int, duration: int,
+        drain: bool = False,
+    ) -> Tuple[int, int, int]:
+        dur = max(duration, 1)
+        ws = now - now % dur
+        s_ws, s_cur, s_prev = self.state.get(key, (None, 0, 0))
+        if s_ws == ws:
+            cur, prev = s_cur, s_prev
+        elif s_ws == ws - dur:
+            cur, prev = 0, s_cur
+        else:  # stale beyond one window (== the slot's ws+2dur expiry)
+            cur, prev = 0, 0
+        used = cur + (prev * (dur - (now - ws))) // dur
+        deny = hits > 0 and used + hits > limit
+        take = 0 if (deny and not drain) else hits
+        cur += take
+        self.state[key] = (ws, cur, prev)
+        rem = _clip(limit - (used + take), 0, limit)
+        return (1 if deny else 0, rem, ws + dur)
+
+
+@dataclass
+class LeaseOracle:
+    """Concurrency leases: hits>0 acquires, hits<0 releases, 0 queries.
+    State: (inflight, expire_at); an expired slot reclaims every lease —
+    the TTL-eviction reclamation contract."""
+
+    state: Dict[int, Tuple[int, int]] = field(default_factory=dict)
+
+    def check(
+        self, key: int, now: int, hits: int, limit: int, duration: int,
+        drain: bool = False,
+    ) -> Tuple[int, int, int]:
+        inflight, exp = self.state.get(key, (0, None))
+        if exp is None or exp < now:  # lazy expiry (exp >= now keeps it live)
+            inflight, exp = 0, None
+        deny = hits > 0 and inflight + hits > limit
+        take = 0 if (deny and not drain) else hits
+        inflight = max(inflight + take, 0)
+        refresh = hits > 0 and not (deny and not drain)
+        if refresh or exp is None:
+            exp = now + duration
+        self.state[key] = (inflight, exp)
+        rem = _clip(limit - inflight, 0, limit)
+        return (1 if deny else 0, rem, exp)
+
+
+class TokenOracle:
+    """Minimal fixed-window token bucket (the reference's semantics for the
+    cases the GCRA-equivalence test drives: constant config, hits>0, no
+    behaviors): remaining decrements, resets when the item expires."""
+
+    def __init__(self):
+        self.state: Dict[int, Tuple[int, int]] = {}  # key -> (rem, exp)
+
+    def check(self, key, now, hits, limit, duration) -> Tuple[int, int]:
+        rem, exp = self.state.get(key, (None, None))
+        if rem is None or exp < now:
+            rem, exp = limit, now + duration
+            # new item (go:202-252)
+            if hits > limit:
+                self.state[key] = (limit, exp)
+                return 1, limit
+            self.state[key] = (limit - hits, exp)
+            return 0, limit - hits
+        if rem == 0 and hits > 0:
+            self.state[key] = (rem, exp)
+            return 1, rem
+        if hits > rem:
+            return 1, rem
+        self.state[key] = (rem - hits, exp)
+        return 0, rem - hits
