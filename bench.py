@@ -128,9 +128,13 @@ class Case:
     vs xla on identical traffic)."""
 
     def __init__(self, name, capacity, batches, seed_batches=None, seed_iter=None,
-                 math="mixed", active_counts=None, write=None):
+                 math="mixed", active_counts=None, write=None, layout=None):
         self.name = name
-        self.table = new_table2(capacity)
+        from gubernator_tpu.ops.layout import resolve_layout
+
+        self.table = new_table2(
+            capacity, layout=resolve_layout(layout or "full")
+        )
         self.batches = batches
         self.seed_batches = seed_batches if seed_batches is not None else batches
         self.seed_iter = seed_iter  # lazy seeding for huge keyspaces
@@ -469,6 +473,75 @@ def config5_case(rng, now) -> Case:
 
     return Case("config5-100M", CAPACITY, batches, seed_iter=seed_iter,
                 math="token")
+
+
+def layout_case(rng, now) -> dict:
+    """Packed slot-layout phase (PR 11): device decisions/s for the SAME
+    all-GCRA traffic on the full 64 B layout vs the packed 32 B gcra32
+    layout, at the largest live-key geometry the backend affords (TPU:
+    the 100M-key acceptance scale, the table walk BENCH_r05 measured
+    HBM-bound; CPU: a 1M-key proxy). Also records bytes/slot and live
+    keys per HBM GB — the ≥1.5×-decisions / 2×-capacity targets."""
+    from gubernator_tpu.ops.layout import FULL, GCRA32
+
+    on_tpu = jax.default_backend() == "tpu"
+    LIVE = 100_000_000 if on_tpu else 1 << 20
+    BATCH = (1 << 20) if on_tpu else (1 << 14)
+    CAPACITY = (1 << 27) if on_tpu else (1 << 21)
+    LIMIT, DUR = 16, 86_400_000  # T = 90 min — GCRA state stays live
+    keyspace = rng.integers(1, (1 << 63) - 1, size=LIVE, dtype=np.int64)
+    idx = np.unique(rng.integers(0, LIVE, size=BATCH * 10, dtype=np.int64))
+    idx = rng.permutation(idx)[: BATCH * 8]
+    algo = np.full(BATCH, int(Algorithm.GCRA), dtype=np.int32)
+
+    def batches():
+        return [
+            jax.device_put(
+                make_req_batch(
+                    keyspace[idx[i * BATCH : (i + 1) * BATCH]], now,
+                    algo=algo, limit=LIMIT, duration=DUR,
+                )
+            )
+            for i in range(8)
+        ]
+
+    def seed_iter():
+        for i in range(0, LIVE, BATCH):
+            chunk = keyspace[i : i + BATCH]
+            if chunk.shape[0] < BATCH:
+                chunk = np.pad(chunk, (0, BATCH - chunk.shape[0]))
+            b = make_req_batch(chunk, now, algo=algo, limit=LIMIT,
+                               duration=DUR)
+            if (chunk == 0).any():
+                b = b._replace(active=jnp.asarray(chunk != 0))
+            yield jax.device_put(b)
+
+    out: dict = {"live_keys": LIVE, "batch": BATCH}
+    rates = {}
+    for label, lay in (("full", "full"), ("gcra32", "gcra32")):
+        case = Case(
+            f"layout-{label}", CAPACITY, batches(), seed_iter=seed_iter,
+            math="gcra", layout=lay,
+        )
+        table_bytes = int(np.prod(case.table.rows.shape)) * 4
+        case.seed()
+        res = case.device_loop()
+        rates[label] = res.get("device_decisions_per_sec")
+        out[label] = {
+            **res,
+            "table_bytes": table_bytes,
+            "bytes_per_slot": case.table.layout.slot_bytes,
+            "live_keys_per_hbm_gb": round(
+                LIVE / (table_bytes / 2**30), 1
+            ),
+        }
+        del case  # release the table before the next layout's HBM claim
+    if rates.get("full") and rates.get("gcra32"):
+        out["packed_speedup"] = round(rates["gcra32"] / rates["full"], 3)
+    out["capacity_gain"] = round(
+        out["full"]["table_bytes"] / out["gcra32"]["table_bytes"], 2
+    )
+    return out
 
 
 def _pipelined_checks(eng, cols_iter, now, depth=2):
@@ -1207,7 +1280,7 @@ def durability_case(rng, now) -> dict:
     # warm restart: base put + frame replay vs the cold re-seed above
     dst = LocalEngine(capacity=int(LIVE * 1.7), write_mode=WRITE)
     t0 = time.perf_counter()
-    rows, _base_epoch = load_snapshot_meta(base_path)
+    rows, _base_epoch, _layout = load_snapshot_meta(base_path)
     dst.restore(rows)
     dst.merge_rows(fps_from_slots(d_slots), d_slots, now_ms=now + 5)
     restore_s = time.perf_counter() - t0
@@ -1927,6 +2000,15 @@ def main() -> None:
     matrix["cascade"] = _attempt(
         "cascade",
         lambda: cascade_case(np.random.default_rng(54), now),
+    )
+
+    # packed slot-layout phase (PR 11): full vs gcra32 device rates at the
+    # biggest geometry the backend affords + bytes/slot and keys/GB — the
+    # ≥1.5×-decisions / 2×-capacity acceptance surface. Late for the same
+    # HBM reason as config6.
+    matrix["layout"] = _attempt(
+        "layout",
+        lambda: layout_case(np.random.default_rng(55), now),
     )
 
     # latency phase (sweep vs sparse vs xla device terms per table size);

@@ -1161,6 +1161,125 @@ def algo_smoke() -> dict:
     return out
 
 
+def layout_smoke() -> dict:
+    """Packed slot-layout regression gate (PR 11):
+
+    (a) **bytes/slot** — the packed layouts must hold ≥1.8× fewer bytes
+        per slot than the full layout (measured on the actual table
+        arrays, not the descriptor constants), i.e. bytes/slot ≤ 0.55×;
+    (b) **decision parity at scale** — a gcra32 (and token32) table must
+        match the full-layout oracle decision-for-decision over ~1M-key
+        traffic with duplicates and time steps (the CPU-CI proxy for the
+        TPU 100M-key acceptance run);
+    (c) **checkpoint/delta bytes shrink proportionally** — the same dirty
+        set's delta frame under the packed layout must be ≤ 0.6× the
+        full-layout frame's bytes;
+    (d) **full stays bit-identical** — layout="full" and the pre-layout
+        default produce byte-equal tables for identical traffic.
+    """
+    from gubernator_tpu.ops.checkpoint import (
+        EpochTracker, extract_begin, finish_extract,
+    )
+    from gubernator_tpu.store import encode_delta_frame
+
+    rng = np.random.default_rng(17)
+    out: dict = {}
+
+    # ---- (d) full byte-identity pin
+    fp0 = rng.integers(1, (1 << 63) - 1, size=B, dtype=np.int64)
+    e_full = LocalEngine(capacity=1 << 14, write_mode="xla", layout="full")
+    e_def = LocalEngine(capacity=1 << 14, write_mode="xla")
+    for t in (NOW, NOW + 1000):
+        e_full.check_columns(cols(fp0), now_ms=t)
+        e_def.check_columns(cols(fp0), now_ms=t)
+    if not np.array_equal(np.asarray(e_full.table.rows),
+                          np.asarray(e_def.table.rows)):
+        print(json.dumps({"error": "layout smoke: layout=full diverged "
+                          "from the pre-layout default table bytes"}))
+        sys.exit(1)
+    out["full_bit_identical"] = True
+
+    # ---- (a)+(b) packed parity over a ~1M-key population
+    def pcols(fp, algo, hits, t):
+        n = fp.shape[0]
+        return cols(fp)._replace(
+            algo=np.full(n, algo, dtype=np.int32),
+            hits=np.asarray(hits, dtype=np.int64),
+            limit=np.full(n, 64, dtype=np.int64),
+            duration=np.full(n, 60_000, dtype=np.int64),
+            created_at=np.full(n, t, dtype=np.int64),
+        )
+
+    n_seed = 1 << 20
+    seed_fps = np.unique(rng.integers(
+        1, (1 << 63) - 1, size=n_seed + (n_seed >> 3), dtype=np.int64
+    ))[:n_seed]
+    for lay, algo in (("gcra32", 2), ("token32", 0)):
+        full_e = LocalEngine(capacity=1 << 21, write_mode="xla",
+                             layout="full")
+        pack_e = LocalEngine(capacity=1 << 21, write_mode="xla", layout=lay)
+        bytes_full = np.asarray(full_e.table.rows).nbytes
+        bytes_pack = np.asarray(pack_e.table.rows).nbytes
+        ratio = bytes_pack / bytes_full
+        out[f"{lay}_bytes_per_slot_ratio"] = round(ratio, 3)
+        out[f"{lay}_live_keys_per_gb_gain"] = round(1.0 / ratio, 2)
+        if ratio > 0.55:
+            print(json.dumps({"error": f"layout smoke: {lay} bytes/slot "
+                              f"ratio {ratio:.3f} above the 0.55 floor",
+                              **out}))
+            sys.exit(1)
+        t = NOW
+        bsz = 1 << 16
+        for i in range(0, n_seed, bsz):  # seed ~1M live keys
+            sl = seed_fps[i:i + bsz]
+            h = np.ones(sl.shape[0], dtype=np.int64)
+            full_e.check_columns(pcols(sl, algo, h, t), now_ms=t)
+            pack_e.check_columns(pcols(sl, algo, h, t), now_ms=t)
+        mism = 0
+        for step in range(4):  # re-hit a slice, duplicates included
+            t += int(rng.integers(100, 5_000))
+            sel = seed_fps[rng.integers(0, n_seed, size=4096)]
+            h = rng.integers(0, 4, size=4096)
+            a = full_e.check_columns(pcols(sel, algo, h, t), now_ms=t)
+            b = pack_e.check_columns(pcols(sel, algo, h, t), now_ms=t)
+            for f in ("status", "remaining", "reset_time", "err"):
+                mism += int((np.asarray(getattr(a, f))
+                             != np.asarray(getattr(b, f))).sum())
+        out[f"{lay}_parity_mismatches"] = mism
+        out[f"{lay}_live"] = pack_e.live_count(t)
+        if mism or pack_e.stats.layout_migrations:
+            print(json.dumps({"error": f"layout smoke: {lay} parity vs the "
+                              "full-layout oracle failed", **out}))
+            sys.exit(1)
+        if pack_e.live_count(t) != full_e.live_count(t):
+            print(json.dumps({"error": f"layout smoke: {lay} live-key count "
+                              "diverged from full", **out}))
+            sys.exit(1)
+
+        # ---- (c) checkpoint bytes shrink with the layout
+        if lay == "gcra32":
+            for e, label in ((full_e, "full"), (pack_e, "packed")):
+                e.ckpt = EpochTracker(e.table.rows.shape[0])
+                e.check_columns(
+                    pcols(seed_fps[: 1 << 14],
+                          algo, np.ones(1 << 14, dtype=np.int64), t),
+                    now_ms=t,
+                )
+                _, gids = e.ckpt.take()
+                _f, slots = finish_extract(extract_begin(
+                    e.table.rows, gids, e.ckpt.blk, t, layout=e.table.layout
+                ))
+                frame = encode_delta_frame(1, t, slots, layout=e.table.layout)
+                out[f"delta_bytes_{label}"] = len(frame)
+            dratio = out["delta_bytes_packed"] / max(out["delta_bytes_full"], 1)
+            out["delta_bytes_ratio"] = round(dratio, 3)
+            if dratio > 0.6:
+                print(json.dumps({"error": "layout smoke: packed delta "
+                                  "frame not proportionally smaller", **out}))
+                sys.exit(1)
+    return out
+
+
 def main() -> None:
     eng = LocalEngine(capacity=1 << 15, write_mode="xla")
     rng = np.random.default_rng(0)
@@ -1187,6 +1306,7 @@ def main() -> None:
         "mesh_smoke": mesh_smoke(),
         "durability_smoke": durability_smoke(),
         "algo_smoke": algo_smoke(),
+        "layout_smoke": layout_smoke(),
     }))
 
 

@@ -24,6 +24,7 @@
 #include <Python.h>
 
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <string>
 #include <vector>
@@ -454,16 +455,22 @@ static inline void put_tag(std::string& out, uint32_t field, uint32_t wt) {
 }
 
 // encode_responses(status_i64, limit_i64, remaining_i64, reset_i64,
-//                  errors: dict[int, str]) -> bytes(GetRateLimitsResp)
+//                  errors: dict[int, str], now_ms: int = -1)
+//                  -> bytes(GetRateLimitsResp)
 // The column buffers are raw little-endian int64 — any buffer-protocol
 // object works (contiguous numpy int64 arrays pass ZERO-COPY; no .tobytes()
 // round trip). Error strings are gathered under the GIL up front; the
 // varint/field assembly then runs with the GIL RELEASED so N responder
-// workers encode concurrently.
+// workers encode concurrently. With now_ms >= 0, DENIED rows additionally
+// carry metadata["retry_after_ms"] = max(0, reset_time - now_ms) — for
+// GCRA denials reset_time is the exact TAT-derived conforming instant
+// (ops/math.py), so clients honoring it back off precisely.
 static PyObject* encode_responses(PyObject*, PyObject* args) {
   Py_buffer sb, lb, rb, tb;
   PyObject* errs;
-  if (!PyArg_ParseTuple(args, "y*y*y*y*O", &sb, &lb, &rb, &tb, &errs))
+  long long now_ms = -1;
+  if (!PyArg_ParseTuple(args, "y*y*y*y*O|L", &sb, &lb, &rb, &tb, &errs,
+                        &now_ms))
     return nullptr;
   size_t n = (size_t)(sb.len / 8);
   const int64_t* st = (const int64_t*)sb.buf;
@@ -509,6 +516,24 @@ static PyObject* encode_responses(PyObject*, PyObject* args) {
       put_tag(item, 5, 2);
       put_varint(item, err_at[i]->size());
       item += *err_at[i];
+    }
+    if (now_ms >= 0 && st[i] == 1) {
+      // metadata map entry {1: "retry_after_ms", 2: decimal-ms}
+      static const char RA_KEY[] = "retry_after_ms";
+      long long d = rt[i] - now_ms;
+      if (d < 0) d = 0;
+      char vbuf[24];
+      int vlen = snprintf(vbuf, sizeof vbuf, "%lld", d);
+      std::string entry;
+      put_tag(entry, 1, 2);
+      put_varint(entry, sizeof(RA_KEY) - 1);
+      entry.append(RA_KEY, sizeof(RA_KEY) - 1);
+      put_tag(entry, 2, 2);
+      put_varint(entry, (uint64_t)vlen);
+      entry.append(vbuf, (size_t)vlen);
+      put_tag(item, 6, 2);
+      put_varint(item, entry.size());
+      item += entry;
     }
     put_tag(out, 1, 2);
     put_varint(out, item.size());

@@ -83,6 +83,14 @@ class RespBatch(NamedTuple):
     reset_time: jnp.ndarray  # int64
     cache_hit: jnp.ndarray  # bool — row found a live matching slot
     dropped: jnp.ndarray  # bool — no slot could be claimed (decision not persisted)
+    # stored-state echoes for full-fidelity GLOBAL broadcasts
+    # (kernel2.decide2_impl → global_sync._sync_core): the raw aux lane
+    # writeback (GCRA TAT / sliding-window previous count) and the
+    # remaining-STYLE integer lane (limit - current for windows). None on
+    # legacy constructors (the v1 oracle kernel); DCE'd by every serving
+    # graph (pack_outputs reads neither).
+    aux: jnp.ndarray = None  # int64 | None
+    rem_store: jnp.ndarray = None  # int64 | None
 
 
 class BatchStats(NamedTuple):
@@ -115,6 +123,12 @@ class InstallBatch(NamedTuple):
     # gubernator.go:434-474) — its callers pass burst=limit, stamp=now.
     burst: jnp.ndarray  # int64
     stamp: jnp.ndarray  # int64
+    # sliding-window broadcast fidelity (PR 11): the previous-window count
+    # (raw aux lane) and the stored-style remaining (limit - current
+    # count). None on legacy wire paths — install2 then falls back to the
+    # conservative weighted rebuild (docs/algorithms.md "Sliding window").
+    aux: jnp.ndarray = None  # int64 | None
+    rem_store: jnp.ndarray = None  # int64 | None
 
 
 class HostBatch(NamedTuple):

@@ -48,15 +48,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from gubernator_tpu.ops.table2 import (
-    EXP_HI,
-    EXP_LO,
-    F,
-    FP_HI,
-    FP_LO,
-    K,
-    ROW,
-)
+from gubernator_tpu.ops.table2 import FP_HI, FP_LO, K
 
 
 def ckpt_blk() -> int:
@@ -173,40 +165,45 @@ class EpochTracker:
 # ------------------------------------------------------------- extract pass
 
 
-def _extract_blocks_core(rows2d, bidx, now, blk: int):
+def _extract_blocks_core(rows2d, bidx, now, blk: int, layout=None):
     """Traced core shared by the single-device jit and the per-shard
     shard_map body (parallel/sharded.py): gather the dirty blocks' bucket
     rows, filter live slots, pack them to the front.
 
-    `rows2d` is (T, ROW); `bidx` (g,) block ids with out-of-range sentinels
-    for padding (jnp.take mode="fill" zero-fills them — fp == 0 rows are
-    never live). Returns (slots (g·blk·K, F) live-first, fp (g·blk·K,),
-    live_count)."""
+    `rows2d` is (T, ROW_layout); `bidx` (g,) block ids with out-of-range
+    sentinels for padding (jnp.take mode="fill" zero-fills them — fp == 0
+    rows are never live). Returns (slots (g·blk·K, F_layout) live-first,
+    fp (g·blk·K,), live_count) — slots stay in the table's own layout, so
+    packed tables' delta frames carry HALF the bytes per row."""
+    if layout is None:
+        from gubernator_tpu.ops.layout import FULL as layout
     g = bidx.shape[0]
     rowidx = (
         bidx[:, None].astype(jnp.int32) * blk
         + jnp.arange(blk, dtype=jnp.int32)[None, :]
     ).reshape(-1)
     blocks = jnp.take(rows2d, rowidx, axis=0, mode="fill", fill_value=0)
-    slots = blocks.reshape(g * blk * K, F)
+    slots = blocks.reshape(g * blk * K, layout.F)
     lo = slots[:, FP_LO].astype(jnp.int64) & 0xFFFFFFFF
     hi = slots[:, FP_HI].astype(jnp.int64)
     fp = (hi << 32) | lo
-    exp = (slots[:, EXP_LO].astype(jnp.int64) & 0xFFFFFFFF) | (
-        slots[:, EXP_HI].astype(jnp.int64) << 32
+    exp = (slots[:, layout.exp_lo_i].astype(jnp.int64) & 0xFFFFFFFF) | (
+        slots[:, layout.exp_hi_i].astype(jnp.int64) << 32
     )
     live = (fp != 0) & (exp >= now)
     order = jnp.argsort(jnp.where(live, 0, 1).astype(jnp.int32))
     return slots[order], fp[order], live.sum()
 
 
-@functools.partial(jax.jit, static_argnames=("blk",))
-def _extract_blocks_sorted(rows, bidx, now, *, blk: int):
-    """Single-array entry: accepts any (..., ROW) rows layout ((NB, ROW)
-    local or (D, NB, ROW) sharded — the flatten folds the shard axis in,
-    exactly like table2._extract_sorted; block ids are then GLOBAL,
-    shard-major)."""
-    return _extract_blocks_core(rows.reshape(-1, ROW), bidx, now, blk)
+@functools.partial(jax.jit, static_argnames=("blk", "layout"))
+def _extract_blocks_sorted(rows, bidx, now, *, blk: int, layout):
+    """Single-array entry: accepts any (..., ROW_layout) rows array
+    ((NB, ·) local or (D, NB, ·) sharded — the flatten folds the shard
+    axis in, exactly like table2._extract_sorted; block ids are then
+    GLOBAL, shard-major)."""
+    return _extract_blocks_core(
+        rows.reshape(-1, layout.row), bidx, now, blk, layout
+    )
 
 
 def _pad_pow2(n: int, floor: int = 8) -> int:
@@ -216,12 +213,17 @@ def _pad_pow2(n: int, floor: int = 8) -> int:
     return p
 
 
-def extract_begin(rows, gids: np.ndarray, blk: int, now_ms: int):
+def extract_begin(rows, gids: np.ndarray, blk: int, now_ms: int, layout=None):
     """LAUNCH half of a dirty-block extract (engine thread — must read a
     coherent table, costs only the enqueue): pads the dirty-block list to a
     pow2 grid width (log-many compiled shapes) with an out-of-range
     sentinel and launches the gather+filter+pack. Returns a pending handle
-    for finish_extract."""
+    for finish_extract. `layout` is the table's slot layout (full when
+    omitted — the legacy geometry)."""
+    if layout is None:
+        from gubernator_tpu.ops.layout import layout_for_row
+
+        layout = layout_for_row(int(rows.shape[-1]))
     # sentinel: one past the last valid block id in the flattened layout
     sentinel = int(np.prod(rows.shape[:-1])) // blk
     g = int(gids.shape[0])
@@ -229,7 +231,8 @@ def extract_begin(rows, gids: np.ndarray, blk: int, now_ms: int):
     bidx = np.full(pad, sentinel, dtype=np.int64)
     bidx[:g] = gids
     slots_s, fp_s, cnt = _extract_blocks_sorted(
-        rows, jnp.asarray(bidx), jnp.asarray(np.int64(now_ms)), blk=blk
+        rows, jnp.asarray(bidx), jnp.asarray(np.int64(now_ms)),
+        blk=blk, layout=layout,
     )
     return slots_s, fp_s, cnt
 
@@ -237,11 +240,16 @@ def extract_begin(rows, gids: np.ndarray, blk: int, now_ms: int):
 def finish_extract(pending):
     """FETCH half (any thread): materialize the live count, then fetch only
     the live prefix padded to a pow2 so the compiled slice shapes stay
-    logarithmic in extract size (the extract_live_rows fetch rule)."""
+    logarithmic in extract size (the extract_live_rows fetch rule). Slots
+    come back in the table's own layout (width = the pending arrays')."""
     slots_s, fp_s, cnt = pending
     n = int(cnt)
     if n == 0:
-        return np.empty(0, dtype=np.int64), np.empty((0, F), dtype=np.int32)
+        width = int(slots_s.shape[-1])
+        return (
+            np.empty(0, dtype=np.int64),
+            np.empty((0, width), dtype=np.int32),
+        )
     pad = 256
     while pad < n:
         pad *= 2

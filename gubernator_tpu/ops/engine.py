@@ -108,6 +108,37 @@ def _math_mode(hb: HostBatch) -> str:
     return "int"
 
 
+def batch_needs_full_layout(layout, math: str, hb=None) -> bool:
+    """Host-side: can `layout` serve this batch? Shared by the local and
+    mesh engines. Computed from the BATCH alone — migration only ever goes
+    packed → full, so a prep-thread race reads at worst a stale packed
+    layout and the engine-thread migrate call no-ops."""
+    from gubernator_tpu.ops.layout import FULL
+
+    if layout is FULL:
+        return False
+    if not layout.supports_math(math):
+        return True
+    if hb is not None and isinstance(hb, HostBatch):
+        if not layout.greg_ok and (np.asarray(hb.greg_interval) != 0).any():
+            return True
+        if not layout.supports_algos(hb.algo, hb.active):
+            return True
+    return False
+
+
+def effective_math(layout, hb) -> str:
+    """The dispatch's math mode, layout-adjusted: an all-padding batch
+    (warm-ups, all-error rows) defaults to "token" in _math_mode, which a
+    packed non-token table cannot serve — padding rows ride ANY
+    algorithm's lanes harmlessly (ops/math.py), so such batches take the
+    layout's own mode instead of forcing a spurious migration."""
+    math = _math_mode(hb)
+    if not layout.supports_math(math) and not np.asarray(hb.active).any():
+        return layout.modes[0]
+    return math
+
+
 def _has_cascade(hb) -> bool:
     """Whether a packed batch carries cascade level bits (behavior bits
     8-15, types.CASCADE_LEVEL_SHIFT)."""
@@ -171,6 +202,10 @@ class EngineStats:
     # hit/miss/over counter, so without this the identity hits+misses ≈
     # checks would drift silently under sustained hot-shard overflow
     unprocessed_dropped: int = 0
+    # packed-layout tables migrated to the full layout because off-family
+    # traffic arrived (ops/layout.py selection contract) — a nonzero count
+    # on a single-algorithm fleet means GUBER_SLOT_LAYOUT is misconfigured
+    layout_migrations: int = 0
 
     def accumulate(self, stats, count_dropped: bool = True) -> None:
         self.cache_hits += int(stats.cache_hits)
@@ -192,6 +227,7 @@ class EngineStats:
         self.dispatches += d.dispatches
         self.created_at_clamped += d.created_at_clamped
         self.unprocessed_dropped += d.unprocessed_dropped
+        self.layout_migrations += d.layout_migrations
 
 
 def _plan(engine, hb):
@@ -706,10 +742,28 @@ class LocalEngine:
         created_at_tolerance_ms: Optional[int] = None,
         store=None,
         wire: Optional[str] = None,
+        layout: Optional[str] = None,
     ):
+        from gubernator_tpu.ops.layout import resolve_layout
         from gubernator_tpu.ops.wire import default_wire_mode
 
-        self.table = table if table is not None else new_table2(capacity)
+        # slot layout (ops/layout.py): "full" (bit-compatible default),
+        # "gcra32"/"token32" (32 B packed rows for single-algorithm
+        # tables), or "auto"/"packed" policies; None reads
+        # GUBER_SLOT_LAYOUT. Off-family traffic migrates a packed table to
+        # full in place (one unpack) rather than erroring.
+        if table is None:
+            self._layout = resolve_layout(layout)
+        else:
+            # injected tables carry their own layout; the v1 oracle's
+            # legacy Table has none (its plane layout predates descriptors)
+            from gubernator_tpu.ops.layout import FULL
+
+            self._layout = getattr(table, "layout", FULL)
+        self.table = (
+            table if table is not None
+            else new_table2(capacity, layout=self._layout)
+        )
         # host↔device wire format: "compact" ships 5-lane int32 ingress +
         # int32 egress (ops/wire.py, the TPU default — GUBER_WIRE_COMPACT),
         # "full" the 12-lane int64 grids (the parity oracle). Per-dispatch
@@ -759,6 +813,37 @@ class LocalEngine:
         if self.ckpt is not None:
             self.ckpt.mark(np.asarray(fps))
 
+    # ---------------------------------------------------------- slot layout
+
+    def _batch_needs_full(self, math: str, hb=None) -> bool:
+        return batch_needs_full_layout(self.table.layout, math, hb)
+
+    def _effective_math(self, hb: HostBatch) -> str:
+        return effective_math(self.table.layout, hb)
+
+    def migrate_layout_full(self, reason: str = "off-family traffic") -> bool:
+        """Migrate a packed table to the canonical full layout in place —
+        one jitted row unpack, engine thread only. Returns True when a
+        migration actually happened. The one-way direction is deliberate:
+        packed layouts are a boot-time bet on single-algorithm traffic,
+        and losing the bet must degrade to correct-and-bigger, never to
+        wrong bytes."""
+        from gubernator_tpu.ops.layout import FULL
+
+        lay = self.table.layout
+        if lay is FULL:
+            return False
+        import logging
+
+        logging.getLogger("gubernator_tpu.engine").warning(
+            "migrating table layout %s -> full (%s)", lay.name, reason
+        )
+        rows_full = jax.jit(lay.unpack_rows)(self.table.rows)
+        self.table = Table2(rows=rows_full, layout=FULL)
+        self._layout = FULL
+        self.stats.layout_migrations += 1
+        return True
+
     def _decide_packed(self, hb: HostBatch, cascade: bool = False) -> np.ndarray:
         """One dispatch → ONE host transfer each way: compact 5-lane int32
         wire block (or full packed (12, B) ingress) in, compact int32 (or
@@ -772,10 +857,13 @@ class LocalEngine:
             # same downstream shape
             self.table, resp, stats = self._decide_fn(self.table, to_device(hb))
             return np.asarray(pack_outputs(resp, stats))
+        math = self._effective_math(hb)
+        if self._batch_needs_full(math, hb):
+            self.migrate_layout_full()
         dev, wired = self._stage_ingress(hb)
         return np.asarray(
             self._issue_from_dev(
-                dev, int(hb.fp.shape[0]), _math_mode(hb), wired, cascade
+                dev, int(hb.fp.shape[0]), math, wired, cascade
             )
         )
 
@@ -826,10 +914,13 @@ class LocalEngine:
 
     def stage_pass(self, pass_batch: HostBatch, n: int, cascade: bool = False):
         """(padded batch, staged ingress array + static math/wire/cascade
-        modes) for one unique-fp pass."""
+        modes + layout-mismatch flag) for one unique-fp pass."""
         batch = pad_batch(pass_batch, _pad_size(n))
+        math = self._effective_math(batch)
         dev, wired = self._stage_ingress(batch)
-        return batch, (dev, _math_mode(batch), wired, cascade)
+        return batch, (
+            dev, math, wired, cascade, self._batch_needs_full(math, batch)
+        )
 
     @property
     def supports_cascade_intrace(self) -> bool:
@@ -852,13 +943,21 @@ class LocalEngine:
     def stage_wire(self, grid: np.ndarray, math: str, cascade: bool = False):
         """Stage a fused front-door grid (ops/wire.assemble_wire_grid
         output) — same staged tuple as stage_pass's, issued by
-        issue_staged unchanged."""
+        issue_staged unchanged. Wire grids carry no Gregorian rows
+        (wire_encodable excludes them) and their algorithm family is
+        implied by the math mode, so the layout check needs no batch."""
         import jax
 
-        return jax.device_put(grid), math, True, cascade
+        return (
+            jax.device_put(grid), math, True, cascade,
+            self._batch_needs_full(math),
+        )
 
     def issue_staged(self, staged, batch_rows: int):
-        dev, math, wired, cascade = staged
+        dev, math, wired, cascade, needs_full = staged
+        if needs_full:
+            # engine thread — the only thread allowed to swap the table
+            self.migrate_layout_full()
         self._seen_pad_sizes.add(batch_rows)
         return self._issue_from_dev(dev, batch_rows, math, wired, cascade)
 
@@ -926,8 +1025,11 @@ class LocalEngine:
         the columns fast path."""
         if not requests:
             return []
+        from gubernator_tpu.types import retry_after_ms
+
+        now = now_ms if now_ms is not None else ms_now()
         cols = columns_from_requests(requests)
-        rc = self.check_columns(cols, now_ms=now_ms)
+        rc = self.check_columns(cols, now_ms=now)
         return [
             RateLimitResponse(
                 status=int(rc.status[i]),
@@ -935,6 +1037,9 @@ class LocalEngine:
                 remaining=int(rc.remaining[i]),
                 reset_time=int(rc.reset_time[i]),
                 error=ERROR_STRINGS[int(rc.err[i])],
+                retry_after_ms=retry_after_ms(
+                    int(rc.status[i]), int(rc.reset_time[i]), now
+                ),
             )
             for i in range(len(requests))
         ]
@@ -989,13 +1094,17 @@ class LocalEngine:
         now_ms: Optional[int] = None,
         burst: Optional[np.ndarray] = None,
         stamp: Optional[np.ndarray] = None,
+        aux: Optional[np.ndarray] = None,
+        rem_store: Optional[np.ndarray] = None,
     ) -> int:
         """Install owner-authoritative GLOBAL statuses as fresh items — the
         UpdatePeerGlobals receive path (reference gubernator.go:434-474).
         Returns the number installed. `burst`/`stamp` default to the wire
         path's lossy rebuild (Burst=Limit, stamp=now — exactly the
         reference's, gubernator.go:434-474); the Store rehydrate path passes
-        the stored values for full fidelity."""
+        the stored values for full fidelity. `aux`/`rem_store` carry
+        sliding-window broadcast fidelity (previous-window count and the
+        stored-style remaining) when the wire provides them."""
         if self._decide_fn is not None:
             raise RuntimeError("install_columns unsupported on the v1 oracle engine")
         now = now_ms if now_ms is not None else ms_now()
@@ -1006,6 +1115,8 @@ class LocalEngine:
             burst = np.asarray(limit, dtype=np.int64)
         if stamp is None:
             stamp = np.full(n, now, dtype=np.int64)
+        if not self.table.layout.supports_algos(algo):
+            self.migrate_layout_full("install of off-family algorithms")
         self._mark_dirty(fp)
         size = _pad_size(n)
 
@@ -1028,6 +1139,11 @@ class LocalEngine:
             active=jnp.asarray(pad(np.ones(n, dtype=bool), bool)),
             burst=jnp.asarray(pad(burst, np.int64)),
             stamp=jnp.asarray(pad(stamp, np.int64)),
+            aux=None if aux is None else jnp.asarray(pad(aux, np.int64)),
+            rem_store=(
+                None if rem_store is None
+                else jnp.asarray(pad(rem_store, np.int64))
+            ),
         )
         self.table, installed = install2(self.table, inst, write=self.write_mode)
         self.stats.dispatches += 1
@@ -1041,36 +1157,68 @@ class LocalEngine:
     # nor re-snapshotted by the source.
 
     def extract_live(self, now_ms: Optional[int] = None):
-        """All live slots as (fps (N,) i64, slots (N, F) i32) host arrays —
-        the device pays for the full-table filter+pack, the host fetches
-        only the live prefix (ops/table2.extract_live_rows)."""
+        """All live slots as (fps (N,) i64, slots (N, F_layout) i32) host
+        arrays — the device pays for the full-table filter+pack, the host
+        fetches only the live prefix (ops/table2.extract_live_rows). Slots
+        ride the table's own layout; the TransferState wire tags them with
+        the layout code so a receiver on a different layout converts
+        through the canonical full row."""
         from gubernator_tpu.ops.table2 import extract_live_rows
 
         now = now_ms if now_ms is not None else ms_now()
-        return extract_live_rows(self.table.rows, now)
+        return extract_live_rows(
+            self.table.rows, now, layout=self.table.layout
+        )
+
+    def _slots_to_full(self, slots: np.ndarray, layout=None) -> np.ndarray:
+        """Normalize incoming slot rows to the canonical full layout — the
+        one cross-layout conversion point (ops/layout.py contract). With no
+        explicit layout, a 16-field row is full and an 8-field row is
+        assumed to be this table's own packed layout (same-fleet
+        transfers); cross-layout senders always say theirs."""
+        from gubernator_tpu.ops import layout as layout_mod
+
+        if layout is None:
+            if slots.shape[1] == layout_mod.FULL.F:
+                layout = layout_mod.FULL
+            elif slots.shape[1] == self.table.layout.F:
+                layout = self.table.layout
+            else:
+                raise ValueError(
+                    f"cannot infer slot layout for width {slots.shape[1]}"
+                )
+        return np.asarray(layout.unpack(slots))
 
     def merge_rows(
-        self, fps: np.ndarray, slots: np.ndarray, now_ms: Optional[int] = None
+        self, fps: np.ndarray, slots: np.ndarray,
+        now_ms: Optional[int] = None, layout=None,
     ) -> int:
         """Conservatively merge transferred slot rows (TransferState receive
         path): remaining=min, expiry=max, newest config wins. Returns the
-        number of rows merged/installed. Duplicate fingerprints within one
-        call merge as sequential passes — the claim machinery's unique-fp
-        contract, same as the serving planner's (a chunk from one extract is
-        always unique, but crossed transfers may not be)."""
+        number of rows merged/installed. `slots` may arrive in any sender
+        layout (`layout`; inferred for full-width / same-layout rows) — the
+        merge itself always runs on canonical full rows, so the
+        conservatism is layout-independent. Duplicate fingerprints within
+        one call merge as sequential passes — the claim machinery's
+        unique-fp contract, same as the serving planner's (a chunk from one
+        extract is always unique, but crossed transfers may not be)."""
         import jax.numpy as jnp
 
         from gubernator_tpu.ops.kernel2 import merge2
+        from gubernator_tpu.ops.table2 import FLAGS
 
         n = fps.shape[0]
         if n == 0:
             return 0
+        slots = self._slots_to_full(slots, layout)
         rank = _occurrence_rank(fps)
         if rank.max() > 0:
             return sum(
                 self.merge_rows(fps[rank == r], slots[rank == r], now_ms)
                 for r in range(int(rank.max()) + 1)
             )
+        if not self.table.layout.supports_algos(slots[:, FLAGS] & 0xFF):
+            self.migrate_layout_full("merge of off-family rows")
         now = now_ms if now_ms is not None else ms_now()
         self._mark_dirty(fps)
         size = _pad_size(n)
@@ -1091,6 +1239,32 @@ class LocalEngine:
         self.stats.dispatches += 1
         return int(np.asarray(merged).sum())
 
+    def read_state(self, fps: np.ndarray):
+        """Read the full-width stored slots for `fps` without mutating
+        anything: (found (n,) bool, slots (n, 16) i32 canonical fields).
+        One device bucket gather — the GLOBAL broadcast plane uses this to
+        attach sliding-window aux (prev count, stored remaining) to owner
+        updates (service/global_manager._broadcast)."""
+        import jax.numpy as jnp
+
+        from gubernator_tpu.ops.table2 import F as F_FULL, gather_slots
+
+        n = fps.shape[0]
+        if n == 0:
+            return (
+                np.zeros(0, dtype=bool), np.zeros((0, F_FULL), dtype=np.int32)
+            )
+        size = _pad_size(n)
+        fp_p = np.zeros(size, dtype=np.int64)
+        fp_p[:n] = fps
+        active = np.zeros(size, dtype=bool)
+        active[:n] = True
+        slots, found = gather_slots(
+            self.table.rows, jnp.asarray(fp_p), jnp.asarray(active),
+            layout=self.table.layout,
+        )
+        return np.asarray(found)[:n].copy(), np.asarray(slots)[:n].copy()
+
     def tombstone_fps(self, fps: np.ndarray) -> int:
         """Zero the slots holding `fps` (post-ack handoff cleanup). Missing
         fingerprints are no-ops; returns the number actually removed."""
@@ -1110,7 +1284,7 @@ class LocalEngine:
         rows, found = tombstone_rows(
             self.table.rows, jnp.asarray(fp_p), jnp.asarray(active)
         )
-        self.table = Table2(rows=rows)
+        self.table = Table2(rows=rows, layout=self.table.layout)
         self.stats.dispatches += 1
         return int(np.asarray(found).sum())
 
@@ -1124,7 +1298,8 @@ class LocalEngine:
         from gubernator_tpu.ops.telemetry import scan_begin
 
         return scan_begin(
-            self.table.rows, now_ms if now_ms is not None else ms_now()
+            self.table.rows, now_ms if now_ms is not None else ms_now(),
+            layout=self.table.layout,
         )
 
     # ---------------------------------------------------------- checkpointing
@@ -1134,17 +1309,41 @@ class LocalEngine:
         reference store.go:49-60 / workers.go:457-540)."""
         return np.asarray(self.table.rows)
 
-    def restore(self, rows: np.ndarray) -> None:
+    def restore(self, rows: np.ndarray, layout=None) -> None:
         """Host→device restore of a snapshot taken by `snapshot()` (the
-        Loader.Load analog, reference workers.go:335-419)."""
+        Loader.Load analog, reference workers.go:335-419). A snapshot
+        written under a DIFFERENT slot layout (`layout` — recorded in the
+        snapshot file) converts through the canonical full row on the host
+        when the bucket geometry matches; the engine's own layout is kept."""
         import jax
         import jax.numpy as jnp
 
+        lay = self.table.layout
+        if layout is not None and layout is not lay:
+            from gubernator_tpu.ops.table2 import FLAGS, F as F_FULL
+
+            if rows.shape[:-1] != tuple(self.table.rows.shape[:-1]):
+                raise ValueError(
+                    f"snapshot geometry {rows.shape} incompatible with "
+                    f"table {tuple(self.table.rows.shape)}"
+                )
+            full = np.asarray(layout.unpack_rows(rows))
+            slots = full.reshape(-1, F_FULL)
+            occupied = (slots[:, 0] != 0) | (slots[:, 1] != 0)
+            if not lay.supports_algos((slots[:, FLAGS] & 0xFF)[occupied]):
+                # the snapshot holds rows this packed layout cannot store:
+                # degrade the ENGINE to full rather than corrupt state
+                self.migrate_layout_full("restore of off-family snapshot")
+                lay = self.table.layout
+            rows = np.asarray(lay.pack_rows(full))
         if rows.shape != tuple(self.table.rows.shape):
             raise ValueError(
                 f"snapshot shape {rows.shape} != table {tuple(self.table.rows.shape)}"
             )
-        self.table = Table2(rows=jax.device_put(jnp.asarray(rows, dtype=jnp.int32)))
+        self.table = Table2(
+            rows=jax.device_put(jnp.asarray(rows, dtype=jnp.int32)),
+            layout=lay,
+        )
         if self.ckpt is not None:
             # a mid-life restore replaces state of unknown provenance: the
             # next delta epoch must capture everything live, not just what
@@ -1160,7 +1359,10 @@ class LocalEngine:
         from gubernator_tpu.ops.checkpoint import extract_begin
 
         now = now_ms if now_ms is not None else ms_now()
-        return extract_begin(self.table.rows, gids, self.ckpt.blk, now)
+        return extract_begin(
+            self.table.rows, gids, self.ckpt.blk, now,
+            layout=self.table.layout,
+        )
 
     def checkpoint_finish(self, pending):
         """FETCH half: (fps (N,) i64, slots (N, F) i32) — only the live
@@ -1195,10 +1397,13 @@ class LocalEngine:
         from gubernator_tpu.ops.table2 import n_buckets_for, rehash_rows
 
         now = now_ms if now_ms is not None else ms_now()
+        lay = self.table.layout
         new_rows, dropped = rehash_rows(
-            self.snapshot(), n_buckets_for(new_capacity), now
+            self.snapshot(), n_buckets_for(new_capacity), now, layout=lay
         )
-        self.table = Table2(rows=jax.device_put(jnp.asarray(new_rows)))
+        self.table = Table2(
+            rows=jax.device_put(jnp.asarray(new_rows)), layout=lay
+        )
         self.stats.evicted_unexpired += dropped
         if self.ckpt is not None:
             # block ids do not survive a geometry change: fresh tracker,
@@ -1212,9 +1417,14 @@ class LocalEngine:
         # leaky row the mixed one (_math_mode; the all-GCRA "gcra" variant
         # needs an ACTIVE row, so a rare pure-GCRA batch right after a
         # resize pays its own compile).
+        from gubernator_tpu.ops.layout import FULL as _FULL
+
+        # packed layouts warm only their own math graph (off-family probe
+        # rows would trigger a spurious migration)
+        probe_algos = (0, 2, 1) if lay is _FULL else (lay.algos[0],)
         for size in sorted(self._seen_pad_sizes):
             z64 = np.zeros(size, dtype=np.int64)
-            for probe_algo in (0, 2, 1):
+            for probe_algo in probe_algos:
                 algo = np.zeros(size, dtype=np.int32)
                 algo[0] = probe_algo
                 dummy = HostBatch(

@@ -260,8 +260,15 @@ class Claim2(NamedTuple):
 
 
 def _probe_claim2(
-    rows_tbl: jnp.ndarray, fp, now, active, blk: int, u: int
+    rows_tbl: jnp.ndarray, fp, now, active, blk: int, u: int, layout=None
 ) -> Claim2:
+    """Probe + claim. `layout` (ops/layout.py) is the table's slot layout:
+    the row gather fetches layout.row lanes per bucket — HALF the HBM
+    bytes for the 32 B packed layouts — and the packed fields unpack to
+    the canonical 16-field slots in registers, so every consumer below
+    (claim ordering, decision math, merge rules) stays layout-blind."""
+    if layout is None:
+        from gubernator_tpu.ops.layout import FULL as layout
     NB = rows_tbl.shape[0]
     B = fp.shape[0]
     if NB * K * 2 >= 2**31:
@@ -271,8 +278,8 @@ def _probe_claim2(
     my_lo = _lo32(fp)
     my_hi = _hi32(fp)
 
-    rows = rows_tbl[bucket]  # (B, 128) row gather — the only table read
-    slots = rows.reshape(B, K, F)
+    rows = rows_tbl[bucket]  # (B, ROW_layout) row gather — the only table read
+    slots = layout.unpack(rows.reshape(B, K, layout.F))  # (B, K, 16) canonical
     s_fp_lo = slots[:, :, FP_LO]
     s_fp_hi = slots[:, :, FP_HI]
 
@@ -371,7 +378,8 @@ def _probe_claim2(
 # --------------------------------------------------------------------- write
 
 
-def _make_sweep_kernel(nwin: int, blk: int, u: int, sparse: bool = False):
+def _make_sweep_kernel(nwin: int, blk: int, u: int, fl: int = F,
+                       sparse: bool = False):
     """Kernel factory for the scalar-prefetch sweep (closes over geometry).
 
     Windowing lives IN the kernel: updates stay in target-sorted order; the
@@ -396,8 +404,12 @@ def _make_sweep_kernel(nwin: int, blk: int, u: int, sparse: bool = False):
 
     `sparse=True` builds the block-sparse variant (_write_sparse): grid step
     i composes the dirty block named by the scalar-prefetched `db_ref[i]`
-    instead of block i — same body, data-dependent block base."""
+    instead of block i — same body, data-dependent block base. `fl` is the
+    table layout's fields-per-slot (ops/layout.py): payload rows are
+    (u, fl) and table blocks (blk, K·fl) — the packed layouts stream half
+    the bytes per block through VMEM."""
     KBLK = K * blk
+    ROW_L = K * fl
 
     def body(i, blk_base, n2_ref, p1, p2, t1, t2, tbl_in, tbl_out):
         dot = functools.partial(
@@ -407,15 +419,15 @@ def _make_sweep_kernel(nwin: int, blk: int, u: int, sparse: bool = False):
         )
 
         def half(pay_ref, tgt_ref):
-            pay = pay_ref[:]  # (u, F) i32 payload, sorted-by-target
+            pay = pay_ref[:]  # (u, fl) i32 payload, sorted-by-target
             tgt = tgt_ref[:]  # (u, 1) i32 global slot target (sentinel NBK)
             rel = tgt - blk_base
             live = (rel >= 0) & (rel < KBLK)
             slot = jnp.where(live, rel % K, -1)  # (u, 1)
             lb = jnp.where(live, rel // K, -1)  # (u, 1)
-            # lane l of a bucket row belongs to slot l//16, field l%16
-            lane_slot = jax.lax.broadcasted_iota(i32, (u, ROW), 1) // F
-            upd = jnp.concatenate([pay] * K, axis=1)  # (u, 128)
+            # lane l of a bucket row belongs to slot l//fl, field l%fl
+            lane_slot = jax.lax.broadcasted_iota(i32, (u, ROW_L), 1) // fl
+            upd = jnp.concatenate([pay] * K, axis=1)  # (u, K·fl)
             msk = (lane_slot == slot).astype(jnp.int8)
             iot = jax.lax.broadcasted_iota(i32, (blk, u), 0)
             onehot = (iot == lb[:, 0][None, :]).astype(jnp.int8)
@@ -459,16 +471,23 @@ def _make_sweep_kernel(nwin: int, blk: int, u: int, sparse: bool = False):
     return kern
 
 
-def _write_sweep(rows_tbl, new16, c: Claim2, blk: int, u: int):
+def _write_sweep(rows_tbl, new16, c: Claim2, blk: int, u: int, layout=None):
     """Pallas sweep write: stream the table through VMEM once, composing the
-    target-sorted update run of each block in-kernel (see _make_sweep_kernel)."""
+    target-sorted update run of each block in-kernel (see _make_sweep_kernel).
+    Payload rows pack to the table's slot layout before the gather, so a
+    packed table's sweep streams layout.row lanes per bucket — half the
+    bytes for the 32 B layouts."""
+    if layout is None:
+        from gubernator_tpu.ops.layout import FULL as layout
+    fl, rowl = layout.F, layout.row
     NB = rows_tbl.shape[0]
     B = new16.shape[0]
     nblk = NB // blk
     nwin = B // u
     assert nwin * u == B, f"batch {B} not divisible by window {u}"
 
-    pay_s = new16[c.order]  # the ONE payload gather: original → sorted order
+    new_pk = layout.pack(new16)  # (B, fl)
+    pay_s = new_pk[c.order]  # the ONE payload gather: original → sorted order
     tgt_eff = jnp.where(
         c.written_sorted, c.tgt_sorted, jnp.int32(NB * K)
     ).astype(i32)[:, None]
@@ -487,18 +506,18 @@ def _write_sweep(rows_tbl, new16, c: Claim2, blk: int, u: int):
         num_scalar_prefetch=2,
         grid=(nblk,),
         in_specs=[
-            pl.BlockSpec((u, F), lambda i, s, n2: (s[i], 0)),
-            pl.BlockSpec((u, F), second),
+            pl.BlockSpec((u, fl), lambda i, s, n2: (s[i], 0)),
+            pl.BlockSpec((u, fl), second),
             pl.BlockSpec((u, 1), lambda i, s, n2: (s[i], 0)),
             pl.BlockSpec((u, 1), second),
-            pl.BlockSpec((blk, ROW), lambda i, s, n2: (i, 0)),
+            pl.BlockSpec((blk, rowl), lambda i, s, n2: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((blk, ROW), lambda i, s, n2: (i, 0)),
+        out_specs=pl.BlockSpec((blk, rowl), lambda i, s, n2: (i, 0)),
     )
     interpret = jax.default_backend() == "cpu"
     with _sweep_x64_ctx(interpret):
         out = pl.pallas_call(
-            _make_sweep_kernel(nwin, blk, u),
+            _make_sweep_kernel(nwin, blk, u, fl),
             interpret=interpret,
             out_shape=jax.ShapeDtypeStruct(rows_tbl.shape, rows_tbl.dtype),
             grid_spec=grid_spec,
@@ -507,7 +526,8 @@ def _write_sweep(rows_tbl, new16, c: Claim2, blk: int, u: int):
     return out
 
 
-def _write_sparse(rows_tbl, new16, c: Claim2, blk: int, u: int, g: int):
+def _write_sparse(rows_tbl, new16, c: Claim2, blk: int, u: int, g: int,
+                  layout=None):
     """Block-sparse Pallas write: launch the sweep grid ONLY over dirty
     blocks, so the write's HBM traffic scales with the batch, not the table.
 
@@ -528,6 +548,9 @@ def _write_sparse(rows_tbl, new16, c: Claim2, blk: int, u: int, g: int):
     buffer, fetched and flushed once) makes them write identical bytes — no
     read-after-write hazard, unlike duplicate DIRTY blocks, which is why the
     real entries are deduplicated rather than clamped."""
+    if layout is None:
+        from gubernator_tpu.ops.layout import FULL as layout
+    fl, rowl = layout.F, layout.row
     NB = rows_tbl.shape[0]
     B = new16.shape[0]
     nblk = NB // blk
@@ -536,7 +559,8 @@ def _write_sparse(rows_tbl, new16, c: Claim2, blk: int, u: int, g: int):
     assert nwin * u == B, f"batch {B} not divisible by window {u}"
     assert g >= 1
 
-    pay_s = new16[c.order]  # the ONE payload gather: original → sorted order
+    new_pk = layout.pack(new16)  # (B, fl)
+    pay_s = new_pk[c.order]  # the ONE payload gather: original → sorted order
     tgt_eff = jnp.where(
         c.written_sorted, c.tgt_sorted, jnp.int32(NB * K)
     ).astype(i32)[:, None]
@@ -565,18 +589,18 @@ def _write_sparse(rows_tbl, new16, c: Claim2, blk: int, u: int, g: int):
         num_scalar_prefetch=3,
         grid=(g,),
         in_specs=[
-            pl.BlockSpec((u, F), lambda i, db_, s, n2: (s[i], 0)),
-            pl.BlockSpec((u, F), second),
+            pl.BlockSpec((u, fl), lambda i, db_, s, n2: (s[i], 0)),
+            pl.BlockSpec((u, fl), second),
             pl.BlockSpec((u, 1), lambda i, db_, s, n2: (s[i], 0)),
             pl.BlockSpec((u, 1), second),
-            pl.BlockSpec((blk, ROW), lambda i, db_, s, n2: (db_[i], 0)),
+            pl.BlockSpec((blk, rowl), lambda i, db_, s, n2: (db_[i], 0)),
         ],
-        out_specs=pl.BlockSpec((blk, ROW), lambda i, db_, s, n2: (db_[i], 0)),
+        out_specs=pl.BlockSpec((blk, rowl), lambda i, db_, s, n2: (db_[i], 0)),
     )
     interpret = jax.default_backend() == "cpu"
     with _sweep_x64_ctx(interpret):
         out = pl.pallas_call(
-            _make_sweep_kernel(nwin, blk, u, sparse=True),
+            _make_sweep_kernel(nwin, blk, u, fl, sparse=True),
             interpret=interpret,
             out_shape=jax.ShapeDtypeStruct(rows_tbl.shape, rows_tbl.dtype),
             grid_spec=grid_spec,
@@ -585,14 +609,16 @@ def _write_sparse(rows_tbl, new16, c: Claim2, blk: int, u: int, g: int):
     return out
 
 
-def _write_xla(rows_tbl, new16, c: Claim2):
+def _write_xla(rows_tbl, new16, c: Claim2, layout=None):
     """Semantically identical scatter write for backends without the Pallas
     TPU pipeline (CPU test meshes). Slot-granular, drop-mode."""
+    if layout is None:
+        from gubernator_tpu.ops.layout import FULL as layout
     NB = rows_tbl.shape[0]
-    slot_view = rows_tbl.reshape(NB * K, F)
+    slot_view = rows_tbl.reshape(NB * K, layout.F)
     tgt = jnp.where(c.written, c.bucket * K + c.chosen, NB * K)
-    out = slot_view.at[tgt].set(new16, mode="drop")
-    return out.reshape(NB, ROW)
+    out = slot_view.at[tgt].set(layout.pack(new16), mode="drop")
+    return out.reshape(NB, layout.row)
 
 
 # -------------------------------------------------------------------- decide
@@ -608,7 +634,18 @@ def decide2_impl(
     dispatch after a host-side check that the batch carries no leaky row.
     `write="sparse"` resolves per dispatch shape (resolve_write): the
     block-sparse grid when its coverage is a small fraction of the table,
-    the full sweep otherwise."""
+    the full sweep otherwise. The table's slot layout (ops/layout.py)
+    threads through the probe gather and the write composition; packed
+    layouts only serve their own math mode — the engine migrates a packed
+    table to full before dispatching off-family traffic, so this guard
+    firing means a caller skipped the engine layer."""
+    layout = table.layout
+    if not layout.supports_math(math):
+        raise ValueError(
+            f"table layout {layout.name!r} cannot serve math={math!r}; "
+            "migrate the table to the full layout first (engine does this "
+            "automatically)"
+        )
     B = req.fp.shape[0]
     NB = table.rows.shape[0]
     write = resolve_write(write, NB, B)
@@ -619,7 +656,7 @@ def decide2_impl(
     now = req.created_at
     active = req.active
 
-    c = _probe_claim2(table.rows, req.fp, now, active, blk, u)
+    c = _probe_claim2(table.rows, req.fp, now, active, blk, u, layout)
 
     # ---- apply: chosen lane's stored state
     lane16 = jnp.take_along_axis(c.slots, c.chosen[:, None, None], axis=1)[
@@ -694,11 +731,11 @@ def decide2_impl(
     )  # (B, F)
 
     if write == "sweep":
-        rows_out = _write_sweep(table.rows, new16, c, blk, u)
+        rows_out = _write_sweep(table.rows, new16, c, blk, u, layout)
     elif write == "sparse":
-        rows_out = _write_sparse(table.rows, new16, c, blk, u, gsteps)
+        rows_out = _write_sparse(table.rows, new16, c, blk, u, gsteps, layout)
     else:
-        rows_out = _write_xla(table.rows, new16, c)
+        rows_out = _write_xla(table.rows, new16, c, layout)
 
     OVER = jnp.int32(int(Status.OVER_LIMIT))
     UNDER = jnp.int32(int(Status.UNDER_LIMIT))
@@ -710,6 +747,12 @@ def decide2_impl(
         reset_time=jnp.where(active, d.resp_reset, i64(0)),
         cache_hit=exists,
         dropped=dropped,
+        # stored-state echoes for full-fidelity GLOBAL broadcasts
+        # (parallel/global_sync._sync_core): the raw aux (GCRA TAT /
+        # sliding-window previous count) and the remaining-STYLE integer
+        # lane. DCE'd in every serving graph (pack_outputs ignores them).
+        aux=d.aux_out,
+        rem_store=d.rem_i_out,
     )
     stats = BatchStats(
         cache_hits=exists.sum(dtype=i64),
@@ -718,7 +761,7 @@ def decide2_impl(
         evicted_unexpired=c.evict_live.sum(dtype=i64),
         dropped=dropped.sum(dtype=i64),
     )
-    return Table2(rows=rows_out), resp, stats
+    return Table2(rows=rows_out, layout=layout), resp, stats
 
 
 decide2 = functools.partial(
@@ -1050,6 +1093,7 @@ def install2_impl(
     Returns (table', installed_mask)."""
     from gubernator_tpu.types import Algorithm
 
+    layout = table.layout
     B = inst.fp.shape[0]
     NB = table.rows.shape[0]
     write = resolve_write(write, NB, B)
@@ -1057,25 +1101,38 @@ def install2_impl(
         blk, u, g = sparse_geometry(NB, B)
     else:
         blk, u = sweep_geometry(NB, B)
-    c = _probe_claim2(table.rows, inst.fp, inst.now, inst.active, blk, u)
+    c = _probe_claim2(table.rows, inst.fp, inst.now, inst.active, blk, u,
+                      layout)
 
     is_token = inst.algo == int(Algorithm.TOKEN_BUCKET)
     is_leaky = inst.algo == int(Algorithm.LEAKY_BUCKET)
     is_gcra = inst.algo == int(Algorithm.GCRA)
     is_win = inst.algo == int(Algorithm.SLIDING_WINDOW)
+    # full-fidelity window state when the broadcast carries it (the
+    # PR-11 GLOBAL fidelity fix): `aux` = previous-window count,
+    # `rem_store` = the stored-style remaining (limit - current count).
+    # Legacy broadcasts (None) degrade to the CONSERVATIVE weighted
+    # rebuild below.
+    has_aux = inst.aux is not None
+    inst_aux = inst.aux if has_aux else jnp.zeros_like(inst.remaining)
+    inst_rem = inst.rem_store if inst.rem_store is not None else inst.remaining
     # REM_I is remaining-style for every integer algorithm (ops/math.py
     # storage convention), so the wire rebuild installs `remaining`
-    # verbatim for token, sliding-window and lease rows; only leaky keeps
-    # its float lane and GCRA its TAT.
-    rem_i = jnp.where(is_leaky | is_gcra, i64(0), inst.remaining)
+    # verbatim for token and lease rows; sliding windows take the
+    # stored-style remaining when the wire carries it (else the weighted
+    # client remaining — conservative: interpolated usage counts as
+    # current); only leaky keeps its float lane and GCRA its TAT.
+    rem_i = jnp.where(
+        is_leaky | is_gcra, i64(0), jnp.where(is_win, inst_rem, inst.remaining)
+    )
     rem_f = jnp.where(is_leaky, inst.remaining.astype(f64), f64(0.0))
     # GCRA: with the wire rebuild's burst == limit, reset_time IS the
     # authoritative TAT (tau = limit·T ⇒ reset = tat, ops/math.py) — the
     # owner's verdict rebuilds exactly. Sliding window: the previous-window
-    # count has no wire field; 0 is the permissive rebuild, tightened by
-    # the next owner broadcast (same spirit as the reference's Burst=Limit
-    # lossy rebuild, gubernator.go:434-474).
-    aux = jnp.where(is_gcra, inst.reset_time, i64(0))
+    # count rides the broadcast aux when present (replicas then interpolate
+    # the same `used` as the owner); absent, 0 — the legacy permissive
+    # rebuild, tightened by the next owner broadcast.
+    aux = jnp.where(is_gcra, inst.reset_time, jnp.where(is_win, inst_aux, i64(0)))
     burst = jnp.where(is_token | is_win, i64(0), inst.burst)
     # expiry: token items expire at their authoritative reset (ExpireAt =
     # CreatedAt + Duration = reset, store.go:29-35); leaky items at
@@ -1083,10 +1140,16 @@ def install2_impl(
     # whose leaky meaning (createdAt + (limit-rem)*rate) can lie in the past
     # for a near-full bucket and would expire the install on arrival. GCRA
     # state self-expires at its TAT (= reset); window/lease keep the
-    # stamp + duration rule (window interpolation state is rebuilt fresh,
-    # lease reset_time == expiry by construction).
+    # stamp + duration rule (lease reset_time == expiry by construction).
+    # Sliding windows store the WINDOW START as their stamp (the
+    # interpolation key, ops/math.py w_same) and expire one full window
+    # past the current one — matching the owner's own writeback.
+    w_dur = jnp.maximum(inst.duration, i64(1))
+    w_ws = inst.now - inst.now % w_dur
     exp = jnp.where(
-        is_token | is_gcra, inst.reset_time, inst.stamp + inst.duration
+        is_token | is_gcra,
+        inst.reset_time,
+        jnp.where(is_win, w_ws + 2 * w_dur, inst.stamp + inst.duration),
     )
     flags = inst.algo | (inst.status << 8)
     sat32 = lambda x: jnp.clip(x, -(2**31), 2**31 - 1).astype(i32)
@@ -1098,6 +1161,7 @@ def install2_impl(
     remf_lo = jnp.where(
         is_leaky, jax.lax.bitcast_convert_type(remf_lo_f, i32), _lo32(aux)
     )
+    stamp_eff = jnp.where(is_win, w_ws, inst.stamp)
     zero = jnp.zeros((B,), dtype=i32)
     new16 = jnp.stack(
         [
@@ -1109,8 +1173,8 @@ def install2_impl(
             flags,
             _lo32(inst.duration),
             _hi32(inst.duration),
-            _lo32(inst.stamp),
-            _hi32(inst.stamp),
+            _lo32(stamp_eff),
+            _hi32(stamp_eff),
             _lo32(exp),
             _hi32(exp),
             remf_hi,
@@ -1121,12 +1185,12 @@ def install2_impl(
         axis=1,
     )
     if write == "sweep":
-        rows_out = _write_sweep(table.rows, new16, c, blk, u)
+        rows_out = _write_sweep(table.rows, new16, c, blk, u, layout)
     elif write == "sparse":
-        rows_out = _write_sparse(table.rows, new16, c, blk, u, g)
+        rows_out = _write_sparse(table.rows, new16, c, blk, u, g, layout)
     else:
-        rows_out = _write_xla(table.rows, new16, c)
-    return Table2(rows=rows_out), inst.active & c.written
+        rows_out = _write_xla(table.rows, new16, c, layout)
+    return Table2(rows=rows_out, layout=layout), inst.active & c.written
 
 
 install2 = functools.partial(
@@ -1143,10 +1207,13 @@ def merge2_impl(
     """Conservative merge of transferred table slots (the TransferState
     receive path, docs/robustness.md "Topology change & drain").
 
-    Incoming rows arrive in the table's own slot-field layout ((B, F) i32,
-    the extract_live_rows wire format). Against an existing live entry the
-    merge can only ever TIGHTEN admission — the invariant that makes a
-    retried, duplicated, or crossed transfer unable to grant extra capacity:
+    Incoming rows arrive in the CANONICAL full-width slot layout ((B, 16)
+    i32): extract wires carry the sender's own layout, and the receiving
+    host unpacks them through ops/layout before this kernel — the one
+    full-width round-trip that keeps the conservatism rules below
+    layout-independent. Against an existing live entry the merge can only
+    ever TIGHTEN admission — the invariant that makes a retried,
+    duplicated, or crossed transfer unable to grant extra capacity:
 
       * remaining  = min(stored, incoming)   (integer and leaky-float lanes;
         REM_I is remaining-style for every integer algorithm, so min
@@ -1161,6 +1228,7 @@ def merge2_impl(
     shared with install2). Incoming rows already expired at the receiver's
     clock are dropped — stale state must not resurrect. Returns
     (table', merged_mask)."""
+    layout = table.layout
     B = fp.shape[0]
     NB = table.rows.shape[0]
     write = resolve_write(write, NB, B)
@@ -1173,7 +1241,7 @@ def merge2_impl(
     i_exp = _join64(g_i(EXP_LO), g_i(EXP_HI))
     active = active & (i_exp >= now)
 
-    c = _probe_claim2(table.rows, fp, now, active, blk, u)
+    c = _probe_claim2(table.rows, fp, now, active, blk, u, layout)
     lane16 = jnp.take_along_axis(c.slots, c.chosen[:, None, None], axis=1)[
         :, 0, :
     ]
@@ -1263,12 +1331,12 @@ def merge2_impl(
         axis=1,
     )
     if write == "sweep":
-        rows_out = _write_sweep(table.rows, new16, c, blk, u)
+        rows_out = _write_sweep(table.rows, new16, c, blk, u, layout)
     elif write == "sparse":
-        rows_out = _write_sparse(table.rows, new16, c, blk, u, gsteps)
+        rows_out = _write_sparse(table.rows, new16, c, blk, u, gsteps, layout)
     else:
-        rows_out = _write_xla(table.rows, new16, c)
-    return Table2(rows=rows_out), active & c.written
+        rows_out = _write_xla(table.rows, new16, c, layout)
+    return Table2(rows=rows_out, layout=layout), active & c.written
 
 
 merge2 = functools.partial(

@@ -162,6 +162,13 @@ def _bucket_math_impl(
         # exactly the TAT (tau = limit·T), mirroring token's "reset =
         # window expiry"
         g_reset = g_tat_out - g_tau + g_T * req.limit
+        # DENIED rows (without DRAIN) report the EXACT conforming instant
+        # instead: the earliest now' with tat0 + h·T - tau ≤ now' — the
+        # TAT-derived retry_after bound clients back off to (PR-11).
+        # reset > now by the deny condition itself; internal rebuilds
+        # (GLOBAL installs from reset_time) only ever read zero-hit or
+        # DRAIN-forced responses, which keep the TAT meaning above.
+        g_reset = jnp.where(g_deny & ~is_drain, g_tat1 - g_tau, g_reset)
         g_status = jnp.where(g_deny, OVER, UNDER)
         # RESET_REMAINING removes the item outright and reports a full
         # bucket (token semantics, go:82-94)

@@ -37,17 +37,36 @@ import jax.numpy as jnp
 import numpy as np
 
 K = 8  # slots per bucket
-F = 16  # int32 fields per slot
-ROW = K * F  # 128 int32 lanes per bucket row
+F = 16  # int32 fields per slot in the canonical FULL layout
+ROW = K * F  # 128 int32 lanes per full-layout bucket row
 
-# field indices within a slot
+# field indices within a FULL-layout slot (packed layouts unpack to this
+# order — ops/layout.py is the single conversion authority)
 FP_LO, FP_HI, LIMIT, BURST, REM_I, FLAGS = 0, 1, 2, 3, 4, 5
 DUR_LO, DUR_HI, STAMP_LO, STAMP_HI, EXP_LO, EXP_HI = 6, 7, 8, 9, 10, 11
 REMF_HI, REMF_LO = 12, 13
 
 
-class Table2(NamedTuple):
-    rows: jnp.ndarray  # (NB, 128) int32
+class Table2:
+    """One HBM table: a rows array plus the SlotLayout addressing it.
+
+    ``rows`` is (NB, K·layout.F) int32 — one bucket per row, K slots of
+    layout.F fields each (128 lanes for the full layout, 64 for the packed
+    ones). The layout travels as pytree AUX data (static), so jitted
+    programs key their compilation on it and shard_map/tree transforms
+    preserve it for free; ``Table2(rows=...)`` without a layout infers the
+    full layout from the 128-lane width (the pre-layout constructor every
+    existing call site uses), while packed tables pass theirs explicitly."""
+
+    __slots__ = ("rows", "layout")
+
+    def __init__(self, rows, layout=None):
+        if layout is None:
+            from gubernator_tpu.ops.layout import layout_for_row
+
+            layout = layout_for_row(int(rows.shape[-1]))
+        self.rows = rows
+        self.layout = layout
 
     @property
     def n_buckets(self) -> int:
@@ -56,6 +75,24 @@ class Table2(NamedTuple):
     @property
     def capacity(self) -> int:
         return self.rows.shape[-2] * K
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return f"Table2(rows={getattr(self.rows, 'shape', None)}, " \
+               f"layout={self.layout.name})"
+
+
+def _t2_flatten(t: Table2):
+    return (t.rows,), t.layout
+
+
+def _t2_unflatten(layout, children):
+    obj = object.__new__(Table2)
+    obj.rows = children[0]
+    obj.layout = layout
+    return obj
+
+
+jax.tree_util.register_pytree_node(Table2, _t2_flatten, _t2_unflatten)
 
 
 def n_buckets_for(capacity: int) -> int:
@@ -73,35 +110,47 @@ def n_buckets_for(capacity: int) -> int:
     return -(-nb // 2048) * 2048
 
 
-def new_table2(capacity: int) -> Table2:
+def new_table2(capacity: int, layout=None) -> Table2:
     """Fresh empty table (the CacheSize analog, reference config.go:151).
-    Keep load factor <= ~0.6 for healthy buckets."""
-    return Table2(rows=jnp.zeros((n_buckets_for(capacity), ROW), dtype=jnp.int32))
+    Keep load factor <= ~0.6 for healthy buckets. `layout` defaults to the
+    canonical full layout (bit-compatible with every earlier PR)."""
+    if layout is None:
+        from gubernator_tpu.ops.layout import FULL as layout
+    return Table2(
+        rows=jnp.zeros((n_buckets_for(capacity), layout.row), dtype=jnp.int32),
+        layout=layout,
+    )
 
 
 def live_count2(table: Table2, now_ms: int) -> int:
     """Live (non-empty, unexpired) slots — reference cache Size()
     (lrucache.go:152-157)."""
-    rows = np.asarray(table.rows).reshape(-1, K, F)
+    lay = table.layout
+    rows = np.asarray(table.rows).reshape(-1, K, lay.F)
     lo = rows[:, :, FP_LO]
     hi = rows[:, :, FP_HI]
-    exp = (rows[:, :, EXP_LO].astype(np.int64) & 0xFFFFFFFF) | (
-        rows[:, :, EXP_HI].astype(np.int64) << 32
+    exp = (rows[:, :, lay.exp_lo_i].astype(np.int64) & 0xFFFFFFFF) | (
+        rows[:, :, lay.exp_hi_i].astype(np.int64) << 32
     )
     nonempty = (lo != 0) | (hi != 0)
     return int((nonempty & (exp >= now_ms)).sum())
 
 
-def decode_live_slots(rows: np.ndarray, now_ms: int):
-    """Flatten an (NB, 128) rows array into live slot records:
-    (slot_fields (N, F) i32, fp (N,) i64, exp (N,) i64) for slots that are
-    non-empty and unexpired at now_ms."""
-    slots = rows.reshape(-1, F)
+def decode_live_slots(rows: np.ndarray, now_ms: int, layout=None):
+    """Flatten a rows array into live slot records: (slot_fields (N, F_layout)
+    i32, fp (N,) i64, exp (N,) i64) for slots that are non-empty and
+    unexpired at now_ms. Slots come back in the TABLE's own layout — convert
+    with layout.unpack when full-width fields are needed."""
+    if layout is None:
+        from gubernator_tpu.ops.layout import layout_for_row
+
+        layout = layout_for_row(int(rows.shape[-1]))
+    slots = rows.reshape(-1, layout.F)
     lo = slots[:, FP_LO].astype(np.int64) & 0xFFFFFFFF
     hi = slots[:, FP_HI].astype(np.int64)
     fp = (hi << 32) | lo
-    exp = (slots[:, EXP_LO].astype(np.int64) & 0xFFFFFFFF) | (
-        slots[:, EXP_HI].astype(np.int64) << 32
+    exp = (slots[:, layout.exp_lo_i].astype(np.int64) & 0xFFFFFFFF) | (
+        slots[:, layout.exp_hi_i].astype(np.int64) << 32
     )
     live = (fp != 0) & (exp >= now_ms)
     return slots[live], fp[live], exp[live]
@@ -117,35 +166,43 @@ def decode_live_slots(rows: np.ndarray, now_ms: int):
 # packed-single-fetch idioms of the serving path.
 
 
-@jax.jit
-def _extract_sorted(rows: jnp.ndarray, now_ms: jnp.ndarray):
+@functools.partial(jax.jit, static_argnames=("layout",))
+def _extract_sorted(rows: jnp.ndarray, now_ms: jnp.ndarray, *, layout):
     """Device filter+pack: all live slots sorted to the front. Accepts any
-    (..., 128) rows layout (single-device (NB, 128) or sharded (D, NB, 128) —
-    the flatten makes the shard axis fold in). Returns (slots_packed (N, F),
-    fp_packed (N,), live_count) with live entries occupying the first
-    `live_count` positions."""
-    slots = rows.reshape(-1, F)
+    (..., ROW_layout) rows array (single-device (NB, ·) or sharded
+    (D, NB, ·) — the flatten makes the shard axis fold in). Returns
+    (slots_packed (N, F_layout), fp_packed (N,), live_count) with live
+    entries occupying the first `live_count` positions; slots stay in the
+    table's own layout (the handoff/checkpoint wire format)."""
+    slots = rows.reshape(-1, layout.F)
     lo = slots[:, FP_LO].astype(jnp.int64) & 0xFFFFFFFF
     hi = slots[:, FP_HI].astype(jnp.int64)
     fp = (hi << 32) | lo
-    exp = (slots[:, EXP_LO].astype(jnp.int64) & 0xFFFFFFFF) | (
-        slots[:, EXP_HI].astype(jnp.int64) << 32
+    exp = (slots[:, layout.exp_lo_i].astype(jnp.int64) & 0xFFFFFFFF) | (
+        slots[:, layout.exp_hi_i].astype(jnp.int64) << 32
     )
     live = (fp != 0) & (exp >= now_ms)
     order = jnp.argsort(jnp.where(live, 0, 1).astype(jnp.int32))
     return slots[order], fp[order], live.sum()
 
 
-def extract_live_rows(rows, now_ms: int):
+def extract_live_rows(rows, now_ms: int, layout=None):
     """Extract every live slot from a device-resident rows array:
-    (fps (N,) i64, slots (N, F) i32) host copies. The filter + pack runs
-    on-device (_extract_sorted); the host fetches only the live prefix,
+    (fps (N,) i64, slots (N, F_layout) i32) host copies. The filter + pack
+    runs on-device (_extract_sorted); the host fetches only the live prefix,
     padded to a power of two so the number of compiled slice shapes stays
     logarithmic in table capacity."""
-    slots_s, fp_s, cnt = _extract_sorted(rows, np.int64(now_ms))
+    if layout is None:
+        from gubernator_tpu.ops.layout import layout_for_row
+
+        layout = layout_for_row(int(rows.shape[-1]))
+    slots_s, fp_s, cnt = _extract_sorted(rows, np.int64(now_ms), layout=layout)
     n = int(cnt)
     if n == 0:
-        return np.empty(0, dtype=np.int64), np.empty((0, F), dtype=np.int32)
+        return (
+            np.empty(0, dtype=np.int64),
+            np.empty((0, layout.F), dtype=np.int32),
+        )
     pad = 256
     while pad < n:
         pad *= 2
@@ -156,16 +213,56 @@ def extract_live_rows(rows, now_ms: int):
     )
 
 
+def gather_slots_impl(rows: jnp.ndarray, fp: jnp.ndarray,
+                      active: jnp.ndarray, layout=None):
+    """Read the slots holding each fingerprint WITHOUT mutating anything:
+    one bucket-row gather + lane match, unpacked to canonical full-width
+    fields. Returns (full_slots (B, 16) i32, found (B,) bool) — the
+    stored-state read the GLOBAL broadcast plane uses to ship
+    sliding-window aux with owner updates (service/global_manager.py).
+    Unexpired-ness is NOT checked here; callers filter on the expiry pair
+    if they need liveness."""
+    if layout is None:
+        from gubernator_tpu.ops.layout import layout_for_row
+
+        layout = layout_for_row(int(rows.shape[-1]))
+    NB = rows.shape[0]
+    B = fp.shape[0]
+    bucket = (fp % NB).astype(jnp.int32)
+    full = layout.unpack(rows[bucket].reshape(B, K, layout.F))  # (B, K, 16)
+    my_lo = fp.astype(jnp.int32)
+    my_hi = (fp >> 32).astype(jnp.int32)
+    s_lo = full[:, :, FP_LO]
+    s_hi = full[:, :, FP_HI]
+    empty = (s_lo == 0) & (s_hi == 0)
+    match = (
+        (s_lo == my_lo[:, None]) & (s_hi == my_hi[:, None]) & ~empty
+        & active[:, None]
+    )
+    found = match.any(axis=1)
+    lane = jnp.argmax(match, axis=1).astype(jnp.int32)
+    lane16 = jnp.take_along_axis(full, lane[:, None, None], axis=1)[:, 0, :]
+    return jnp.where(found[:, None], lane16, 0), found
+
+
+gather_slots = functools.partial(jax.jit, static_argnames=("layout",))(
+    gather_slots_impl
+)
+
+
 def tombstone_rows_impl(rows: jnp.ndarray, fp: jnp.ndarray, active: jnp.ndarray):
     """Zero the slot holding each fingerprint (handoff source side: rows are
     tombstoned only AFTER the destination acked their transfer). Missing
     fingerprints are no-ops — a kill mask over matched slots only, so a
     retried tombstone can never evict an unrelated live entry. Returns
-    (rows', found_mask)."""
+    (rows', found_mask). Layout-agnostic by construction: only the
+    fingerprint pair (fields 0/1 in every layout) and the row geometry
+    (F = lanes // K) are read."""
     NB = rows.shape[0]
     B = fp.shape[0]
+    F_l = rows.shape[-1] // K
     bucket = (fp % NB).astype(jnp.int32)
-    b_rows = rows[bucket].reshape(B, K, F)
+    b_rows = rows[bucket].reshape(B, K, F_l)
     my_lo = fp.astype(jnp.int32)
     my_hi = (fp >> 32).astype(jnp.int32)
     s_lo = b_rows[:, :, FP_LO]
@@ -180,8 +277,8 @@ def tombstone_rows_impl(rows: jnp.ndarray, fp: jnp.ndarray, active: jnp.ndarray)
     NBK = NB * K
     tgt = jnp.where(found, bucket * K + lane, NBK)
     kill = jnp.zeros(NBK + 1, dtype=bool).at[tgt].set(True)[:NBK]
-    flat = rows.reshape(NBK, F)
-    out = jnp.where(kill[:, None], 0, flat).reshape(NB, ROW)
+    flat = rows.reshape(NBK, F_l)
+    out = jnp.where(kill[:, None], 0, flat).reshape(NB, K * F_l)
     return out, found
 
 
@@ -191,16 +288,20 @@ tombstone_rows = functools.partial(jax.jit, donate_argnums=(0,))(
 
 
 def rehash_rows(
-    rows: np.ndarray, new_n_buckets: int, now_ms: int
+    rows: np.ndarray, new_n_buckets: int, now_ms: int, layout=None
 ) -> "tuple[np.ndarray, int]":
     """Re-place every live slot into a table with `new_n_buckets` buckets —
     the host side of a resize (SURVEY §7 hard-parts: table growth is
     host-orchestrated; the kernel's placement rule is bucket = fp % NB).
     Buckets receiving more than K live entries keep the K latest-expiring and
     drop the rest (the same preference order as in-kernel eviction). Returns
-    (new rows array, dropped count)."""
-    slots, fp, exp = decode_live_slots(rows, now_ms)
-    out = np.zeros((new_n_buckets, ROW), dtype=np.int32)
+    (new rows array, dropped count) in the same slot layout as the input."""
+    if layout is None:
+        from gubernator_tpu.ops.layout import layout_for_row
+
+        layout = layout_for_row(int(rows.shape[-1]))
+    slots, fp, exp = decode_live_slots(rows, now_ms, layout=layout)
+    out = np.zeros((new_n_buckets, layout.row), dtype=np.int32)
     if fp.shape[0] == 0:
         return out, 0
     bucket = fp % new_n_buckets
@@ -214,5 +315,5 @@ def rehash_rows(
     keep = lane < K
     dropped = int((~keep).sum())
     tgt = b_sorted[keep] * K + lane[keep]
-    out.reshape(-1, F)[tgt] = slots[order[keep]]
+    out.reshape(-1, layout.F)[tgt] = slots[order[keep]]
     return out, dropped

@@ -126,12 +126,20 @@ class TableSnapshot:
         return d
 
 
-def _scan_body(rows: jnp.ndarray, now: jnp.ndarray, blk: int) -> jnp.ndarray:
-    """Traceable scan body over an (..., NB, 128) rows array → (VEC_LEN,)
-    int64 stats vector. Every entry is additive across disjoint row sets, so
-    the sharded variant sums per-device vectors. `blk` (static) is the
-    occupancy-block width in buckets."""
-    slots = rows.reshape(-1, K, F)  # (M buckets, K slots, F fields)
+def _scan_body(rows: jnp.ndarray, now: jnp.ndarray, blk: int,
+               layout=None) -> jnp.ndarray:
+    """Traceable scan body over an (..., NB, ROW_layout) rows array →
+    (VEC_LEN,) int64 stats vector. Every entry is additive across disjoint
+    row sets, so the sharded variant sums per-device vectors. `blk`
+    (static) is the occupancy-block width in buckets; `layout` the table's
+    slot layout — packed fields unpack to the canonical 16 in registers,
+    so the statistics themselves stay layout-blind while the scan streams
+    half the HBM bytes on 32 B tables."""
+    if layout is None:
+        from gubernator_tpu.ops.layout import FULL as layout
+    slots = layout.unpack(
+        rows.reshape(-1, K, layout.F)
+    )  # (M buckets, K slots, 16 canonical fields)
     lo = slots[:, :, FP_LO].astype(jnp.int64) & 0xFFFFFFFF
     hi = slots[:, :, FP_HI].astype(jnp.int64)
     fp = (hi << 32) | lo
@@ -184,7 +192,9 @@ def _scan_body(rows: jnp.ndarray, now: jnp.ndarray, blk: int) -> jnp.ndarray:
     return jnp.concatenate(parts)
 
 
-_scan = functools.partial(jax.jit, static_argnames=("blk",))(_scan_body)
+_scan = functools.partial(jax.jit, static_argnames=("blk", "layout"))(
+    _scan_body
+)
 
 
 def block_width(n_buckets: int) -> int:
@@ -209,12 +219,16 @@ class PendingScan:
         self.per_shard = per_shard
 
 
-def scan_begin(rows, now_ms: int) -> PendingScan:
+def scan_begin(rows, now_ms: int, layout=None) -> PendingScan:
     """Launch the telemetry scan over a single-device rows array WITHOUT
     fetching (the engine-thread half — cheap enqueue, the serving pipeline
     keeps dispatching while the device streams the table)."""
+    if layout is None:
+        from gubernator_tpu.ops.layout import layout_for_row
+
+        layout = layout_for_row(int(rows.shape[-1]))
     nb = int(rows.shape[-2])
-    vec = _scan(rows, jnp.int64(now_ms), blk=block_width(nb))
+    vec = _scan(rows, jnp.int64(now_ms), blk=block_width(nb), layout=layout)
     total_buckets = int(np.prod(rows.shape[:-1]))
     return PendingScan(vec, now_ms, total_buckets * K, total_buckets)
 
@@ -261,13 +275,17 @@ def finish_scan(pending: PendingScan) -> TableSnapshot:
     )
 
 
-def host_telemetry(rows: np.ndarray, now_ms: int) -> TableSnapshot:
+def host_telemetry(rows: np.ndarray, now_ms: int, layout=None) -> TableSnapshot:
     """Numpy oracle: the same statistics computed host-side from a table
     snapshot — the parity reference for the device scan (tests) and the
     escape hatch for post-mortem analysis of a checkpoint file."""
+    if layout is None:
+        from gubernator_tpu.ops.layout import layout_for_row
+
+        layout = layout_for_row(int(rows.shape[-1]))
     nb = int(rows.shape[-2])
     blk = block_width(nb)
-    slots = rows.reshape(-1, K, F)
+    slots = np.asarray(layout.unpack(rows.reshape(-1, K, layout.F)))
     lo = slots[:, :, FP_LO].astype(np.int64) & 0xFFFFFFFF
     hi = slots[:, :, FP_HI].astype(np.int64)
     fp = (hi << 32) | lo

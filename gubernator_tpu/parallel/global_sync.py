@@ -271,6 +271,12 @@ def _sync_core(primary, replica, outbox: ReqBatch, me, D: int, write: str,
         active=valid,
         burst=agg.burst,  # real config burst — richer than the wire
         stamp=agg.created_at,  # path's Burst=Limit rebuild
+        # sliding-window fidelity (PR 11): the owner's previous-window
+        # count and stored-style remaining ride the broadcast so replicas
+        # interpolate the SAME `used` as the owner instead of the
+        # permissive aux=0 rebuild
+        aux=resp.aux,
+        rem_store=resp.rem_store,
     )
     bc_all = jax.lax.all_gather(bc, axes)
     bc_flat = jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), bc_all)
@@ -425,6 +431,7 @@ class GlobalShardedEngine(ShardedEngine):
         dedup: Optional[str] = None,
         wire: Optional[str] = None,
         a2a: Optional[str] = None,
+        layout: Optional[str] = None,
     ):
         super().__init__(
             mesh,
@@ -437,6 +444,7 @@ class GlobalShardedEngine(ShardedEngine):
             dedup=dedup,
             wire=wire,
             a2a=a2a,
+            layout=layout,
         )
         # the replica table + collective step materialize on first GLOBAL
         # use: clustered daemons route GLOBAL over the host peer plane and
@@ -457,6 +465,11 @@ class GlobalShardedEngine(ShardedEngine):
         self._rr_lock = threading.Lock()
 
     def _ensure_global_plane(self) -> None:
+        # the collective reconcile runs the mixed decision graph over
+        # whatever algorithms GLOBAL keys use — a packed single-algorithm
+        # primary cannot serve it; replicas are always full for the same
+        # reason (installs carry arbitrary algos)
+        self.migrate_layout_full("GLOBAL collective sync needs mixed math")
         if self.replica is None:
             self.replica = new_sharded_table(self.mesh, self._capacity_per_shard)
         if self._sync_step is None:

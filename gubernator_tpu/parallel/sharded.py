@@ -83,7 +83,9 @@ from gubernator_tpu.ops.engine import (
     EngineStats,
     _math_mode,
     _pad_size,
+    batch_needs_full_layout,
     default_write_mode,
+    effective_math,
     ms_now,
 )
 from gubernator_tpu.ops.plan import _subset, plan_passes, single_pass
@@ -229,7 +231,7 @@ def make_sharded_merge(mesh: Mesh, write: Optional[str] = None):
     return jax.jit(fn, donate_argnums=(0,))
 
 
-def make_sharded_extract_dirty(mesh: Mesh, blk: int):
+def make_sharded_extract_dirty(mesh: Mesh, blk: int, layout=None):
     """All-shards dirty-block extract step (incremental checkpointing,
     ops/checkpoint.py): each device gathers ITS dirty blocks' bucket rows,
     filters live slots and packs them to the front — no slot row ever
@@ -243,7 +245,7 @@ def make_sharded_extract_dirty(mesh: Mesh, blk: int):
         from gubernator_tpu.ops.checkpoint import _extract_blocks_core
 
         slots, fp, cnt = _extract_blocks_core(
-            rows[0], bidx[0], now[0], blk
+            rows[0], bidx[0], now[0], blk, layout
         )
         return slots[None], fp[None], cnt[None]
 
@@ -251,6 +253,24 @@ def make_sharded_extract_dirty(mesh: Mesh, blk: int):
     fn = shard_map_compat(
         per_device, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=(spec, spec, spec), check_vma=False
+    )
+    return jax.jit(fn)
+
+
+def make_sharded_gather(mesh: Mesh, layout=None):
+    """All-shards stored-state read (table2.gather_slots_impl): full-width
+    slots for routed fingerprints, no mutation (nothing donated)."""
+
+    def per_device(rows, fp, active):
+        from gubernator_tpu.ops.table2 import gather_slots_impl
+
+        slots, found = gather_slots_impl(rows[0], fp[0], active[0], layout)
+        return slots[None], found[None]
+
+    spec = shard_spec(mesh)
+    fn = shard_map_compat(
+        per_device, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=(spec, spec), check_vma=False
     )
     return jax.jit(fn)
 
@@ -264,7 +284,7 @@ def make_sharded_tombstone(mesh: Mesh):
 
         rows = table.rows[0]
         rows, found = tombstone_rows_impl(rows, fp[0], active[0])
-        return Table2(rows=rows[None]), found[None]
+        return Table2(rows=rows[None], layout=table.layout), found[None]
 
     spec = shard_spec(mesh)
     fn = shard_map_compat(
@@ -310,10 +330,11 @@ class _StagingPool:
         return buf
 
 
-def new_sharded_table(mesh: Mesh, capacity_per_shard: int) -> Table2:
-    """A (D, n_buckets, 128) packed-row table placed shard-per-device."""
+def new_sharded_table(mesh: Mesh, capacity_per_shard: int, layout=None) -> Table2:
+    """A (D, n_buckets, ROW_layout) packed-row table placed shard-per-device
+    (the slot layout travels as Table2 pytree aux through every tree.map)."""
     D = mesh.devices.size
-    local = new_table2(capacity_per_shard)
+    local = new_table2(capacity_per_shard, layout=layout)
     stacked = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (D,) + x.shape), local)
     sharding = NamedSharding(mesh, shard_spec(mesh))
     return jax.tree.map(lambda x: jax.device_put(x, sharding), stacked)
@@ -339,7 +360,9 @@ class ShardedEngine:
         dedup: Optional[str] = None,
         wire: Optional[str] = None,
         a2a: Optional[str] = None,
+        layout: Optional[str] = None,
     ):
+        from gubernator_tpu.ops.layout import resolve_layout
         from gubernator_tpu.ops.wire import default_wire_mode
         from gubernator_tpu.parallel.ring import a2a_impl
 
@@ -365,7 +388,13 @@ class ShardedEngine:
         # (parallel/ring.py): "ring" | "collective", resolved once from the
         # override / GUBER_A2A_IMPL / backend auto rule
         self.a2a_impl = a2a_impl(a2a)
-        self.table = new_sharded_table(mesh, capacity_per_shard)
+        # slot layout (ops/layout.py): full by default, packed 32 B rows
+        # for single-algorithm fleets (GUBER_SLOT_LAYOUT / layout=); off-
+        # family traffic migrates the shards to full in place
+        self._layout = resolve_layout(layout)
+        self.table = new_sharded_table(
+            mesh, capacity_per_shard, layout=self._layout
+        )
         # routing mode: "host" sorts rows into an ownership grid on the host;
         # "device" ships arrival-order rows and routes on-mesh with an
         # all_to_all exchange (parallel/a2a.py) — zero host routing work,
@@ -601,10 +630,13 @@ class ShardedEngine:
         now_ms: Optional[int] = None,
         burst: Optional[np.ndarray] = None,
         stamp: Optional[np.ndarray] = None,
+        aux: Optional[np.ndarray] = None,
+        rem_store: Optional[np.ndarray] = None,
     ) -> int:
         """Install owner-authoritative GLOBAL statuses, routed to each
         fingerprint's owning shard (UpdatePeerGlobals receive path).
-        `burst`/`stamp` default to the wire path's lossy rebuild (cf.
+        `burst`/`stamp` default to the wire path's lossy rebuild;
+        `aux`/`rem_store` carry sliding-window broadcast fidelity (cf.
         LocalEngine.install_columns)."""
         now = now_ms if now_ms is not None else ms_now()
         n = fp.shape[0]
@@ -614,6 +646,8 @@ class ShardedEngine:
             burst = np.asarray(limit, dtype=np.int64)
         if stamp is None:
             stamp = np.full(n, now, dtype=np.int64)
+        if not self.table.layout.supports_algos(algo):
+            self.migrate_layout_full("install of off-family algorithms")
         self._mark_dirty(fp)
         D = self.n_shards
         routed = shard_of(fp, D)
@@ -636,6 +670,10 @@ class ShardedEngine:
             active=grid(np.ones(n, dtype=bool), bool),
             burst=grid(burst, np.int64),
             stamp=grid(stamp, np.int64),
+            aux=None if aux is None else grid(aux, np.int64),
+            rem_store=(
+                None if rem_store is None else grid(rem_store, np.int64)
+            ),
         )
         inst = jax.tree.map(
             lambda x: jax.device_put(x, self._batch_sharding), inst
@@ -650,14 +688,23 @@ class ShardedEngine:
         """(D, NB, 128) device→host copy of every shard (Loader.Save analog)."""
         return np.asarray(self.table.rows)
 
-    def restore(self, rows: np.ndarray) -> None:
+    def restore(self, rows: np.ndarray, layout=None) -> None:
+        lay = self.table.layout
+        if layout is not None and layout is not lay:
+            if rows.shape[:-1] != tuple(self.table.rows.shape[:-1]):
+                raise ValueError(
+                    f"snapshot geometry {rows.shape} incompatible with "
+                    f"table {tuple(self.table.rows.shape)}"
+                )
+            rows = np.asarray(lay.pack_rows(layout.unpack_rows(rows)))
         if rows.shape != tuple(self.table.rows.shape):
             raise ValueError(
                 f"snapshot shape {rows.shape} != table {tuple(self.table.rows.shape)}"
             )
         sharding = NamedSharding(self.mesh, shard_spec(self.mesh))
         self.table = Table2(
-            rows=jax.device_put(jnp.asarray(rows, dtype=jnp.int32), sharding)
+            rows=jax.device_put(jnp.asarray(rows, dtype=jnp.int32), sharding),
+            layout=lay,
         )
         if self.ckpt is not None:
             # mid-life restore: state of unknown provenance — next delta
@@ -680,22 +727,45 @@ class ShardedEngine:
         from gubernator_tpu.ops.table2 import extract_live_rows
 
         now = now_ms if now_ms is not None else ms_now()
-        return extract_live_rows(self.table.rows, now)
+        return extract_live_rows(
+            self.table.rows, now, layout=self.table.layout
+        )
+
+    def _slots_to_full(self, slots: np.ndarray, layout=None) -> np.ndarray:
+        """Normalize incoming slot rows to the canonical full layout (cf.
+        LocalEngine._slots_to_full — same inference rules)."""
+        from gubernator_tpu.ops import layout as layout_mod
+
+        if layout is None:
+            if slots.shape[1] == layout_mod.FULL.F:
+                layout = layout_mod.FULL
+            elif slots.shape[1] == self.table.layout.F:
+                layout = self.table.layout
+            else:
+                raise ValueError(
+                    f"cannot infer slot layout for width {slots.shape[1]}"
+                )
+        return np.asarray(layout.unpack(slots))
 
     def merge_rows(
-        self, fps: np.ndarray, slots: np.ndarray, now_ms: Optional[int] = None
+        self, fps: np.ndarray, slots: np.ndarray,
+        now_ms: Optional[int] = None, layout=None,
     ) -> int:
         n = fps.shape[0]
         if n == 0:
             return 0
         from gubernator_tpu.ops.engine import _occurrence_rank
+        from gubernator_tpu.ops.table2 import FLAGS
 
+        slots = self._slots_to_full(slots, layout)
         rank = _occurrence_rank(fps)
         if rank.max() > 0:  # unique-fp contract (cf. LocalEngine.merge_rows)
             return sum(
                 self.merge_rows(fps[rank == r], slots[rank == r], now_ms)
                 for r in range(int(rank.max()) + 1)
             )
+        if not self.table.layout.supports_algos(slots[:, FLAGS] & 0xFF):
+            self.migrate_layout_full("merge of off-family rows")
         now = now_ms if now_ms is not None else ms_now()
         self._mark_dirty(fps)
         D = self.n_shards
@@ -714,6 +784,39 @@ class ShardedEngine:
         )
         self.stats.dispatches += 1
         return int(np.asarray(merged).sum())
+
+    def read_state(self, fps: np.ndarray):
+        """(found, full-width slots) for `fps` — the ShardedEngine analog
+        of LocalEngine.read_state (routed shard_map gather, no mutation)."""
+        from gubernator_tpu.ops.table2 import F as F_FULL
+
+        n = fps.shape[0]
+        if n == 0:
+            return (
+                np.zeros(0, dtype=bool), np.zeros((0, F_FULL), dtype=np.int32)
+            )
+        D = self.n_shards
+        routed = shard_of(fps, D)
+        order, rs, offset, b_local = _route_plan(routed, D)
+        fp_g = _to_grid(fps[order].astype(np.int64), rs, offset, D, b_local)
+        act_g = _to_grid(np.ones(n, dtype=bool), rs, offset, D, b_local)
+        fn = getattr(self, "_gather_fn", None)
+        if fn is None or getattr(self, "_gather_layout", None) is not (
+            self.table.layout
+        ):
+            fn = self._gather_fn = make_sharded_gather(
+                self.mesh, layout=self.table.layout
+            )
+            self._gather_layout = self.table.layout
+        put = lambda x: jax.device_put(x, self._batch_sharding)
+        slots_g, found_g = fn(self.table.rows, put(fp_g), put(act_g))
+        slots_h = np.asarray(slots_g)
+        found_h = np.asarray(found_g)
+        slots = np.zeros((n, F_FULL), dtype=np.int32)
+        found = np.zeros(n, dtype=bool)
+        slots[order] = slots_h[rs, offset]
+        found[order] = found_h[rs, offset]
+        return found, slots
 
     def tombstone_fps(self, fps: np.ndarray) -> int:
         n = fps.shape[0]
@@ -753,7 +856,9 @@ class ShardedEngine:
         offset = np.arange(gids.shape[0]) - np.searchsorted(rs, rs)
         bidx[rs, offset] = local[order]
         if self._extract_dirty_fn is None:
-            self._extract_dirty_fn = make_sharded_extract_dirty(self.mesh, blk)
+            self._extract_dirty_fn = make_sharded_extract_dirty(
+                self.mesh, blk, layout=self.table.layout
+            )
         put = lambda x: jax.device_put(x, self._batch_sharding)
         return self._extract_dirty_fn(
             self.table.rows, put(bidx),
@@ -763,7 +868,7 @@ class ShardedEngine:
     def checkpoint_finish(self, pending):
         """Fetch per-shard live prefixes (pow2-padded — the
         extract_live_rows fetch rule, per shard) and concatenate."""
-        from gubernator_tpu.ops.table2 import F
+        F = self.table.layout.F
 
         slots_g, fp_g, cnt_g = pending
         counts = np.asarray(cnt_g)
@@ -825,7 +930,39 @@ class ShardedEngine:
         staged = self._stage(pass_batch, None)
         return pass_batch, staged
 
+    def migrate_layout_full(self, reason: str = "off-family traffic") -> bool:
+        """Migrate the authoritative shards to the canonical full layout in
+        place (engine thread only; cf. LocalEngine.migrate_layout_full).
+        One jitted per-shard row unpack — the shard axis is untouched, so
+        the sharding survives the conversion."""
+        from gubernator_tpu.ops.layout import FULL
+
+        if self.table.layout is FULL:
+            return False
+        self.table = self._table_to_full(self.table)
+        self._layout = FULL
+        return True
+
+    def _table_to_full(self, table: Table2) -> Table2:
+        from gubernator_tpu.ops.layout import FULL
+
+        import logging
+
+        logging.getLogger("gubernator_tpu.engine").warning(
+            "migrating sharded table layout %s -> full", table.layout.name
+        )
+        rows_full = jax.jit(table.layout.unpack_rows)(table.rows)
+        rows_full = jax.device_put(rows_full, self._batch_sharding)
+        self.stats.layout_migrations += 1
+        return Table2(rows=rows_full, layout=FULL)
+
     def _decide(self, table: Table2, staged):
+        from gubernator_tpu.ops.layout import FULL
+
+        if getattr(staged, "needs_full", False) and table.layout is not FULL:
+            # engine thread (_decide only runs from issue/dispatch): convert
+            # whichever table this dispatch targets before launching
+            table = self._table_to_full(table)
         dedup = self.dedup == "device"
         if isinstance(staged, _StagedA2A):
             from gubernator_tpu.parallel.a2a import make_a2a_decide
@@ -942,9 +1079,11 @@ class ShardedEngine:
         self._wire_count("put", grid.nbytes)
         with self._stage_lock:
             self.stage_dispatches += 1
+        math = effective_math(self.table.layout, batch)
         return _Staged(
             order=order, rs=rs, offset=offset, b_local=b_local, dev=dev,
-            math=_math_mode(batch), wire=wired, base=base,
+            math=math, wire=wired, base=base,
+            needs_full=batch_needs_full_layout(self.table.layout, math, batch),
         )
 
     def _wire_plan(self, batch: HostBatch) -> "tuple[bool, int]":
@@ -1033,8 +1172,10 @@ class ShardedEngine:
         self._wire_count("put", grid.nbytes)
         with self._stage_lock:
             self.stage_dispatches += 1
+        math = effective_math(self.table.layout, batch)
         return _StagedA2A(
-            c=c, dev=dev, math=_math_mode(batch), wire=wired, base=base
+            c=c, dev=dev, math=math, wire=wired, base=base,
+            needs_full=batch_needs_full_layout(self.table.layout, math, batch),
         )
 
     def _unroute(self, staged, outh: np.ndarray, n: int):
@@ -1170,6 +1311,7 @@ class _Staged(NamedTuple):
     math: str  # static decision-graph mode ("token" | "mixed")
     wire: bool = False  # compact 5-lane int32 wire grids (ops/wire.py)
     base: int = 0  # created_at base of the compact encoding
+    needs_full: bool = False  # batch unservable by a packed table layout
 
 
 class _StagedA2A(NamedTuple):
@@ -1182,6 +1324,7 @@ class _StagedA2A(NamedTuple):
     math: str  # static decision-graph mode ("token" | "mixed")
     wire: bool = False  # compact 5-lane int32 wire grids (ops/wire.py)
     base: int = 0  # created_at base of the compact encoding
+    needs_full: bool = False  # batch unservable by a packed table layout
 
 
 def _route_plan(routed: np.ndarray, D: int):

@@ -28,15 +28,15 @@ from gubernator_tpu.ops.table2 import K
 from gubernator_tpu.parallel.mesh import shard_map_compat, shard_spec
 
 
-def make_sharded_scan(mesh: Mesh, n_buckets: int):
-    """Jitted all-shards telemetry step: (D, NB, 128) rows → (D, VEC_LEN)
-    per-shard stats vectors. The table is NOT donated — the scan is a pure
-    read racing nothing (it runs issued from the engine thread like every
-    other table access)."""
+def make_sharded_scan(mesh: Mesh, n_buckets: int, layout=None):
+    """Jitted all-shards telemetry step: (D, NB, ROW_layout) rows →
+    (D, VEC_LEN) per-shard stats vectors. The table is NOT donated — the
+    scan is a pure read racing nothing (it runs issued from the engine
+    thread like every other table access)."""
     blk = block_width(n_buckets)
 
     def per_device(rows: jnp.ndarray, now: jnp.ndarray):
-        return _scan_body(rows[0], now[0, 0], blk)[None]
+        return _scan_body(rows[0], now[0, 0], blk, layout)[None]
 
     spec = shard_spec(mesh)
     fn = shard_map_compat(
@@ -54,8 +54,13 @@ def sharded_scan_begin(engine, now_ms: int) -> PendingScan:
     rows = engine.table.rows
     D, nb = int(rows.shape[0]), int(rows.shape[1])
     fn = getattr(engine, "_telemetry_fn", None)
-    if fn is None:
-        fn = engine._telemetry_fn = make_sharded_scan(engine.mesh, nb)
+    if fn is None or getattr(engine, "_telemetry_layout", None) is not (
+        engine.table.layout
+    ):
+        fn = engine._telemetry_fn = make_sharded_scan(
+            engine.mesh, nb, layout=engine.table.layout
+        )
+        engine._telemetry_layout = engine.table.layout
     now = jax.device_put(
         jnp.full((D, 1), now_ms, dtype=jnp.int64), engine._batch_sharding
     )

@@ -19,7 +19,11 @@ Schema (proto3, package pb.gubernator):
       uint32 count          = 6;  // rows in this chunk
       bytes  fps            = 7;  // count × int64 LE fingerprints
       bytes  points         = 8;  // count × uint32 LE ring points
-      bytes  slots          = 9;  // count × 16 × int32 LE packed slot fields
+      bytes  slots          = 9;  // count × F × int32 LE slot fields, in the
+                                  // sender's slot layout (ops/layout.py)
+      uint32 layout         = 10; // sender's slot-layout code (0 = full —
+                                  // the proto3 default, so pre-layout
+                                  // senders decode as full automatically)
     }
     message TransferStateResp {
       uint32 merged   = 1;  // rows merged/installed by the receiver
@@ -55,6 +59,7 @@ for _name, _num, _type in (
     ("fps", 7, _FD.TYPE_BYTES),
     ("points", 8, _FD.TYPE_BYTES),
     ("slots", 9, _FD.TYPE_BYTES),
+    ("layout", 10, _FD.TYPE_UINT32),
 ):
     _f = _req.field.add()
     _f.name, _f.number, _f.type = _name, _num, _type

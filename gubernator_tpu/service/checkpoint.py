@@ -87,20 +87,30 @@ class CheckpointManager:
         engine = daemon.engine
         self.restored = "cold"
         rows = None
+        base_layout = None
         if os.path.exists(self.base_path):
             from gubernator_tpu.store import load_snapshot_meta
 
             try:
-                rows, self.base_epoch = load_snapshot_meta(self.base_path)
+                rows, self.base_epoch, layout_name = load_snapshot_meta(
+                    self.base_path
+                )
+                from gubernator_tpu.ops.layout import LAYOUTS
+
+                base_layout = LAYOUTS[layout_name]
             except Exception as exc:
                 log.warning(
                     "base snapshot %s unreadable (%s); cold start",
                     self.base_path, exc,
                 )
                 daemon.metrics.checkpoint_errors.labels(stage="restore").inc()
+                rows = None
         if rows is not None:
             try:
-                engine.restore(np.asarray(rows))
+                # cross-layout restores (snapshot written under a different
+                # GUBER_SLOT_LAYOUT) convert through the canonical full row
+                # inside engine.restore
+                engine.restore(np.asarray(rows), layout=base_layout)
                 self.restored = "base"
             except Exception as exc:
                 # geometry/schema mismatch (cache_size changed across
@@ -145,14 +155,20 @@ class CheckpointManager:
         from gubernator_tpu.store import fps_from_slots
 
         t0 = time.perf_counter()
-        for epoch, _now_ms, slots in scan.frames:
+        for epoch, _now_ms, slots, frame_layout in scan.frames:
             if epoch <= self.base_epoch:
                 continue  # already compacted into the base
             if slots.shape[0] == 0:
                 self.last_epoch = max(self.last_epoch, epoch)
                 continue
             try:
-                engine.merge_rows(fps_from_slots(slots), slots)
+                # frames written under another layout (restart with a
+                # different GUBER_SLOT_LAYOUT) convert through the
+                # canonical full row inside merge_rows — replay stays
+                # conservative whatever the layouts
+                engine.merge_rows(
+                    fps_from_slots(slots), slots, layout=frame_layout
+                )
             except Exception as exc:
                 log.warning(
                     "delta frame (epoch %d) replay failed (%s); stopping "
@@ -220,9 +236,12 @@ class CheckpointManager:
                 return out
             loop = asyncio.get_running_loop()
             now_ms = daemon.now_ms()
+            lay = daemon.engine.table.layout
             try:
                 nbytes = await loop.run_in_executor(
-                    None, self._log.append, epoch, now_ms, slots
+                    None, lambda: self._log.append(
+                        epoch, now_ms, slots, layout=lay
+                    )
                 )
             except Exception as exc:
                 # disk full / unwritable path: defer the dirt to the next
@@ -256,7 +275,7 @@ class CheckpointManager:
         daemon = self.daemon
         async with self._lock:
             t0 = time.perf_counter()
-            rows, epoch = await daemon.runner.checkpoint_snapshot()
+            rows, epoch, lay = await daemon.runner.checkpoint_snapshot()
             loop = asyncio.get_running_loop()
             from gubernator_tpu.ops.table2 import live_count2, Table2
             from gubernator_tpu.store import save_snapshot
@@ -266,12 +285,13 @@ class CheckpointManager:
             def write_base():
                 # everything that touches disk stays off the event loop:
                 # snapshot write + rename, log reset, size stat
-                save_snapshot(self.base_path, rows, epoch)
+                save_snapshot(self.base_path, rows, epoch,
+                              layout_name=lay.name)
                 self._log.reset()
                 # the rows are already host-side; the live count is one
                 # vectorized pass over memory the save just touched
                 return (
-                    live_count2(Table2(rows=rows), now_ms),
+                    live_count2(Table2(rows=rows, layout=lay), now_ms),
                     os.path.getsize(self.base_path),
                 )
 

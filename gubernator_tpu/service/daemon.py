@@ -444,13 +444,23 @@ class Daemon:
         the first GLOBAL sync round of a fresh daemon. All three static math
         variants compile (engine._math_mode picks per dispatch): an all-token
         warm batch alone would leave the first leaky- or GCRA-carrying
-        request to pay the mixed/int graph's compile on the request path."""
-        for algos in (
+        request to pay the mixed/int graph's compile on the request path.
+        Packed-layout tables (GUBER_SLOT_LAYOUT) warm ONLY their own
+        family's graph — an off-family warm batch would migrate the table
+        to full before the first real request arrives."""
+        lay = getattr(self.engine.table, "layout", None)
+        variants = (
             [0],  # math="token" graph
             [2],  # math="gcra" graph (all-GCRA specialization)
             [2, 3],  # math="int" graph (mixed integer algorithms)
             [1],  # math="mixed" graph
-        ):
+        )
+        if lay is not None and lay.algos is not None:
+            variants = tuple(
+                v for v in variants
+                if lay.supports_algos(np.asarray(v, dtype=np.int32))
+            )
+        for algos in variants:
             n = len(algos)
             warm = RequestColumns(
                 fp=np.arange(1, n + 1, dtype=np.int64),
@@ -464,9 +474,13 @@ class Daemon:
                 err=np.zeros(n, dtype=np.int8),
             )
             await self.runner.check_columns(warm)
+        warm_install_algo = (
+            lay.algos[0]
+            if lay is not None and lay.algos is not None else 0
+        )
         await self.runner.install_columns(
             fp=np.asarray([1], dtype=np.int64),
-            algo=np.zeros(1, dtype=np.int32),
+            algo=np.full(1, warm_install_algo, dtype=np.int32),
             status=np.zeros(1, dtype=np.int32),
             limit=np.ones(1, dtype=np.int64),
             remaining=np.ones(1, dtype=np.int64),
@@ -1076,17 +1090,18 @@ class Daemon:
                 resps.append(r)
             return pb.GetRateLimitsResp(responses=resps).SerializeToString()
         t0 = time.perf_counter()
+        now = self.now_ms()  # retry_after_ms metadata basis (denied rows)
         if n * 8 >= self.DOOR_OFFLOAD_BYTES:
             # native encode drops the GIL — responder workers encode big
             # batches in parallel off the event loop
             out_bytes = await asyncio.get_running_loop().run_in_executor(
                 self._door,
                 encode_response_columns,
-                status, limit, remaining, reset, errors,
+                status, limit, remaining, reset, errors, now,
             )
         else:
             out_bytes = encode_response_columns(
-                status, limit, remaining, reset, errors
+                status, limit, remaining, reset, errors, now
             )
         self._observe_request_stage(
             "encode", time.perf_counter() - t0, tracing.current_span()
@@ -1140,13 +1155,14 @@ class Daemon:
             cols, items, self.conf.cascade_max_levels
         )
         rc = await self.batcher.check(exp)
+        now = self.now_ms()
         if counts is None:
-            return pb_from_response_columns(rc)
+            return pb_from_response_columns(rc, now_ms=now)
         for m in counts:
             if m:
                 self.metrics.cascade_depth.observe(1 + m)
         return pb_from_cascade_response_columns(
-            rc, counts, self.conf.cascade_max_levels
+            rc, counts, self.conf.cascade_max_levels, now_ms=now
         )
 
     async def _forward(self, row: int, key: str, item, out) -> None:
@@ -1317,6 +1333,25 @@ class Daemon:
         n = len(g)
         if n:
             fp = np.fromiter((_hashkey_fp(u.key) for u in g), dtype=np.int64, count=n)
+            remaining = np.fromiter(
+                (u.status.remaining for u in g), dtype=np.int64, count=n
+            )
+            # sliding-window fidelity metadata (w_prev / w_rem — see
+            # global_manager._broadcast): replicas interpolate the same
+            # `used` as the owner. Absent (old senders / non-window rows)
+            # the install falls back to the conservative weighted rebuild.
+            aux = np.zeros(n, dtype=np.int64)
+            rem_store = remaining.copy()
+            has_meta = False
+            for i, u in enumerate(g):
+                md = u.status.metadata
+                if "w_prev" in md:
+                    try:
+                        aux[i] = int(md["w_prev"])
+                        rem_store[i] = int(md.get("w_rem", remaining[i]))
+                        has_meta = True
+                    except ValueError:
+                        pass
             await self.runner.install_columns(
                 fp=fp,
                 algo=np.fromiter((u.algorithm for u in g), dtype=np.int32, count=n),
@@ -1324,13 +1359,13 @@ class Daemon:
                     (u.status.status for u in g), dtype=np.int32, count=n
                 ),
                 limit=np.fromiter((u.status.limit for u in g), dtype=np.int64, count=n),
-                remaining=np.fromiter(
-                    (u.status.remaining for u in g), dtype=np.int64, count=n
-                ),
+                remaining=remaining,
                 reset_time=np.fromiter(
                     (u.status.reset_time for u in g), dtype=np.int64, count=n
                 ),
                 duration=np.fromiter((u.duration for u in g), dtype=np.int64, count=n),
+                aux=aux if has_meta else None,
+                rem_store=rem_store if has_meta else None,
             )
             self.metrics.updates_installed.inc(n)
             self.metrics.broadcast_counter.labels(
@@ -1372,8 +1407,8 @@ class Daemon:
         cached = self._applied_transfers.get(key)
         if cached is not None:
             return handoff_pb.TransferStateResp(merged=cached, duplicate=True)
-        fps, points, slots = transfer_chunk_arrays(req)
-        merged = await self.runner.merge_rows(fps, slots)
+        fps, points, slots, chunk_layout = transfer_chunk_arrays(req)
+        merged = await self.runner.merge_rows(fps, slots, layout=chunk_layout)
         self.ownership.record(fps, points)
         self.metrics.handoff_rows.labels(phase="merged").inc(merged)
         self._applied_transfers[key] = merged
@@ -1601,7 +1636,15 @@ class Daemon:
         if loader is None:
             return
         try:
-            loader.save(self.runner.snapshot_sync())
+            rows = self.runner.snapshot_sync()
+            lay = self.engine.table.layout
+            try:
+                # FileLoader records the slot layout so a later meta read
+                # interprets the bytes; Loader subclasses without the kw
+                # keep the classic single-arg contract
+                loader.save(rows, layout_name=lay.name)
+            except TypeError:
+                loader.save(rows)
         except Exception:
             log.exception("shutdown checkpoint failed; state not persisted")
             self.metrics.checkpoint_errors.labels(stage="shutdown").inc()

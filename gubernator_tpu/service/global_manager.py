@@ -341,17 +341,52 @@ class GlobalManager:
             reads.append(r)
         cols, _ = columns_from_pb(reads)
         rc = await self.daemon.runner.check_columns(cols)
+        # sliding-window fidelity (PR 11): the wire's (status, remaining)
+        # alone cannot rebuild a window — replicas need the previous-window
+        # count and the STORED-style remaining to interpolate the same
+        # `used` as the owner. Read the owner's stored slots for the
+        # window keys once per broadcast and ride them as status metadata
+        # (the frozen proto schema has no field; old receivers ignore it —
+        # mixed-version clusters degrade to the legacy permissive rebuild).
+        win_meta: dict = {}
+        win_rows = [
+            i for i, (_k, it) in enumerate(batch.items())
+            if it.algorithm == int(pb.SLIDING_WINDOW)
+        ]
+        if win_rows:
+            import numpy as np
+
+            from gubernator_tpu.ops.table2 import (
+                LIMIT, REM_I, REMF_HI, REMF_LO,
+            )
+
+            found, slots = await self.daemon.runner.read_state(
+                np.asarray(cols.fp)[win_rows]
+            )
+            for j, i in enumerate(win_rows):
+                if not found[j]:
+                    continue
+                prev = (int(slots[j, REMF_HI]) << 32) | (
+                    int(slots[j, REMF_LO]) & 0xFFFFFFFF
+                )
+                rem_store = int(slots[j, REM_I])
+                win_meta[i] = (prev, rem_store)
         globals_ = []
         for i, (key, item) in enumerate(batch.items()):
+            status = pb.RateLimitResp(
+                status=int(rc.status[i]),
+                limit=int(rc.limit[i]),
+                remaining=int(rc.remaining[i]),
+                reset_time=int(rc.reset_time[i]),
+            )
+            if i in win_meta:
+                prev, rem_store = win_meta[i]
+                status.metadata["w_prev"] = str(prev)
+                status.metadata["w_rem"] = str(rem_store)
             globals_.append(
                 peers_pb.UpdatePeerGlobal(
                     key=key,
-                    status=pb.RateLimitResp(
-                        status=int(rc.status[i]),
-                        limit=int(rc.limit[i]),
-                        remaining=int(rc.remaining[i]),
-                        reset_time=int(rc.reset_time[i]),
-                    ),
+                    status=status,
                     algorithm=item.algorithm,
                     duration=item.duration,
                     created_at=item.created_at or self.daemon.now_ms(),

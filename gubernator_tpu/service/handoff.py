@@ -177,11 +177,14 @@ class HandoffManager:
         loop = asyncio.get_running_loop()
         n = fps.shape[0]
         total = -(-n // self.chunk_rows)
+        # chunks travel in this daemon's own slot layout; the receiver
+        # converts through the canonical full row on mismatch (merge_rows)
+        layout = daemon.engine.table.layout
         for ci in range(total):
             sl = slice(ci * self.chunk_rows, (ci + 1) * self.chunk_rows)
             req = transfer_chunk_pb(
                 transfer_id, ci, total, daemon.conf.advertise_address, now,
-                fps[sl], points[sl], slots[sl],
+                fps[sl], points[sl], slots[sl], layout=layout,
             )
             attempt = 0
             while True:

@@ -367,11 +367,11 @@ class EngineRunner:
 
         epoch, gids, pending = await loop.run_in_executor(self._exec, begin)
         if pending is None:
-            from gubernator_tpu.ops.table2 import F
-
+            width = self.engine.table.layout.F
             return (
                 epoch, gids,
-                np.empty(0, dtype=np.int64), np.empty((0, F), dtype=np.int32),
+                np.empty(0, dtype=np.int64),
+                np.empty((0, width), dtype=np.int32),
             )
         if self._ckpt is None:
             self._ckpt = ThreadPoolExecutor(
@@ -383,14 +383,17 @@ class EngineRunner:
         return epoch, gids, fps, slots
 
     async def checkpoint_snapshot(self):
-        """(full table rows, epoch) read atomically on the engine thread —
-        the compaction input (rows coherent with the epoch counter)."""
+        """(full table rows, epoch, slot layout) read atomically on the
+        engine thread — the compaction input (rows coherent with the epoch
+        counter AND the layout those bytes are in)."""
         loop = asyncio.get_running_loop()
 
         def run():
             tracker = self.engine.ckpt
-            return self.engine.snapshot(), (
-                tracker.epoch if tracker is not None else 0
+            return (
+                self.engine.snapshot(),
+                tracker.epoch if tracker is not None else 0,
+                self.engine.table.layout,
             )
 
         return await loop.run_in_executor(self._exec, run)
@@ -406,17 +409,27 @@ class EngineRunner:
         )
 
     async def merge_rows(
-        self, fps: np.ndarray, slots: np.ndarray, now_ms: Optional[int] = None
+        self, fps: np.ndarray, slots: np.ndarray,
+        now_ms: Optional[int] = None, layout=None,
     ) -> int:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
-            self._exec, lambda: self.engine.merge_rows(fps, slots, now_ms)
+            self._exec,
+            lambda: self.engine.merge_rows(fps, slots, now_ms, layout=layout),
         )
 
     async def tombstone_fps(self, fps: np.ndarray) -> int:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
             self._exec, lambda: self.engine.tombstone_fps(fps)
+        )
+
+    async def read_state(self, fps: np.ndarray):
+        """(found, full-width slots) stored-state read — engine thread for
+        a coherent table view (the GLOBAL broadcast aux source)."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._exec, lambda: self.engine.read_state(fps)
         )
 
     async def maybe_grow(self, **kw) -> bool:

@@ -112,20 +112,33 @@ def columns_from_pb(
 
 
 def pb_from_response_columns(
-    rc: ResponseColumns, rows: Sequence[int] = None
+    rc: ResponseColumns, rows: Sequence[int] = None,
+    now_ms: Optional[int] = None,
 ) -> List["pb.RateLimitResp"]:
-    """ResponseColumns → RateLimitResp list (optionally a row subset)."""
-    idx = range(rc.status.shape[0]) if rows is None else rows
-    return [
-        pb.RateLimitResp(
-            status=int(rc.status[i]),
+    """ResponseColumns → RateLimitResp list (optionally a row subset).
+    With `now_ms`, denied rows additionally surface
+    metadata["retry_after_ms"] — the ms until the reset/conforming instant
+    (for GCRA denials reset_time IS the exact TAT-derived conforming
+    instant, ops/math.py). The frozen proto schema has no field for it;
+    metadata keeps old clients compatible."""
+
+    def resp(i):
+        st = int(rc.status[i])
+        r = pb.RateLimitResp(
+            status=st,
             limit=int(rc.limit[i]),
             remaining=int(rc.remaining[i]),
             reset_time=int(rc.reset_time[i]),
             error=ERROR_STRINGS[int(rc.err[i])],
         )
-        for i in idx
-    ]
+        if now_ms is not None and st == 1:
+            r.metadata["retry_after_ms"] = str(
+                max(0, int(rc.reset_time[i]) - int(now_ms))
+            )
+        return r
+
+    idx = range(rc.status.shape[0]) if rows is None else rows
+    return [resp(i) for i in idx]
 
 
 def subset_columns(cols: RequestColumns, rows: np.ndarray) -> RequestColumns:
@@ -286,7 +299,8 @@ def expand_cascades(
 
 
 def pb_from_cascade_response_columns(
-    rc: ResponseColumns, counts: List[int], max_levels: int
+    rc: ResponseColumns, counts: List[int], max_levels: int,
+    now_ms: Optional[int] = None,
 ) -> List["pb.RateLimitResp"]:
     """Contract an expanded response back to per-request RateLimitResp
     messages: the carrier row (already folded to the combined verdict)
@@ -295,28 +309,39 @@ def pb_from_cascade_response_columns(
     out: List[pb.RateLimitResp] = []
     off = 0
     for m in counts:
-        top = _resp_at(rc, off, max_levels)
+        top = _resp_at(rc, off, max_levels, now_ms)
         for k in range(1, m + 1):
-            top.cascade.append(_resp_at(rc, off + k, max_levels))
+            top.cascade.append(_resp_at(rc, off + k, max_levels, now_ms))
         out.append(top)
         off += 1 + m
     return out
 
 
-def _resp_at(rc: ResponseColumns, i: int, max_levels: int) -> "pb.RateLimitResp":
+def _resp_at(
+    rc: ResponseColumns, i: int, max_levels: int,
+    now_ms: Optional[int] = None,
+) -> "pb.RateLimitResp":
     code = int(rc.err[i])
     msg = (
         cascade_too_deep_error(max_levels)
         if code == ERR_CASCADE_DEEP
         else ERROR_STRINGS[code]
     )
-    return pb.RateLimitResp(
-        status=int(rc.status[i]),
+    st = int(rc.status[i])
+    r = pb.RateLimitResp(
+        status=st,
         limit=int(rc.limit[i]),
         remaining=int(rc.remaining[i]),
         reset_time=int(rc.reset_time[i]),
         error=msg,
     )
+    if now_ms is not None and st == 1:
+        # the carrier's folded reset is the latest denying level's reset —
+        # exactly the retry-after bound (kernel2.fold_cascade_packed)
+        r.metadata["retry_after_ms"] = str(
+            max(0, int(rc.reset_time[i]) - int(now_ms))
+        )
+    return r
 
 
 # ------------------------------------------------------------ state handoff
@@ -331,11 +356,17 @@ def transfer_chunk_pb(
     fps: np.ndarray,
     points: np.ndarray,
     slots: np.ndarray,
+    layout=None,
 ):
     """One TransferState chunk from extract arrays (little-endian memory
-    images — no per-row message objects; see proto/handoff_pb2.py)."""
+    images — no per-row message objects; see proto/handoff_pb2.py). The
+    slot rows travel in the SENDER's slot layout, tagged by `layout` (code
+    0 = full, the proto3 default — a pre-layout peer's chunks decode as
+    full automatically)."""
+    from gubernator_tpu.ops.layout import FULL
     from gubernator_tpu.proto import handoff_pb2 as handoff_pb
 
+    layout = layout or FULL
     return handoff_pb.TransferStateReq(
         transfer_id=transfer_id,
         chunk=chunk,
@@ -346,15 +377,20 @@ def transfer_chunk_pb(
         fps=np.ascontiguousarray(fps, dtype=np.int64).tobytes(),
         points=np.ascontiguousarray(points, dtype=np.uint32).tobytes(),
         slots=np.ascontiguousarray(slots, dtype=np.int32).tobytes(),
+        layout=layout.code,
     )
 
 
 def transfer_chunk_arrays(req):
-    """Decode a TransferStateReq back into (fps, points, slots) arrays,
+    """Decode a TransferStateReq back into (fps, points, slots, layout),
     validating the advertised count against every buffer length (a short
-    buffer must fail loudly, not merge garbage rows)."""
-    from gubernator_tpu.ops.table2 import F
+    buffer must fail loudly, not merge garbage rows). `slots` come back in
+    the SENDER's layout (`layout`); the receiver converts through the
+    canonical full row (engine.merge_rows(layout=...))."""
+    from gubernator_tpu.ops.layout import layout_by_code
 
+    layout = layout_by_code(int(req.layout))
+    F = layout.F
     n = int(req.count)
     fps = np.frombuffer(req.fps, dtype=np.int64)
     points = np.frombuffer(req.points, dtype=np.uint32)
@@ -362,9 +398,10 @@ def transfer_chunk_arrays(req):
     if fps.shape[0] != n or points.shape[0] != n or slots.shape[0] != n * F:
         raise ValueError(
             f"transfer chunk length mismatch: count={n} fps={fps.shape[0]} "
-            f"points={points.shape[0]} slots={slots.shape[0]}"
+            f"points={points.shape[0]} slots={slots.shape[0]} "
+            f"(layout {layout.name})"
         )
-    return fps, points, slots.reshape(n, F)
+    return fps, points, slots.reshape(n, F), layout
 
 
 # ----------------------------------------------------------- native ingress
@@ -441,12 +478,15 @@ def encode_response_columns(
     remaining: np.ndarray,
     reset_time: np.ndarray,
     errors: dict,
+    now_ms: Optional[int] = None,
 ) -> bytes:
     """Native GetRateLimitsResp encode from response columns; `errors` is a
     sparse {row: message} dict. Arrays cross the boundary via the buffer
     protocol — contiguous int64 columns encode ZERO-COPY (no .tobytes()
     staging), and the C assembly loop drops the GIL so responder workers
-    encode in parallel."""
+    encode in parallel. With `now_ms`, denied rows carry
+    metadata["retry_after_ms"] (the exact conforming-instant delta for
+    GCRA — see ops/math.py)."""
     from gubernator_tpu import native
 
     m = native.load()
@@ -457,6 +497,7 @@ def encode_response_columns(
         np.ascontiguousarray(remaining, dtype=np.int64),
         np.ascontiguousarray(reset_time, dtype=np.int64),
         errors,
+        -1 if now_ms is None else int(now_ms),
     )
 
 

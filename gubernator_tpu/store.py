@@ -34,19 +34,30 @@ import numpy as np
 SNAPSHOT_MAGIC = "GUBTPU1"
 
 
-def save_snapshot(path: str, rows: np.ndarray, epoch: int = 0) -> None:
+def save_snapshot(path: str, rows: np.ndarray, epoch: int = 0,
+                  layout_name: str = "full") -> None:
     """Atomically write a table snapshot (tmp + rename, so a crash mid-write
     never leaves a torn file for the next boot). `epoch` records the last
     checkpoint epoch the snapshot includes (0 on the classic full-snapshot
-    path) so warm restart can skip already-compacted delta frames."""
+    path) so warm restart can skip already-compacted delta frames.
+    `layout_name` records the slot layout the rows bytes are in
+    (ops/layout.py) — "full" writes a file byte-identical to the
+    pre-layout format."""
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".gubtpu-snap-")
     try:
         with os.fdopen(fd, "wb") as f:
+            extra = {}
+            if layout_name != "full":
+                # only non-default layouts write the key: full snapshots
+                # stay byte-identical to every pre-layout file
+                extra["layout"] = np.frombuffer(
+                    layout_name.encode(), dtype=np.uint8
+                )
             np.savez_compressed(f, magic=np.frombuffer(
                 SNAPSHOT_MAGIC.encode(), dtype=np.uint8
-            ), rows=rows, epoch=np.int64(epoch))
+            ), rows=rows, epoch=np.int64(epoch), **extra)
         os.replace(tmp, path)
     except BaseException:
         try:
@@ -64,15 +75,18 @@ def load_snapshot(path: str) -> np.ndarray:
         return z["rows"]
 
 
-def load_snapshot_meta(path: str) -> "Tuple[np.ndarray, int]":
-    """(rows, epoch) — epoch is 0 for snapshots written before the
-    incremental-checkpoint plane existed."""
+def load_snapshot_meta(path: str) -> "Tuple[np.ndarray, int, str]":
+    """(rows, epoch, layout_name) — epoch is 0 and layout "full" for
+    snapshots written before the respective planes existed."""
     with np.load(path) as z:
         magic = bytes(z["magic"]).decode()
         if magic != SNAPSHOT_MAGIC:
             raise ValueError(f"{path}: not a gubernator-tpu snapshot")
         epoch = int(z["epoch"]) if "epoch" in z.files else 0
-        return z["rows"], epoch
+        layout = (
+            bytes(z["layout"]).decode() if "layout" in z.files else "full"
+        )
+        return z["rows"], epoch, layout
 
 
 # ------------------------------------------------------------- delta log
@@ -87,16 +101,29 @@ def load_snapshot_meta(path: str) -> "Tuple[np.ndarray, int]":
 
 DELTA_LOG_MAGIC = b"GUBTPUDL"  # 8-byte file header
 FRAME_MAGIC = 0x46445547  # "GUDF" little-endian
+# frame version doubles as the SLOT-LAYOUT byte: version = 1 + layout.code
+# (ops/layout.py), so a full-layout frame is version 1 — byte-identical to
+# every log written before packed layouts existed — and a reader that
+# predates a layout refuses its frames (scan stops at the unknown version,
+# the conservative prefix rule) instead of misparsing the rows.
 FRAME_VERSION = 1
 # frame header: magic u32, version u32, n_rows u32, epoch i64, now_ms i64,
 # payload crc32 u32
 _FRAME_HEADER = struct.Struct("<IIIqqI")
-_SLOT_FIELDS = 16  # table2.F — frozen into the on-disk format by VERSION 1
+_SLOT_FIELDS = 16  # full-layout fields/row (VERSION 1); packed versions
+# derive theirs from the layout registry
+
+
+def _frame_layout(version: int):
+    from gubernator_tpu.ops.layout import layout_by_code
+
+    return layout_by_code(version - 1)
 
 
 def fps_from_slots(slots: np.ndarray) -> np.ndarray:
-    """Fingerprints encoded in packed slot rows (fields FP_LO/FP_HI) — the
-    reason delta frames need no separate fp column."""
+    """Fingerprints encoded in packed slot rows (fields FP_LO/FP_HI — the
+    0/1 position is a cross-layout invariant, ops/layout.py) — the reason
+    delta frames need no separate fp column."""
     from gubernator_tpu.ops.table2 import FP_HI, FP_LO
 
     lo = slots[:, FP_LO].astype(np.int64) & 0xFFFFFFFF
@@ -104,13 +131,28 @@ def fps_from_slots(slots: np.ndarray) -> np.ndarray:
     return (hi << 32) | lo
 
 
-def encode_delta_frame(epoch: int, now_ms: int, slots: np.ndarray) -> bytes:
-    """One CRC-framed delta: header + raw little-endian (N, F) int32 slot
-    rows. 64 B/row — live rows of dirty blocks only, vs the base
-    snapshot's every-slot-of-every-bucket."""
+def encode_delta_frame(epoch: int, now_ms: int, slots: np.ndarray,
+                       layout=None) -> bytes:
+    """One CRC-framed delta: header + raw little-endian (N, F_layout) int32
+    slot rows — live rows of dirty blocks only, vs the base snapshot's
+    every-slot-of-every-bucket. 64 B/row under the full layout, 32 B/row
+    under the packed ones (the frame's version byte carries the layout)."""
+    if layout is None:
+        from gubernator_tpu.ops.layout import FULL
+
+        if slots.shape[1] != FULL.F:
+            raise ValueError(
+                "packed slot rows need an explicit layout for framing"
+            )
+        layout = FULL
+    if slots.shape[1] != layout.F:
+        raise ValueError(
+            f"slot rows are {slots.shape[1]} fields wide but layout "
+            f"{layout.name} has {layout.F}"
+        )
     payload = np.ascontiguousarray(slots, dtype="<i4").tobytes()
     header = _FRAME_HEADER.pack(
-        FRAME_MAGIC, FRAME_VERSION, slots.shape[0], epoch, now_ms,
+        FRAME_MAGIC, 1 + layout.code, slots.shape[0], epoch, now_ms,
         zlib.crc32(payload),
     )
     return header + payload
@@ -123,7 +165,8 @@ class DeltaScan:
     semantics, while resynchronizing past a corrupt length field is not."""
 
     def __init__(self):
-        self.frames: List[Tuple[int, int, np.ndarray]] = []  # (epoch, now, slots)
+        # (epoch, now_ms, slots, layout) — slots in the frame's own layout
+        self.frames: List[Tuple[int, int, np.ndarray, object]] = []
         self.skipped_bytes = 0
         self.clean_bytes = 0  # file prefix (log header + clean frames)
         self.error: Optional[str] = None
@@ -157,12 +200,19 @@ def read_delta_frames(path: str) -> DeltaScan:
                 scan.skipped_bytes = os.path.getsize(path) - pos
                 break
             magic, version, n_rows, epoch, now_ms, crc = _FRAME_HEADER.unpack(hdr)
-            if magic != FRAME_MAGIC or version != FRAME_VERSION:
-                scan.error = f"bad frame magic/version at offset {pos}"
+            if magic != FRAME_MAGIC:
+                scan.error = f"bad frame magic at offset {pos}"
                 scan.skipped_bytes = os.path.getsize(path) - pos
                 break
-            payload = f.read(n_rows * _SLOT_FIELDS * 4)
-            if len(payload) < n_rows * _SLOT_FIELDS * 4:
+            try:
+                layout = _frame_layout(version)
+            except ValueError:
+                scan.error = f"unknown frame version {version} at offset {pos}"
+                scan.skipped_bytes = os.path.getsize(path) - pos
+                break
+            fields = layout.F
+            payload = f.read(n_rows * fields * 4)
+            if len(payload) < n_rows * fields * 4:
                 scan.error = "truncated frame payload"
                 scan.skipped_bytes = os.path.getsize(path) - pos
                 break
@@ -171,9 +221,9 @@ def read_delta_frames(path: str) -> DeltaScan:
                 scan.skipped_bytes = os.path.getsize(path) - pos
                 break
             slots = np.frombuffer(payload, dtype="<i4").reshape(
-                n_rows, _SLOT_FIELDS
+                n_rows, fields
             ).astype(np.int32)
-            scan.frames.append((epoch, now_ms, slots))
+            scan.frames.append((epoch, now_ms, slots, layout))
     return scan
 
 
@@ -190,9 +240,12 @@ class DeltaLog:
     def __init__(self, path: str):
         self.path = path
 
-    def append(self, epoch: int, now_ms: int, slots: np.ndarray) -> int:
-        """Append one frame; returns bytes written (header included)."""
-        frame = encode_delta_frame(epoch, now_ms, slots)
+    def append(self, epoch: int, now_ms: int, slots: np.ndarray,
+               layout=None) -> int:
+        """Append one frame; returns bytes written (header included).
+        `layout` tags the slot rows' layout (full inferred for 16-field
+        rows)."""
+        frame = encode_delta_frame(epoch, now_ms, slots, layout=layout)
         fresh = not os.path.exists(self.path) or (
             os.path.getsize(self.path) == 0
         )
@@ -320,8 +373,8 @@ class FileLoader(Loader):
             return load_snapshot(self.path)
         return None
 
-    def save(self, rows: np.ndarray) -> None:
-        save_snapshot(self.path, rows)
+    def save(self, rows: np.ndarray, layout_name: str = "full") -> None:
+        save_snapshot(self.path, rows, layout_name=layout_name)
 
 
 class MemoryLoader(Loader):

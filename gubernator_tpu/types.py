@@ -147,7 +147,7 @@ class RateLimitRequest:
 @dataclass
 class RateLimitResponse:
     """Field-for-field parity with reference RateLimitResp
-    (gubernator.proto:197-210)."""
+    (gubernator.proto:197-210), plus the retry_after extension."""
 
     status: int = Status.UNDER_LIMIT
     limit: int = 0
@@ -155,6 +155,21 @@ class RateLimitResponse:
     reset_time: int = 0  # epoch ms when the limit is reset
     error: str = ""
     metadata: Dict[str, str] = field(default_factory=dict)
+    # ms until a DENIED request conforms, computed from reset_time against
+    # the serving clock. For GCRA denials reset_time is the EXACT
+    # TAT-derived conforming instant (ops/math.py gcra_lanes), so a client
+    # honoring retry_after_ms backs off precisely as long as needed — the
+    # pb path additionally surfaces it as metadata["retry_after_ms"]
+    # (the frozen proto schema has no field for it). 0 for allowed rows.
+    retry_after_ms: int = 0
+
+
+def retry_after_ms(status: int, reset_time: int, now_ms: int) -> int:
+    """The retry_after surface rule: denied rows report the ms until their
+    reset/conforming instant (clamped at 0), allowed rows 0."""
+    if status != Status.OVER_LIMIT:
+        return 0
+    return max(0, int(reset_time) - int(now_ms))
 
 
 @dataclass

@@ -48,6 +48,10 @@ class GcraOracle:
         self.tat[key] = out
         rem = _clip((now + tau - out) // T, 0, burst)
         reset = out - tau + T * limit
+        if deny and not drain:
+            # exact conforming instant for the denied request (the
+            # TAT-derived retry_after bound, ops/math.py gcra_lanes)
+            reset = tat1 - tau
         return (1 if deny else 0, rem, reset)
 
 

@@ -166,7 +166,7 @@ def test_delta_frame_roundtrip(tmp_path):
     log.append(2, NOW + 5, s2)
     scan = log.scan()
     assert scan.error is None and len(scan.frames) == 2
-    (e1, t1, r1), (e2, t2, r2) = scan.frames
+    (e1, t1, r1, l1), (e2, t2, r2, l2) = scan.frames
     assert (e1, t1) == (1, NOW) and (e2, t2) == (2, NOW + 5)
     np.testing.assert_array_equal(r1, s1)
     np.testing.assert_array_equal(r2, s2)
@@ -402,9 +402,9 @@ def test_replay_parity_local(tmp_path):
         log.append(epoch, NOW + 1 + i, slots)
     # restore: base, then frames with epoch > base epoch
     dst = LocalEngine(capacity=1 << 14, write_mode="xla")
-    rows, base_epoch = load_snapshot_meta(base_path)
+    rows, base_epoch, _layout = load_snapshot_meta(base_path)
     dst.restore(rows)
-    for epoch, now_ms, slots in log.scan().frames:
+    for epoch, now_ms, slots, _lay in log.scan().frames:
         assert epoch > base_epoch
         dst.merge_rows(fps_from_slots(slots), slots, now_ms=now_ms)
     assert live_map(dst.table.rows, NOW + 4) == live_map(
@@ -518,7 +518,7 @@ async def test_daemon_checkpoint_loop_and_debug(tmp_path):
     finally:
         await d.close()
     # graceful close compacted: base carries everything, log is empty
-    _rows, epoch = load_snapshot_meta(str(tmp_path / "base.npz"))
+    _rows, epoch, _layout = load_snapshot_meta(str(tmp_path / "base.npz"))
     assert epoch >= 1
     assert DeltaLog(str(tmp_path / "base.npz") + ".delta").frame_count() == 0
 

@@ -267,3 +267,63 @@ async def test_global_aggregates_hits_across_non_owners():
         for cl in clients:
             await cl.close()
         await c.stop()
+
+
+@async_test
+async def test_sliding_window_broadcast_carries_prev_window_aux():
+    """PR-11 GLOBAL fidelity regression: owner broadcasts of SLIDING_WINDOW
+    keys must carry the previous-window count (and stored-style remaining)
+    so a replica interpolates the SAME `used` as the owner. Before the fix
+    the install rebuilt windows with prev=0, so a replica right after a
+    window roll answered far more permissively than the owner."""
+    import time
+
+    c = await Cluster.start(2, created_at_tolerance_ms=3_600_000.0)
+    clients = {
+        d.conf.advertise_address: V1Client(d.conf.grpc_address)
+        for d in c.daemons
+    }
+    try:
+        name, key = "wing", "wk1"
+        owner = c.find_owning_daemon(name, key)
+        replica = c.non_owning_daemons(name, key)[0]
+        ocl = clients[owner.conf.advertise_address]
+        rcl = clients[replica.conf.advertise_address]
+        dur, limit = 600_000, 100
+        now = time.time_ns() // 1_000_000
+        ws = now - now % dur
+        t_prev = ws - dur // 2  # middle of the PREVIOUS window
+        t_cur = ws + max(1, (now - ws) // 2)  # inside the current window
+
+        def wreq(hits, created):
+            from gubernator_tpu.types import Algorithm
+
+            return RateLimitRequest(
+                name=name, unique_key=key, hits=hits, limit=limit,
+                duration=dur, algorithm=Algorithm.SLIDING_WINDOW,
+                behavior=Behavior.GLOBAL, created_at=created,
+            )
+
+        # 40 hits land in window W-1 at the owner, then 10 in window W —
+        # the owner's state is (cur=10, prev=40)
+        r = (await ocl.get_rate_limits([wreq(40, t_prev)])).responses[0]
+        assert r.error == "" and r.status == 0
+        r = (await ocl.get_rate_limits([wreq(10, t_cur)])).responses[0]
+        assert r.error == "" and r.status == 0
+
+        t_q = t_cur + 10
+        own = (await ocl.get_rate_limits([wreq(0, t_q)])).responses[0]
+        weighted_prev = (40 * (dur - (t_q - ws))) // dur
+        assert weighted_prev > 0  # the regression needs a live prev weight
+        assert own.remaining == limit - 10 - weighted_prev
+
+        # the replica converges to the owner's EXACT interpolated answer
+        async def replica_matches():
+            rep = (await rcl.get_rate_limits([wreq(0, t_q)])).responses[0]
+            return rep.remaining == own.remaining
+
+        await wait_for(replica_matches, timeout_s=15)
+    finally:
+        for cl in clients.values():
+            await cl.close()
+        await c.stop()

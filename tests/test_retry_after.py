@@ -1,0 +1,147 @@
+"""retry_after surfaces (PR 11): denied clients back off to the exact
+conforming instant.
+
+For GCRA denials the kernel now reports the TAT-derived conforming instant
+as reset_time (ops/math.py gcra_lanes), so retry_after = reset - now is
+exact — waiting exactly that long ALWAYS conforms, and waiting any less
+never does. The engine object API fills RateLimitResponse.retry_after_ms;
+the pb path additionally rides metadata["retry_after_ms"] (frozen proto
+schema). The compact wire carries it implicitly: its reset_delta IS
+reset - base.
+"""
+
+import asyncio
+import functools
+
+import numpy as np
+
+from gubernator_tpu.ops.batch import RequestColumns
+from gubernator_tpu.ops.engine import LocalEngine
+from gubernator_tpu.types import Algorithm, RateLimitRequest
+
+NOW = 1_700_000_000_000
+
+
+def gcols(fp, hits, limit, dur, now):
+    n = fp.shape[0]
+    return RequestColumns(
+        fp=fp.astype(np.int64),
+        algo=np.full(n, int(Algorithm.GCRA), dtype=np.int32),
+        behavior=np.zeros(n, dtype=np.int32),
+        hits=np.full(n, hits, dtype=np.int64),
+        limit=np.full(n, limit, dtype=np.int64),
+        burst=np.zeros(n, dtype=np.int64),
+        duration=np.full(n, dur, dtype=np.int64),
+        created_at=np.full(n, now, dtype=np.int64),
+        err=np.zeros(n, dtype=np.int8),
+    )
+
+
+def test_gcra_denied_reset_is_exact_conforming_instant():
+    """Retrying exactly at reset conforms; one ms earlier still denies."""
+    e = LocalEngine(capacity=1 << 10, write_mode="xla")
+    fp = np.array([12345], dtype=np.int64)
+    limit, dur = 4, 8_000  # T = 2000 ms, tau = 8000 ms
+    # drain the whole tolerance: 4 hits at t0 → TAT = t0 + 8000
+    rc = e.check_columns(gcols(fp, 4, limit, dur, NOW), now_ms=NOW)
+    assert int(rc.status[0]) == 0
+    # an immediate 2-hit ask: tat1 = t0+8000+4000, conforms at tat1 - tau
+    rc = e.check_columns(gcols(fp, 2, limit, dur, NOW + 1), now_ms=NOW + 1)
+    assert int(rc.status[0]) == 1
+    t_conform = int(rc.reset_time[0])
+    assert t_conform == NOW + 8_000 + 2 * 2_000 - 8_000  # = NOW + 4000
+    # 1 ms before the conforming instant: still denied, same bound
+    rc = e.check_columns(
+        gcols(fp, 2, limit, dur, t_conform - 1), now_ms=t_conform - 1
+    )
+    assert int(rc.status[0]) == 1
+    # exactly at the conforming instant: admitted
+    rc = e.check_columns(
+        gcols(fp, 2, limit, dur, t_conform), now_ms=t_conform
+    )
+    assert int(rc.status[0]) == 0
+
+
+def test_engine_object_api_fills_retry_after_ms():
+    e = LocalEngine(capacity=1 << 10, write_mode="xla")
+    req = RateLimitRequest(
+        name="ra", unique_key="k", hits=4, limit=4, duration=8_000,
+        algorithm=Algorithm.GCRA, created_at=NOW,
+    )
+    (r,) = e.check([req], now_ms=NOW)
+    assert r.status == 0 and r.retry_after_ms == 0
+    req2 = RateLimitRequest(
+        name="ra", unique_key="k", hits=2, limit=4, duration=8_000,
+        algorithm=Algorithm.GCRA, created_at=NOW + 1,
+    )
+    (r2,) = e.check([req2], now_ms=NOW + 1)
+    assert r2.status == 1
+    # exact TAT math: conforming instant - now
+    assert r2.retry_after_ms == r2.reset_time - (NOW + 1)
+    assert r2.retry_after_ms == 3_999
+
+
+def test_pb_path_carries_retry_after_metadata():
+    from gubernator_tpu.ops.batch import ResponseColumns
+    from gubernator_tpu.service.wire import pb_from_response_columns
+
+    rc = ResponseColumns(
+        status=np.array([1, 0], dtype=np.int32),
+        limit=np.array([4, 4], dtype=np.int64),
+        remaining=np.array([0, 3], dtype=np.int64),
+        reset_time=np.array([NOW + 2_500, NOW + 9_000], dtype=np.int64),
+        err=np.zeros(2, dtype=np.int8),
+    )
+    a, b = pb_from_response_columns(rc, now_ms=NOW)
+    assert a.metadata["retry_after_ms"] == "2500"
+    assert "retry_after_ms" not in b.metadata  # allowed rows carry nothing
+    # without a clock the pb stays schema-minimal (mixed callers)
+    a2, _ = pb_from_response_columns(rc)
+    assert "retry_after_ms" not in a2.metadata
+
+
+def _async(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **k):
+        asyncio.run(fn(*a, **k))
+
+    return wrapper
+
+
+@_async
+async def test_front_door_surfaces_retry_after_metadata():
+    """A denied GCRA check over the real gRPC front door carries the
+    retry_after_ms metadata consistent with its reset_time."""
+    import time
+
+    from gubernator_tpu.client import V1Client
+    from gubernator_tpu.config import BehaviorConfig, DaemonConfig
+    from gubernator_tpu.service.daemon import Daemon
+
+    d = await Daemon.spawn(DaemonConfig(
+        grpc_address="127.0.0.1:0", http_address="",
+        cache_size=1 << 12,
+        behaviors=BehaviorConfig(batch_wait_ms=0.5),
+    ))
+    client = V1Client(d.conf.grpc_address)
+    try:
+        def req(hits):
+            return RateLimitRequest(
+                name="ra2", unique_key="k", hits=hits, limit=2,
+                duration=60_000, algorithm=Algorithm.GCRA,
+            )
+
+        resp = await client.get_rate_limits([req(2)])
+        assert resp.responses[0].status == 0
+        t0 = time.time_ns() // 1_000_000
+        resp = await client.get_rate_limits([req(2)])
+        (r,) = resp.responses
+        assert r.status == 1
+        ra = int(r.metadata["retry_after_ms"])
+        # conforming instant ≈ 60s away (2 more hits against a drained
+        # 2-per-60s budget); bound it loosely against wall clock
+        assert 0 < ra <= r.reset_time - t0 + 1_000
+        assert abs((r.reset_time - t0) - ra) < 5_000
+    finally:
+        await client.close()
+        await d.close()
