@@ -475,6 +475,137 @@ def config5_case(rng, now) -> Case:
                 math="token")
 
 
+def regions_case(rng, now) -> dict:
+    """Multi-region replication phase (ISSUE 12): (a) CODEC — replication
+    bytes per row on the compact SyncRegionsWire merge codec (full and
+    packed-sender slot rows) vs the classic GetPeerRateLimits proto
+    fallback for the same batch; (b) E2E — a two-region loopback cluster's
+    convergence wall: concurrent hits on K keys in both regions until every
+    key's total converges to the exact union, expressed in sync intervals
+    (the bound docs/robustness.md documents)."""
+    import asyncio
+
+    from gubernator_tpu.ops.engine import LocalEngine
+    from gubernator_tpu.ops.layout import FULL, TOKEN32
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.proto import peers_pb2 as peers_pb
+    from gubernator_tpu.service.wire import (
+        split_region_encodable, sync_regions_pb,
+    )
+    from gubernator_tpu.types import Behavior
+
+    out: dict = {}
+    MR = int(Behavior.MULTI_REGION)
+    B = 4096
+
+    def item(i, hits=5, name="ratelimit-bench"):
+        # realistic key shape: a tenant/user compound, ~27 chars
+        return pb.RateLimitReq(
+            name=name, unique_key=f"tenant-{i % 97:03d}/user-{i:08d}",
+            hits=hits, limit=1 << 20, duration=3_600_000, behavior=MR,
+            created_at=now,
+        )
+
+    pairs = [(f"rb_{i:06d}", item(i)) for i in range(B)]
+    enc, fb = split_region_encodable(pairs)
+    assert len(enc) == B and not fb
+    # bootstrap rows carry strings + the sender's stored slot row; steady-
+    # state rows are pure lane+hits entries merged by fingerprint
+    for lay, label in ((FULL, "bootstrap_full"), (TOKEN32,
+                                                  "bootstrap_token32")):
+        slots = np.zeros((B, lay.F), dtype=np.int32)
+        req = sync_regions_pb(enc, "bench", "dc-a", slots, lay)
+        out[f"{label}_bytes_per_row"] = round(req.ByteSize() / B, 1)
+    steady = sync_regions_pb(
+        enc, "bench", "dc-a", detail_rows=np.zeros(B, dtype=bool)
+    )
+    out["steady_state_bytes_per_row"] = round(steady.ByteSize() / B, 1)
+    proto = peers_pb.GetPeerRateLimitsReq(
+        requests=[it for _k, it in pairs]
+    )
+    out["proto_bytes_per_row"] = round(proto.ByteSize() / B, 1)
+    out["steady_reduction_vs_proto"] = round(
+        out["proto_bytes_per_row"] / out["steady_state_bytes_per_row"], 2
+    )
+
+    # ---- e2e rung: two-region loopback convergence wall
+    from gubernator_tpu.config import BehaviorConfig, DaemonConfig
+    from gubernator_tpu.service.daemon import Daemon
+    from gubernator_tpu.types import PeerInfo
+
+    K = 256
+    SYNC_MS = 25.0
+
+    async def run():
+        def conf(dc):
+            return DaemonConfig(
+                grpc_address="127.0.0.1:0", http_address="127.0.0.1:0",
+                data_center=dc, cache_size=1 << 16,
+                behaviors=BehaviorConfig(
+                    batch_wait_ms=1.0, global_sync_wait_ms=SYNC_MS,
+                    batch_timeout_ms=5000.0, global_timeout_ms=5000.0,
+                ),
+            )
+
+        a = await Daemon.spawn(conf("dc-a"))
+        b = await Daemon.spawn(conf("dc-b"))
+        try:
+            peers = [a.peer_info(), b.peer_info()]
+            for d in (a, b):
+                d.set_peers([PeerInfo(**vars(p)) for p in peers])
+            ha = rng.integers(1, 50, size=K)
+            hb = rng.integers(1, 50, size=K)
+            await a.get_rate_limits(
+                [item(i, int(ha[i])) for i in range(K)]
+            )
+            await b.get_rate_limits(
+                [item(i, int(hb[i])) for i in range(K)]
+            )
+            want = [(1 << 20) - int(ha[i] + hb[i]) for i in range(K)]
+            t0 = time.perf_counter()
+            deadline = t0 + 30.0
+            while time.perf_counter() < deadline:
+                xa = await a.get_rate_limits(
+                    [item(i, 0) for i in range(K)]
+                )
+                xb = await b.get_rate_limits(
+                    [item(i, 0) for i in range(K)]
+                )
+                if all(
+                    xa[i].remaining == xb[i].remaining == want[i]
+                    for i in range(K)
+                ):
+                    break
+                await asyncio.sleep(0.02)
+            else:
+                raise RuntimeError("two-region totals did not converge")
+            wall = time.perf_counter() - t0
+            return {
+                "keys": K,
+                "convergence_wall_s": round(wall, 3),
+                "convergence_sync_intervals": round(
+                    wall / (SYNC_MS / 1e3), 1
+                ),
+                "wire_sent": (
+                    a.region_manager.wire_sent + b.region_manager.wire_sent
+                ),
+                "wire_fallback": (
+                    a.region_manager.wire_fallback
+                    + b.region_manager.wire_fallback
+                ),
+                "rows_merged": (
+                    a.region_manager.rows_merged
+                    + b.region_manager.rows_merged
+                ),
+            }
+        finally:
+            await asyncio.gather(a.close(), b.close())
+
+    out.update(asyncio.run(run()))
+    out["converged_exact"] = True
+    return out
+
+
 def layout_case(rng, now) -> dict:
     """Packed slot-layout phase (PR 11): device decisions/s for the SAME
     all-GCRA traffic on the full 64 B layout vs the packed 32 B gcra32
@@ -2009,6 +2140,14 @@ def main() -> None:
     matrix["layout"] = _attempt(
         "layout",
         lambda: layout_case(np.random.default_rng(55), now),
+    )
+
+    # multi-region replication phase (ISSUE 12): codec bytes/row (merge
+    # wire vs proto fallback) + the two-region loopback convergence wall
+    # in sync intervals — the record the robustness doc's bound points at
+    matrix["regions"] = _attempt(
+        "regions",
+        lambda: regions_case(np.random.default_rng(56), now),
     )
 
     # latency phase (sweep vs sparse vs xla device terms per table size);

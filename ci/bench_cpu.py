@@ -1280,6 +1280,228 @@ def layout_smoke() -> dict:
     return out
 
 
+def region_smoke() -> dict:
+    """Multi-region active-active regression gate (docs/robustness.md
+    "Multi-region active-active"; ISSUE 12 acceptance):
+
+    (a) **exact convergence** — a two-region loopback cluster with
+        concurrent hits on K keys in BOTH regions converges every key to
+        the exact union of hits, within a bounded number of sync
+        intervals;
+    (b) **bounded partition over-admission** — with the inter-region link
+        blackholed under live traffic, each region keeps serving locally
+        with zero request errors, total admissions stay ≤ Σ per-region
+        limits, and the over-admission beyond one region's limit stays ≤
+        the sum of unreplicated deltas (the documented bound); after heal
+        both regions reconverge;
+    (c) **compact-wire engagement** — encodable replication traffic rides
+        the SyncRegionsWire merge codec with ZERO proto fallbacks.
+    """
+    import asyncio
+
+    from gubernator_tpu.config import BehaviorConfig
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.types import Behavior
+    from tests.cluster import Cluster, wait_for
+
+    MR = int(Behavior.MULTI_REGION)
+    SYNC_S = 0.025
+    out: dict = {}
+
+    def mr(key, hits, limit=100):
+        return pb.RateLimitReq(
+            name="rs", unique_key=key, hits=hits, limit=limit,
+            duration=600_000, behavior=MR,
+        )
+
+    async def run():
+        beh = BehaviorConfig(
+            batch_wait_ms=1.0,
+            global_sync_wait_ms=SYNC_S * 1e3,
+            batch_timeout_ms=5000.0,
+            global_timeout_ms=300.0,
+            region_requeue_retries=100_000,  # ride out the partition
+            peer_breaker_errors=3,
+            peer_breaker_backoff_base_ms=200.0,
+            peer_breaker_backoff_cap_ms=1_000.0,
+        )
+        c = await Cluster.start(
+            2, dcs=["dc-a", "dc-b"], chaos=True, behaviors=beh
+        )
+        a, b = c.daemons
+        try:
+            # ---- (a) exact per-key convergence of totals
+            rng = np.random.default_rng(7)
+            K = 64
+            ha = rng.integers(1, 30, size=K)
+            hb = rng.integers(1, 30, size=K)
+            ra = await a.get_rate_limits(
+                [mr(f"k{i}", int(ha[i])) for i in range(K)]
+            )
+            rb = await b.get_rate_limits(
+                [mr(f"k{i}", int(hb[i])) for i in range(K)]
+            )
+            if any(r.error for r in ra + rb):
+                print(json.dumps({"error": "region smoke: serve error",
+                                  **out}))
+                sys.exit(1)
+            want = [100 - int(ha[i] + hb[i]) for i in range(K)]
+            t0 = time.perf_counter()
+
+            async def conv():
+                xa = await a.get_rate_limits(
+                    [mr(f"k{i}", 0) for i in range(K)]
+                )
+                xb = await b.get_rate_limits(
+                    [mr(f"k{i}", 0) for i in range(K)]
+                )
+                return all(
+                    xa[i].remaining == xb[i].remaining == want[i]
+                    for i in range(K)
+                )
+
+            try:
+                await wait_for(conv, timeout_s=20)
+            except TimeoutError:
+                print(json.dumps({"error": "region smoke: two-region "
+                                  "totals did not converge to the exact "
+                                  "union", **out}))
+                sys.exit(1)
+            wall = time.perf_counter() - t0
+            out["converged_keys"] = K
+            out["convergence_wall_s"] = round(wall, 3)
+            out["convergence_sync_intervals"] = round(wall / SYNC_S, 1)
+
+            # ---- (c) compact-wire engagement, zero fallbacks
+            out["wire_sent"] = (
+                a.region_manager.wire_sent + b.region_manager.wire_sent
+            )
+            out["wire_fallback"] = (
+                a.region_manager.wire_fallback
+                + b.region_manager.wire_fallback
+            )
+            out["rows_merged"] = (
+                a.region_manager.rows_merged + b.region_manager.rows_merged
+            )
+            if out["wire_sent"] == 0 or out["wire_fallback"] != 0:
+                print(json.dumps({"error": "region smoke: encodable "
+                                  "traffic did not ride the compact merge "
+                                  "codec", **out}))
+                sys.exit(1)
+            # steady-state replication entries (strings + slots only on a
+            # key's FIRST batch) must stay a fixed 32 B/row — smaller than
+            # the classic proto fallback for the same items
+            from gubernator_tpu.proto import peers_pb2 as peers_pb
+            from gubernator_tpu.service.wire import (
+                split_region_encodable, sync_regions_pb,
+            )
+
+            bp = [(f"rs_b{i}", pb.RateLimitReq(
+                name="rs", unique_key=f"tenant-{i:03d}/user-{i:08d}",
+                hits=3, limit=100, duration=600_000, behavior=MR,
+                created_at=a.now_ms(),
+            )) for i in range(256)]
+            e2, f2 = split_region_encodable(bp)
+            steady = sync_regions_pb(
+                e2, "ci", "dc-a",
+                detail_rows=np.zeros(len(e2), dtype=bool),
+            ).ByteSize() / len(e2)
+            proto_b = peers_pb.GetPeerRateLimitsReq(
+                requests=[it for _k, it in bp]
+            ).ByteSize() / len(bp)
+            out["steady_state_bytes_per_row"] = round(steady, 1)
+            out["proto_bytes_per_row"] = round(proto_b, 1)
+            if f2 or steady > 36 or steady >= proto_b:
+                print(json.dumps({"error": "region smoke: steady-state "
+                                  "codec rows are not proportionally "
+                                  "smaller than the proto fallback",
+                                  **out}))
+                sys.exit(1)
+
+            # ---- (b) partition: degraded-local + bounded over-admission
+            LIMIT = 50
+
+            def pk(hits):
+                return pb.RateLimitReq(
+                    name="rs", unique_key="part", hits=hits, limit=LIMIT,
+                    duration=600_000, behavior=MR,
+                )
+
+            for p in c.proxies:
+                p.set_mode("blackhole")
+            t0 = time.monotonic()
+            admitted = errors = 0
+            while time.monotonic() - t0 < 1.0:  # ≥ 40 sync intervals
+                for d in (a, b):
+                    r = (await d.get_rate_limits([pk(1)]))[0]
+                    if r.error:
+                        errors += 1
+                    elif r.status == pb.UNDER_LIMIT:
+                        admitted += 1
+                await asyncio.sleep(0.005)
+            out["partition_admitted"] = admitted
+            out["partition_errors"] = errors
+            if errors:
+                print(json.dumps({"error": "region smoke: request errors "
+                                  "during the partition", **out}))
+                sys.exit(1)
+            if admitted > 2 * LIMIT:
+                print(json.dumps({"error": "region smoke: partition "
+                                  "admissions exceeded Σ per-region "
+                                  "limits", **out}))
+                sys.exit(1)
+            unreplicated = 0
+            for d in (a, b):
+                for pend in d.region_manager._pending.values():
+                    it = pend.get("rs_part")
+                    if it is not None:
+                        unreplicated += it.hits
+            over = max(0, admitted - LIMIT)
+            out["partition_over_admission"] = over
+            out["partition_unreplicated_deltas"] = int(unreplicated)
+            if over > unreplicated:
+                print(json.dumps({"error": "region smoke: over-admission "
+                                  "exceeded the documented Σ-unreplicated-"
+                                  "deltas bound", **out}))
+                sys.exit(1)
+
+            # ---- heal: backlog drains through the merge, reconverge
+            for p in c.proxies:
+                p.heal()
+
+            async def healed():
+                xa = (await a.get_rate_limits([pk(0)]))[0].remaining
+                xb = (await b.get_rate_limits([pk(0)]))[0].remaining
+                return xa == xb == max(0, LIMIT - admitted)
+
+            try:
+                await wait_for(healed, timeout_s=20, interval_s=0.1)
+            except TimeoutError:
+                print(json.dumps({"error": "region smoke: regions did not "
+                                  "reconverge after heal", **out}))
+                sys.exit(1)
+            out["healed"] = True
+
+            async def drained():
+                return max(
+                    a.region_manager.oldest_delta_age_s(),
+                    b.region_manager.oldest_delta_age_s(),
+                ) == 0.0
+
+            try:
+                await wait_for(drained, timeout_s=10, interval_s=0.1)
+            except TimeoutError:
+                print(json.dumps({"error": "region smoke: staleness did "
+                                  "not drain to 0 after heal", **out}))
+                sys.exit(1)
+            out["staleness_drained"] = True
+        finally:
+            await c.stop()
+
+    asyncio.run(run())
+    return out
+
+
 def main() -> None:
     eng = LocalEngine(capacity=1 << 15, write_mode="xla")
     rng = np.random.default_rng(0)
@@ -1307,6 +1529,7 @@ def main() -> None:
         "durability_smoke": durability_smoke(),
         "algo_smoke": algo_smoke(),
         "layout_smoke": layout_smoke(),
+        "region_smoke": region_smoke(),
     }))
 
 

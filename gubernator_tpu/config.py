@@ -177,6 +177,30 @@ class BehaviorConfig:
     # it, failed batches drop (bounds memory during long partitions)
     global_queue_cap: int = 10_000
 
+    # --- multi-region replication (docs/robustness.md "Multi-region
+    # active-active") ---------------------------------------------------
+    # cross-region sync cadence; 0 inherits global_sync_wait_ms
+    region_sync_wait_ms: float = 0.0
+    # per-RPC deadline for region replication sends; 0 derives
+    # max(global_timeout_ms, 2000) — deliberately GENEROUS: the plane is
+    # asynchronous (nothing user-facing waits on it), and a deadline that
+    # cancels a receiver mid-apply turns a slow round into a duplicate
+    # delivery on retry (under-granting, but needless)
+    region_timeout_ms: float = 0.0
+    # failed cross-region delta batches re-merge into the pending queue
+    # this many times before dropping (the over-admission bound after a
+    # partition longer than retries × sync_wait grows by the dropped
+    # deltas — size this to the longest partition you want to ride out)
+    region_requeue_retries: int = 3
+    # pending-delta keys PER DESTINATION REGION the requeue path may grow
+    # to; beyond it, failed batches drop (bounds memory during partitions)
+    region_queue_cap: int = 10_000
+    # encodable delta batches ride the compact SyncRegionsWire codec and
+    # reconcile through the conservative merge kernel; off forces the
+    # classic GetPeerRateLimits proto path everywhere (legacy DRAIN
+    # semantics — the parity oracle and the pre-upgrade behavior)
+    region_wire_sync: bool = True
+
     # --- topology-change handoff (docs/robustness.md "Topology change &
     # drain") -----------------------------------------------------------
     # move owned live rows to their new ring owners on set_peers rebalance
@@ -482,6 +506,20 @@ class DaemonConfig:
             raise ConfigError("GUBER_GLOBAL_REQUEUE_RETRIES must be >= 0")
         if self.behaviors.global_queue_cap <= 0:
             raise ConfigError("GUBER_GLOBAL_QUEUE_CAP must be positive")
+        if self.behaviors.region_sync_wait_ms < 0:
+            raise ConfigError(
+                "GUBER_REGION_SYNC_WAIT must be >= 0 (0 = inherit "
+                "GUBER_GLOBAL_SYNC_WAIT)"
+            )
+        if self.behaviors.region_timeout_ms < 0:
+            raise ConfigError(
+                "GUBER_REGION_TIMEOUT must be >= 0 (0 = derived from "
+                "GUBER_GLOBAL_TIMEOUT)"
+            )
+        if self.behaviors.region_requeue_retries < 0:
+            raise ConfigError("GUBER_REGION_REQUEUE_RETRIES must be >= 0")
+        if self.behaviors.region_queue_cap <= 0:
+            raise ConfigError("GUBER_REGION_QUEUE_CAP must be positive")
         if self.behaviors.handoff_deadline_ms <= 0:
             raise ConfigError("GUBER_HANDOFF_DEADLINE must be positive")
         if self.behaviors.handoff_chunk_rows <= 0:
@@ -578,6 +616,15 @@ def setup_daemon_config(
                 env, "GUBER_GLOBAL_REQUEUE_RETRIES", 3
             ),
             global_queue_cap=_get_int(env, "GUBER_GLOBAL_QUEUE_CAP", 10_000),
+            region_sync_wait_ms=_get_float_ms(
+                env, "GUBER_REGION_SYNC_WAIT", 0.0
+            ),
+            region_timeout_ms=_get_float_ms(env, "GUBER_REGION_TIMEOUT", 0.0),
+            region_requeue_retries=_get_int(
+                env, "GUBER_REGION_REQUEUE_RETRIES", 3
+            ),
+            region_queue_cap=_get_int(env, "GUBER_REGION_QUEUE_CAP", 10_000),
+            region_wire_sync=_get_bool(env, "GUBER_REGION_WIRE_SYNC", True),
             handoff_enabled=_get_bool(env, "GUBER_HANDOFF_ENABLED", True),
             handoff_deadline_ms=_get_float_ms(
                 env, "GUBER_HANDOFF_DEADLINE", 5_000.0

@@ -1239,20 +1239,25 @@ class LocalEngine:
         self.stats.dispatches += 1
         return int(np.asarray(merged).sum())
 
-    def read_state(self, fps: np.ndarray):
+    def read_state(self, fps: np.ndarray, raw: bool = False):
         """Read the full-width stored slots for `fps` without mutating
         anything: (found (n,) bool, slots (n, 16) i32 canonical fields).
         One device bucket gather — the GLOBAL broadcast plane uses this to
         attach sliding-window aux (prev count, stored remaining) to owner
-        updates (service/global_manager._broadcast)."""
+        updates (service/global_manager._broadcast). `raw=True` returns
+        the rows re-packed into THIS table's own slot layout ((n,
+        layout.F) — exact for in-family rows, ops/layout.py) so the
+        region-sync sender ships its stored rows at the table's native
+        width and the receiver converts through the canonical full row."""
         import jax.numpy as jnp
 
         from gubernator_tpu.ops.table2 import F as F_FULL, gather_slots
 
         n = fps.shape[0]
         if n == 0:
+            width = self.table.layout.F if raw else F_FULL
             return (
-                np.zeros(0, dtype=bool), np.zeros((0, F_FULL), dtype=np.int32)
+                np.zeros(0, dtype=bool), np.zeros((0, width), dtype=np.int32)
             )
         size = _pad_size(n)
         fp_p = np.zeros(size, dtype=np.int64)
@@ -1263,7 +1268,10 @@ class LocalEngine:
             self.table.rows, jnp.asarray(fp_p), jnp.asarray(active),
             layout=self.table.layout,
         )
-        return np.asarray(found)[:n].copy(), np.asarray(slots)[:n].copy()
+        out = np.asarray(slots)[:n].copy()
+        if raw:
+            out = np.asarray(self.table.layout.pack(out))
+        return np.asarray(found)[:n].copy(), out
 
     def tombstone_fps(self, fps: np.ndarray) -> int:
         """Zero the slots holding `fps` (post-ack handoff cleanup). Missing

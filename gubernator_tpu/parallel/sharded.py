@@ -785,15 +785,18 @@ class ShardedEngine:
         self.stats.dispatches += 1
         return int(np.asarray(merged).sum())
 
-    def read_state(self, fps: np.ndarray):
+    def read_state(self, fps: np.ndarray, raw: bool = False):
         """(found, full-width slots) for `fps` — the ShardedEngine analog
-        of LocalEngine.read_state (routed shard_map gather, no mutation)."""
+        of LocalEngine.read_state (routed shard_map gather, no mutation).
+        `raw=True` re-packs the gathered rows into the table's own slot
+        layout (the region-sync staging form, cf. LocalEngine)."""
         from gubernator_tpu.ops.table2 import F as F_FULL
 
         n = fps.shape[0]
         if n == 0:
+            width = self.table.layout.F if raw else F_FULL
             return (
-                np.zeros(0, dtype=bool), np.zeros((0, F_FULL), dtype=np.int32)
+                np.zeros(0, dtype=bool), np.zeros((0, width), dtype=np.int32)
             )
         D = self.n_shards
         routed = shard_of(fps, D)
@@ -816,6 +819,8 @@ class ShardedEngine:
         found = np.zeros(n, dtype=bool)
         slots[order] = slots_h[rs, offset]
         found[order] = found_h[rs, offset]
+        if raw:
+            slots = np.asarray(self.table.layout.pack(slots))
         return found, slots
 
     def tombstone_fps(self, fps: np.ndarray) -> int:

@@ -343,6 +343,9 @@ class Daemon:
         # /metrics scrapes for free
         self.metrics.cache_size.set(snap.live_keys)
         self.metrics.global_sync_staleness.set(self.global_sync_staleness_s())
+        self.metrics.region_sync_staleness.set(
+            self.region_manager.oldest_delta_age_s()
+        )
         return snap
 
     def global_sync_staleness_s(self) -> float:
@@ -488,6 +491,24 @@ class Daemon:
             duration=np.ones(1, dtype=np.int64),
             now_ms=1,
         )
+        if self.conf.data_center:
+            # region plane (docs/robustness.md "Multi-region active-
+            # active"): pre-trace the stored-state read (the sender's
+            # staging gather) and the conservative merge (the receiver's
+            # reconcile) so the first replicated batch doesn't pay an XLA
+            # compile inside a peer's RPC deadline — a timed-out first
+            # sync would requeue and re-apply as a duplicate (under-
+            # granting, but needlessly). DC-less daemons never replicate,
+            # so they skip the two compiles.
+            from gubernator_tpu.ops.table2 import F as F_FULL
+
+            fp1 = np.asarray([1], dtype=np.int64)
+            await self.runner.read_state_raw(fp1)
+            # an all-zero incoming row is expired at every clock: the
+            # merge kernel compiles, the table keeps its bytes
+            await self.runner.merge_rows(
+                fp1, np.zeros((1, F_FULL), dtype=np.int32)
+            )
         # GUBER_WARM_SHAPES=pow2[-mixed]: additionally compile every pow2
         # coalesce geometry up to the coalesce cap (like bench.py's e2e
         # prewarm) so no production batch shape ever compiles on the
@@ -1391,6 +1412,53 @@ class Daemon:
         await self._get_peer_rate_limits(items)
         return globalsync_pb.SyncGlobalsWireResp(applied=len(items))
 
+    async def sync_regions_wire(self, req):
+        """Receive one compact cross-region delta batch
+        (service/wire.sync_regions_pb): decode the lane image + hit-delta
+        sidecar + the sender's stored rows, and reconcile through the
+        conservative merge kernel (ops/reconcile.apply_region_sync → ONE
+        engine job → kernel2.merge2) — never the serving path, so a
+        replicated batch cannot queue broadcasts or re-replicate
+        (ping-pong is structurally impossible). The sender's rows arrive
+        in ITS slot layout and convert through the canonical full row
+        (the PR-11 conversion point), so a packed-layout sender cannot
+        corrupt or over-grant a differently-laid-out receiver.
+
+        The body runs SHIELDED: once the merge job is committed to the
+        engine thread it will land whether or not the sender's RPC
+        deadline survives, so the apply and its accounting (note_recv,
+        ownership sidecar) can never be split by a client-side cancel —
+        the sender's retry then re-applies a FULLY accounted batch, which
+        the merge turns into under-grant, never a half-recorded one."""
+        task = asyncio.ensure_future(self._sync_regions_wire(req))
+        return await asyncio.shield(task)
+
+    async def _sync_regions_wire(self, req):
+        from gubernator_tpu.proto import regionsync_pb2 as regionsync_pb
+        from gubernator_tpu.service.wire import sync_regions_arrays
+
+        fps, deltas, cfg, hash_keys, slots, layout = sync_regions_arrays(req)
+        applied = await self.runner.apply_region(
+            fps, deltas, cfg, slots, layout
+        )
+        if (
+            self._local_picker.size() > 0
+            and self.conf.behaviors.handoff_enabled
+        ):
+            # merged rows live on this daemon now: record their ring points
+            # so a later rebalance can route them onward (handoff sidecar).
+            # Steady-state rows travel string-less ("" marker) — their
+            # points were recorded by the key's bootstrap batch.
+            idx = [i for i, k in enumerate(hash_keys) if k]
+            if idx:
+                self.ownership.record_keys(
+                    (fps[i] for i in idx),
+                    (hash_keys[i] for i in idx),
+                    self._local_picker.hash_fn,
+                )
+        self.region_manager.note_recv(len(hash_keys), applied)
+        return regionsync_pb.SyncRegionsWireResp(applied=applied)
+
     async def transfer_state(
         self, req: "handoff_pb.TransferStateReq"
     ) -> "handoff_pb.TransferStateResp":
@@ -1505,6 +1573,15 @@ class Daemon:
         out["loader"] = type(loader).__name__ if loader is not None else None
         return out
 
+    def debug_regions(self) -> dict:
+        """Multi-region replication plane: per-region breaker states, queue
+        depths, last-sync ages, wire-vs-fallback counts — what an operator
+        checks when a partition is suspected or after a heal (is the
+        backlog draining?)."""
+        out = self.region_manager.debug()
+        self.metrics.region_sync_staleness.set(out["staleness_s"])
+        return out
+
     def debug_global(self) -> dict:
         """GLOBAL behavior: cross-daemon queue ages + mesh outbox depth —
         the convergence-lag view behind the staleness gauge."""
@@ -1566,6 +1643,7 @@ class Daemon:
             message="; ".join((fatal + errs)[:5]),
             peer_count=self._local_picker.size() + self._region_picker.size(),
             advertise_address=self.conf.advertise_address,
+            region=self.conf.data_center,
         )
 
         def peer_entry(p: PeerInfo) -> "pb.PeerHealthResp":

@@ -280,6 +280,40 @@ class DaemonMetrics:
             "or hitting the queue cap",
             registry=r,
         )
+        # --- multi-region replication (service/region_manager.py;
+        # docs/robustness.md "Multi-region active-active")
+        self.region_queue_length = Gauge(
+            "gubernator_region_queue_length",
+            "Pending cross-region hit deltas awaiting the region sync tick "
+            "(summed over destination regions)",
+            registry=r,
+        )
+        self.region_requeued = Counter(
+            "gubernator_region_requeue_count",
+            "Cross-region delta batches re-merged into the pending queue "
+            "after a failed send (instead of dropped)",
+            registry=r,
+        )
+        self.region_requeue_dropped = Counter(
+            "gubernator_region_requeue_dropped_count",
+            "Cross-region pending deltas dropped after exhausting requeue "
+            "retries or hitting the queue cap",
+            registry=r,
+        )
+        self.region_wire_entries = Counter(
+            "gubernator_region_wire_entries_total",
+            "Cross-region replication entries by path: sent/recv ride the "
+            "compact SyncRegionsWire merge codec, fallback the classic "
+            "GetPeerRateLimits proto path",
+            ["direction"],  # sent | recv | fallback
+            registry=r,
+        )
+        self.region_rows_merged = Counter(
+            "gubernator_region_rows_merged_total",
+            "Replicated rows applied through the conservative merge kernel "
+            "(kernel2.merge2) on the region receive path",
+            registry=r,
+        )
         # --- topology-change handoff (service/handoff.py; docs/robustness.md
         # "Topology change & drain") — the rolling-restart chaos test asserts
         # row-count parity between phases across daemons, so phase labels are
@@ -497,6 +531,16 @@ class DaemonMetrics:
             "gubernator_global_sync_staleness_seconds",
             "Age in seconds of the oldest GLOBAL hit not yet synced to its "
             "owner (cross-daemon queue and mesh outbox)",
+            registry=r,
+        )
+        # region-plane convergence lag, built the same way: age of the
+        # oldest hit delta not yet acked by every remote region's owner —
+        # survives requeues; a partitioned region's gauge grows for exactly
+        # as long as the partition, then drains to 0 on heal
+        self.region_sync_staleness = Gauge(
+            "gubernator_region_sync_staleness_seconds",
+            "Age in seconds of the oldest cross-region hit delta not yet "
+            "replicated to every remote region",
             registry=r,
         )
         # OTLP exporter health (satellite: export failures were attributes

@@ -432,6 +432,38 @@ class EngineRunner:
             self._exec, lambda: self.engine.read_state(fps)
         )
 
+    async def read_state_raw(self, fps: np.ndarray):
+        """(found, slots, layout) stored-state read in the table's OWN slot
+        layout — the region-sync sender's staging read. The layout is
+        captured inside the same engine-thread job as the gather, so a
+        concurrent layout migration can never mis-tag the rows."""
+        loop = asyncio.get_running_loop()
+
+        def run():
+            found, slots = self.engine.read_state(fps, raw=True)
+            return found, slots, self.engine.table.layout
+
+        return await loop.run_in_executor(self._exec, run)
+
+    async def apply_region(
+        self, fps: np.ndarray, deltas: np.ndarray, cfg: dict,
+        sender_slots, sender_layout,
+    ) -> int:
+        """Apply one received cross-region delta batch through the
+        conservative merge (ops/reconcile.apply_region_sync). ONE engine
+        job, so the read→reconcile→merge triplet is atomic with respect to
+        serving dispatches — no concurrent hit slips between the stored-
+        state read and the merge."""
+        from gubernator_tpu.ops.reconcile import apply_region_sync
+
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._exec,
+            lambda: apply_region_sync(
+                self.engine, fps, deltas, cfg, sender_slots, sender_layout
+            ),
+        )
+
     async def maybe_grow(self, **kw) -> bool:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(

@@ -21,6 +21,7 @@ from gubernator_tpu.proto import globalsync_pb2 as globalsync_pb
 from gubernator_tpu.proto import gubernator_pb2 as pb
 from gubernator_tpu.proto import handoff_pb2 as handoff_pb
 from gubernator_tpu.proto import peers_pb2 as peers_pb
+from gubernator_tpu.proto import regionsync_pb2 as regionsync_pb
 
 V1 = "pb.gubernator.V1"
 PEERS_V1 = "pb.gubernator.PeersV1"
@@ -107,6 +108,15 @@ def build_grpc_services(daemon):
         except ValueError as exc:  # malformed lane/string buffers
             await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
 
+    @_timed(m, "/peers.SyncRegionsWire")
+    async def sync_regions_wire(
+        request: "regionsync_pb.SyncRegionsWireReq", context
+    ):
+        try:
+            return await daemon.sync_regions_wire(request)
+        except ValueError as exc:  # malformed lane/slot/string buffers
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
+
     def unary(fn, req_cls, resp_cls):
         return grpc.unary_unary_rpc_method_handler(
             fn,
@@ -150,6 +160,11 @@ def build_grpc_services(daemon):
                 sync_globals_wire,
                 globalsync_pb.SyncGlobalsWireReq,
                 globalsync_pb.SyncGlobalsWireResp,
+            ),
+            "SyncRegionsWire": unary(
+                sync_regions_wire,
+                regionsync_pb.SyncRegionsWireReq,
+                regionsync_pb.SyncRegionsWireResp,
             ),
         },
     )
@@ -200,6 +215,9 @@ def build_http_app(daemon, status_only: bool = False) -> web.Application:
         daemon.metrics.global_sync_staleness.set(
             daemon.global_sync_staleness_s()
         )
+        daemon.metrics.region_sync_staleness.set(
+            daemon.region_manager.oldest_delta_age_s()
+        )
         # content negotiation: scrapers that Accept the OpenMetrics format
         # get it (WITH the trace exemplars on latency buckets); everyone
         # else keeps the classic text exposition
@@ -231,6 +249,8 @@ def build_http_app(daemon, status_only: bool = False) -> web.Application:
                 return web.json_response(daemon.debug_peers())
             if kind == "global":
                 return web.json_response(daemon.debug_global())
+            if kind == "regions":
+                return web.json_response(daemon.debug_regions())
             if kind == "durability":
                 return web.json_response(daemon.debug_durability())
         except Exception as exc:  # pragma: no cover - defensive
@@ -240,7 +260,7 @@ def build_http_app(daemon, status_only: bool = False) -> web.Application:
             )
         return web.json_response(
             {"code": 5, "message": f"unknown debug plane {kind!r}; one of: "
-             "table, pipeline, peers, global, durability"},
+             "table, pipeline, peers, global, regions, durability"},
             status=404,
         )
 

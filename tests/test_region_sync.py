@@ -1,0 +1,589 @@
+"""Multi-region active-active replication (docs/robustness.md).
+
+The region plane rebuilt on the conservative-merge kernel: per-key hit
+deltas ride the compact SyncRegionsWire codec to each remote region's owner,
+which reconciles through kernel2.merge2 (ops/reconcile.py) — never the
+serving path. These tests pin the three contracts:
+
+* exactness — with every delta delivered once, each region's per-key state
+  converges to the exact union of all regions' hits;
+* conservatism — duplicated delivery (requeue at-least-once), crossed
+  layouts, and stale sender rows can only UNDER-grant, never over;
+* partition tolerance — a blackholed inter-region link opens the breaker,
+  the partitioned region keeps serving locally with zero request errors,
+  the staleness gauge grows monotonically, and after heal the requeued
+  backlog drains through the merge until both regions reconverge.
+"""
+
+import asyncio
+import functools
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from gubernator_tpu.config import BehaviorConfig
+from gubernator_tpu.ops.batch import RequestColumns
+from gubernator_tpu.ops.engine import LocalEngine, ms_now
+from gubernator_tpu.ops.reconcile import apply_region_sync
+from gubernator_tpu.proto import gubernator_pb2 as pb
+from gubernator_tpu.service.peer_client import PeerError
+from gubernator_tpu.types import Behavior, PeerInfo
+
+from tests.cluster import Cluster, metric_value, scrape, wait_for
+
+NOW = ms_now()
+MINUTE = 60_000
+
+
+def async_test(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **k):
+        asyncio.run(fn(*a, **k))
+
+    return wrapper
+
+
+def _cols(fps, hits, limit=100, dur=MINUTE, algo=0, now=NOW):
+    n = len(fps)
+    return RequestColumns(
+        fp=np.asarray(fps, dtype=np.int64),
+        algo=np.full(n, algo, dtype=np.int32),
+        behavior=np.zeros(n, dtype=np.int32),
+        hits=np.full(n, hits, dtype=np.int64),
+        limit=np.full(n, limit, dtype=np.int64),
+        burst=np.zeros(n, dtype=np.int64),
+        duration=np.full(n, dur, dtype=np.int64),
+        created_at=np.full(n, now, dtype=np.int64),
+        err=np.zeros(n, dtype=np.int8),
+    )
+
+
+def _cfg(algo=0, limit=100, dur=MINUTE, now=NOW, n=1):
+    return {
+        "limit": np.full(n, limit, dtype=np.int64),
+        "duration": np.full(n, dur, dtype=np.int64),
+        "algo": np.full(n, algo, dtype=np.int64),
+        "created_at": np.full(n, now, dtype=np.int64),
+    }
+
+
+def _ship(src: LocalEngine, dst: LocalEngine, fp: int, delta: int,
+          algo=0, now=NOW):
+    """One region→region delta hop through the real staging read + merge."""
+    fps = np.array([fp], dtype=np.int64)
+    _found, slots = src.read_state(fps, raw=True)
+    return apply_region_sync(
+        dst, fps, np.array([delta], dtype=np.int64), _cfg(algo, now=now),
+        slots, src.table.layout, now_ms=now,
+    )
+
+
+# --------------------------------------------------------------- unit layer
+
+
+def test_reconcile_exact_union_token():
+    """Concurrent hits in two regions converge to the exact union after one
+    delta exchange each way — the op-based-CRDT exactness contract."""
+    A, B = LocalEngine(capacity=4096), LocalEngine(capacity=4096)
+    assert A.check_columns(_cols([42], 3), now_ms=NOW).remaining[0] == 97
+    assert B.check_columns(_cols([42], 4), now_ms=NOW).remaining[0] == 96
+    assert _ship(A, B, 42, 3, now=NOW + 1) == 1
+    assert _ship(B, A, 42, 4, now=NOW + 1) == 1
+    ra = A.check_columns(_cols([42], 0), now_ms=NOW + 2).remaining[0]
+    rb = B.check_columns(_cols([42], 0), now_ms=NOW + 2).remaining[0]
+    assert ra == rb == 93  # 100 - (3 + 4)
+
+
+def test_reconcile_duplicate_delivery_under_grants_only():
+    """At-least-once delivery (a requeue after a lost ack) re-applies the
+    delta — the merge turns that into UNDER-grant, never over."""
+    A, B = LocalEngine(capacity=4096), LocalEngine(capacity=4096)
+    A.check_columns(_cols([7], 5), now_ms=NOW)
+    B.check_columns(_cols([7], 2), now_ms=NOW)
+    _ship(A, B, 7, 5, now=NOW + 1)
+    exact = B.check_columns(_cols([7], 0), now_ms=NOW + 2).remaining[0]
+    assert exact == 93
+    _ship(A, B, 7, 5, now=NOW + 3)  # duplicate
+    dup = B.check_columns(_cols([7], 0), now_ms=NOW + 4).remaining[0]
+    assert dup <= exact  # tightened, never loosened
+
+
+def test_reconcile_gcra_matches_union_oracle():
+    """GCRA deltas advance the receiver's stored TAT by delta·T — the
+    merged state answers exactly like one engine that saw the union."""
+    A, B = LocalEngine(capacity=4096), LocalEngine(capacity=4096)
+    O = LocalEngine(capacity=4096)
+    A.check_columns(_cols([9], 10, algo=2), now_ms=NOW)
+    B.check_columns(_cols([9], 5, algo=2), now_ms=NOW)
+    O.check_columns(_cols([9], 15, algo=2), now_ms=NOW)
+    _ship(A, B, 9, 10, algo=2, now=NOW + 1)
+    rb = B.check_columns(_cols([9], 0, algo=2), now_ms=NOW + 2).remaining[0]
+    ro = O.check_columns(_cols([9], 0, algo=2), now_ms=NOW + 2).remaining[0]
+    assert rb == ro
+
+
+def test_reconcile_over_limit_clamps_and_over_sticks():
+    """A delta beyond the bucket clamps remaining at 0 and sets OVER, which
+    the merge keeps sticky."""
+    B = LocalEngine(capacity=4096)
+    B.check_columns(_cols([11], 1), now_ms=NOW)
+    fps = np.array([11], dtype=np.int64)
+    apply_region_sync(
+        B, fps, np.array([500], dtype=np.int64), _cfg(), None, None,
+        now_ms=NOW + 1,
+    )
+    rc = B.check_columns(_cols([11], 0), now_ms=NOW + 2)
+    assert rc.remaining[0] == 0
+    assert rc.status[0] == 1  # OVER_LIMIT
+
+
+def test_reconcile_absent_key_bootstraps_from_sender_row():
+    """A receiver that never saw the key adopts the sender's stored row
+    (which already embodies the delta plus any older history)."""
+    A, C = LocalEngine(capacity=4096), LocalEngine(capacity=4096)
+    A.check_columns(_cols([13], 9), now_ms=NOW)
+    _ship(A, C, 13, 9, now=NOW + 1)
+    assert C.check_columns(
+        _cols([13], 0), now_ms=NOW + 2
+    ).remaining[0] == 91
+
+
+def test_reconcile_cross_layout_sender_converts_through_full():
+    """Packed (token32/gcra32) senders ship rows at their native width; the
+    receiver converts through the canonical full row before merge2 — a
+    mixed-layout fleet can neither corrupt nor over-grant (PR-11 single
+    conversion point, satellite bugfix)."""
+    for lay, algo in (("token32", 0), ("gcra32", 2)):
+        P = LocalEngine(capacity=4096, layout=lay)
+        Q = LocalEngine(capacity=4096)  # full receiver
+        O = LocalEngine(capacity=4096)
+        P.check_columns(_cols([77], 9, algo=algo), now_ms=NOW)
+        Q.check_columns(_cols([77], 4, algo=algo), now_ms=NOW)
+        O.check_columns(_cols([77], 13, algo=algo), now_ms=NOW)
+        assert P.table.layout.F == 8  # really shipped packed
+        _ship(P, Q, 77, 9, algo=algo, now=NOW + 1)
+        rq = Q.check_columns(
+            _cols([77], 0, algo=algo), now_ms=NOW + 2
+        ).remaining[0]
+        ro = O.check_columns(
+            _cols([77], 0, algo=algo), now_ms=NOW + 2
+        ).remaining[0]
+        assert rq == ro, f"{lay}: {rq} != oracle {ro}"
+        # and the reverse hop: full sender → packed receiver
+        _ship(Q, P, 77, 4, algo=algo, now=NOW + 3)
+        rp = P.check_columns(
+            _cols([77], 0, algo=algo), now_ms=NOW + 4
+        ).remaining[0]
+        assert rp == ro, f"{lay} reverse: {rp} != oracle {ro}"
+
+
+def test_region_codec_split_and_roundtrip():
+    """Per-item encodability split: plain deltas ride the compact codec,
+    resets / Gregorian / lease releases / metadata carriers spill to the
+    proto fallback — and the lane image decodes back exactly."""
+    from gubernator_tpu.service.wire import (
+        split_region_encodable, sync_regions_arrays, sync_regions_pb,
+    )
+
+    ok = pb.RateLimitReq(
+        name="mr", unique_key="k1", hits=5, limit=100, duration=MINUTE,
+        behavior=int(Behavior.MULTI_REGION), created_at=NOW,
+    )
+    reset = pb.RateLimitReq(
+        name="mr", unique_key="k2", hits=1, limit=100, duration=MINUTE,
+        behavior=int(Behavior.MULTI_REGION | Behavior.RESET_REMAINING),
+        created_at=NOW,
+    )
+    greg = pb.RateLimitReq(
+        name="mr", unique_key="k3", hits=1, limit=100, duration=1,
+        behavior=int(
+            Behavior.MULTI_REGION | Behavior.DURATION_IS_GREGORIAN
+        ),
+        created_at=NOW,
+    )
+    release = pb.RateLimitReq(
+        name="mr", unique_key="k4", hits=-2, limit=100, duration=MINUTE,
+        algorithm=4, behavior=int(Behavior.MULTI_REGION), created_at=NOW,
+    )
+    skewed = pb.RateLimitReq(
+        name="mr", unique_key="k5", hits=1, limit=100, duration=MINUTE,
+        behavior=int(Behavior.MULTI_REGION), created_at=NOW + 10_000,
+    )
+    pairs = [
+        ("mr_k1", ok), ("mr_k2", reset), ("mr_k3", greg),
+        ("mr_k4", release), ("mr_k5", skewed),
+    ]
+    enc, fb = split_region_encodable(pairs)
+    assert [k for k, _ in enc] == ["mr_k1"]
+    assert [k for k, _ in fb] == ["mr_k2", "mr_k3", "mr_k4", "mr_k5"]
+    req = sync_regions_pb(enc, "127.0.0.1:1", "dc-a")
+    fps, deltas, cfg, hks, slots, lay = sync_regions_arrays(req)
+    from gubernator_tpu.hashing import fingerprint
+
+    assert fps[0] == fingerprint("mr", "k1")
+    assert deltas[0] == 5 and hks == ["mr_k1"] and slots is None
+    assert int(cfg["limit"][0]) == 100
+    assert int(cfg["duration"][0]) == MINUTE
+    assert int(cfg["created_at"][0]) == NOW
+
+
+# ---------------------------------------------------------------- e2e layer
+
+
+def _beh(**kw):
+    base = dict(
+        batch_wait_ms=1.0,
+        global_sync_wait_ms=50.0,
+        batch_timeout_ms=5000.0,
+        global_timeout_ms=5000.0,
+    )
+    base.update(kw)
+    return BehaviorConfig(**base)
+
+
+def _mr(key, hits, limit=100, name="mr", behavior=int(Behavior.MULTI_REGION)):
+    return pb.RateLimitReq(
+        name=name, unique_key=key, hits=hits, limit=limit,
+        duration=MINUTE, behavior=behavior,
+    )
+
+
+@async_test
+async def test_two_region_convergence_via_merge_wire():
+    """Two-region active-active: concurrent hits in both regions converge
+    to the exact union through the compact merge codec (zero proto
+    fallbacks), and never ping-pong back."""
+    c = await Cluster.start(2, dcs=["dc-a", "dc-b"])
+    a, b = c.daemons
+    try:
+        out = await a.get_rate_limits([_mr("k1", 3)])
+        assert out[0].error == "" and out[0].remaining == 97
+        out = await b.get_rate_limits([_mr("k1", 4)])
+        assert out[0].error == "" and out[0].remaining == 96
+
+        async def converged():
+            ra = (await a.get_rate_limits([_mr("k1", 0)]))[0].remaining
+            rb = (await b.get_rate_limits([_mr("k1", 0)]))[0].remaining
+            return ra == rb == 93
+
+        await wait_for(converged, timeout_s=10)
+        # compact-wire engagement, no fallbacks, merge receive accounting
+        assert a.region_manager.wire_sent >= 1
+        assert b.region_manager.wire_sent >= 1
+        assert a.region_manager.wire_fallback == 0
+        assert b.region_manager.wire_fallback == 0
+        assert a.region_manager.rows_merged >= 1
+        assert b.region_manager.rows_merged >= 1
+        # no ping-pong: two extra sync intervals change nothing
+        await asyncio.sleep(0.2)
+        assert (await a.get_rate_limits([_mr("k1", 0)]))[0].remaining == 93
+        assert (await b.get_rate_limits([_mr("k1", 0)]))[0].remaining == 93
+        # staleness drained
+        assert a.region_manager.oldest_delta_age_s() == 0.0
+    finally:
+        await c.stop()
+
+
+@async_test
+async def test_non_encodable_items_ride_proto_fallback():
+    """RESET_REMAINING cannot travel through a min-merge; it rides the
+    classic proto path (legacy DRAIN semantics) and still lands."""
+    c = await Cluster.start(2, dcs=["dc-a", "dc-b"])
+    a, b = c.daemons
+    try:
+        await a.get_rate_limits([_mr("kr", 30)])
+
+        async def replicated():
+            r = (await b.get_rate_limits([_mr("kr", 0)]))[0]
+            return r.remaining == 70
+
+        await wait_for(replicated, timeout_s=10)
+        out = await a.get_rate_limits([_mr(
+            "kr", 1,
+            behavior=int(Behavior.MULTI_REGION | Behavior.RESET_REMAINING),
+        )])
+        assert out[0].error == ""
+        want = (await a.get_rate_limits([_mr("kr", 0)]))[0].remaining
+        assert want > 70  # the reset raised A's bucket
+
+        async def reset_landed():
+            r = (await b.get_rate_limits([_mr("kr", 0)]))[0]
+            return r.remaining == want
+        await wait_for(reset_landed, timeout_s=10)
+        assert a.region_manager.wire_fallback >= 1
+    finally:
+        await c.stop()
+
+
+@async_test
+async def test_pre_upgrade_peer_latches_proto_fallback():
+    """An UNIMPLEMENTED answer (pre-region-merge peer) latches the compact
+    path off for that peer; the batch re-ships as proto in the same round
+    and the regions still converge."""
+    c = await Cluster.start(2, dcs=["dc-a", "dc-b"])
+    a, b = c.daemons
+    try:
+        binfo = next(iter(a._peer_clients))
+        client = a._peer_clients[binfo]
+
+        class FakeUnimplemented(Exception):
+            def code(self):
+                return grpc.StatusCode.UNIMPLEMENTED
+
+        async def refuse(req, timeout=None):
+            raise PeerError(binfo, FakeUnimplemented())
+
+        client.sync_regions_wire = refuse
+        await a.get_rate_limits([_mr("ku", 5)])
+
+        async def replicated():
+            r = (await b.get_rate_limits([_mr("ku", 0)]))[0]
+            return r.remaining == 95
+
+        await wait_for(replicated, timeout_s=10)
+        assert client.region_wire_ok is False
+        assert a.region_manager.wire_fallback >= 1
+        assert a.region_manager.wire_sent == 0
+    finally:
+        await c.stop()
+
+
+@async_test
+async def test_cascade_levels_span_regions():
+    """A MULTI_REGION cascade carrier replicates its own delta AND one per
+    level, each under the level's own key — every level's count converges
+    across regions (the GLOBAL-behavior cascade extended to regions)."""
+    c = await Cluster.start(2, dcs=["dc-a", "dc-b"])
+    a, b = c.daemons
+    try:
+        req = _mr("user1", 2, name="percall")
+        req.cascade.append(pb.CascadeLevel(
+            name="tenant", unique_key="t1", limit=1000, duration=MINUTE,
+        ))
+        out = await a.get_rate_limits([req])
+        assert out[0].error == ""
+        assert len(out[0].cascade) == 1
+
+        async def both_converged():
+            r1 = (await b.get_rate_limits(
+                [_mr("user1", 0, name="percall")]
+            ))[0]
+            r2 = (await b.get_rate_limits([pb.RateLimitReq(
+                name="tenant", unique_key="t1", hits=0, limit=1000,
+                duration=MINUTE,
+            )]))[0]
+            return r1.remaining == 98 and r2.remaining == 998
+
+        await wait_for(both_converged, timeout_s=10)
+        assert a.region_manager.wire_fallback == 0
+    finally:
+        await c.stop()
+
+
+@async_test
+async def test_debug_regions_endpoint_and_health_region():
+    """/v1/debug/regions schema + the region label in HealthCheckResp."""
+    import aiohttp
+
+    c = await Cluster.start(2, dcs=["dc-a", "dc-b"])
+    a, b = c.daemons
+    try:
+        h = await a.health_check()
+        assert h.region == "dc-a"
+        assert (await b.health_check()).region == "dc-b"
+        await a.get_rate_limits([_mr("kd", 1)])
+        url = f"http://{a.conf.http_address}/v1/debug/regions"
+        async with aiohttp.ClientSession() as s:
+            async with s.get(url) as resp:
+                assert resp.status == 200
+                snap = await resp.json()
+        assert snap["region"] == "dc-a"
+        assert "dc-b" in snap["regions"]
+        dcb = snap["regions"]["dc-b"]
+        for field in (
+            "queue_depth", "oldest_delta_age_s", "last_sync_age_s",
+            "requeue_attempts", "peers",
+        ):
+            assert field in dcb
+        assert dcb["peers"][0]["breaker_state"] == "closed"
+        assert {"sent", "recv", "fallback", "rows_merged"} <= set(
+            snap["wire"]
+        )
+    finally:
+        await c.stop()
+
+
+@pytest.mark.slow
+@async_test
+async def test_partition_degraded_local_then_heal_converges():
+    """The headline robustness contract (ISSUE 12 acceptance): blackhole
+    the inter-region link for ≥10 sync intervals; the partitioned regions
+    keep answering locally with ZERO request errors and bounded latency,
+    the breaker opens, the staleness gauge grows monotonically, total
+    admissions stay ≤ the sum of per-region limits; after heal the backlog
+    drains through the merge, staleness returns to 0, and both regions
+    converge to the exact union of hits."""
+    c = await Cluster.start(
+        2, dcs=["dc-a", "dc-b"], chaos=True,
+        behaviors=_beh(
+            global_timeout_ms=150.0,
+            region_timeout_ms=150.0,  # fail fast so the breaker trips
+            region_requeue_retries=10_000,  # ride out the whole partition
+            peer_breaker_errors=3,
+            peer_breaker_backoff_base_ms=200.0,
+            peer_breaker_backoff_cap_ms=1_000.0,
+        ),
+    )
+    a, b = c.daemons
+    try:
+        # one exchange while healthy, so both sides hold the key
+        await a.get_rate_limits([_mr("pk", 2)])
+        await b.get_rate_limits([_mr("pk", 3)])
+
+        async def warm():
+            ra = (await a.get_rate_limits([_mr("pk", 0)]))[0].remaining
+            rb = (await b.get_rate_limits([_mr("pk", 0)]))[0].remaining
+            return ra == rb == 95
+
+        await wait_for(warm, timeout_s=10)
+
+        # ---- partition: blackhole BOTH directions
+        for p in c.proxies:
+            p.set_mode("blackhole")
+        t_part = time.monotonic()
+        admitted = {id(a): 0, id(b): 0}
+        errors = 0
+        stale_samples = []
+        # ≥ 10 sync intervals (50 ms cadence) under live traffic, long
+        # enough for 3 consecutive 150 ms send timeouts to trip the breaker
+        while time.monotonic() - t_part < 2.0:
+            for d in (a, b):
+                t0 = time.monotonic()
+                out = await d.get_rate_limits([_mr("pk", 1)])
+                assert time.monotonic() - t0 < 1.0, "serving stalled"
+                if out[0].error:
+                    errors += 1
+                elif out[0].status == pb.UNDER_LIMIT:
+                    admitted[id(d)] += 1
+            stale_samples.append(a.region_manager.oldest_delta_age_s())
+            await asyncio.sleep(0.02)
+        assert errors == 0, f"{errors} request errors during the partition"
+        # staleness grew monotonically (requeues must not reset it)
+        assert stale_samples[-1] > 0
+        assert all(
+            b2 >= a2 - 1e-3
+            for a2, b2 in zip(stale_samples, stale_samples[1:])
+        )
+        # the breaker toward the dead region opened → sends fail fast
+        states = {
+            cl.breaker.state_name for cl in a._peer_clients.values()
+        }
+        assert "open" in states or "half-open" in states
+        # bounded over-admission: each region admits at most its own limit
+        total = 5 + admitted[id(a)] + admitted[id(b)]
+        assert total <= 2 * 100  # Σ per-region limits
+        for d in (a, b):
+            r = (await d.get_rate_limits([_mr("pk", 0)]))[0]
+            assert r.remaining >= 0
+
+        # ---- heal: backlog drains through the merge, regions reconverge
+        for p in c.proxies:
+            p.heal()
+
+        async def reconverged():
+            ra = (await a.get_rate_limits([_mr("pk", 0)]))[0].remaining
+            rb = (await b.get_rate_limits([_mr("pk", 0)]))[0].remaining
+            want = max(0, 100 - total)
+            return ra == rb == want
+
+        await wait_for(reconverged, timeout_s=30, interval_s=0.1)
+        await wait_for(
+            lambda: _zero_stale(a, b), timeout_s=30, interval_s=0.1
+        )
+        # and the wire path carried the backlog (fallbacks stayed zero)
+        assert a.region_manager.wire_fallback == 0
+        assert b.region_manager.wire_fallback == 0
+        s = await scrape(a)
+        assert metric_value(
+            s, "gubernator_region_sync_staleness_seconds"
+        ) == 0.0
+    finally:
+        await c.stop()
+
+
+async def _zero_stale(a, b):
+    return (
+        a.region_manager.oldest_delta_age_s() == 0.0
+        and b.region_manager.oldest_delta_age_s() == 0.0
+    )
+
+
+@async_test
+async def test_requeue_bounded_drops_counted():
+    """With retries exhausted (GUBER_REGION_REQUEUE_RETRIES=0) a partition
+    degrades to the reference's drop behavior: deltas drop, the drop is
+    counted, the queue never grows unbounded, and staleness resets."""
+    c = await Cluster.start(
+        2, dcs=["dc-a", "dc-b"], chaos=True,
+        behaviors=_beh(
+            global_timeout_ms=200.0, region_timeout_ms=200.0,
+            region_requeue_retries=0,
+        ),
+    )
+    a, b = c.daemons
+    try:
+        for p in c.proxies:
+            p.set_mode("blackhole")
+        await a.get_rate_limits([_mr("dk", 5)])
+
+        async def dropped():
+            s = await scrape(a)
+            return metric_value(
+                s, "gubernator_region_requeue_dropped_count_total"
+            ) >= 1
+
+        await wait_for(dropped, timeout_s=10)
+        assert a.region_manager._queue_len() == 0
+        assert a.region_manager.oldest_delta_age_s() == 0.0
+    finally:
+        await c.stop()
+
+
+@async_test
+async def test_cross_layout_two_region_daemons():
+    """A packed-layout (token32) region replicating to a full-layout region
+    and back: both converge to the exact union — the mixed-layout fleet
+    contract end-to-end over the real wire."""
+    from tests.cluster import daemon_config
+
+    confs = [daemon_config(dc="dc-a"), daemon_config(dc="dc-b")]
+    from gubernator_tpu.service.daemon import Daemon
+
+    a = await Daemon.spawn(
+        confs[0], engine=LocalEngine(capacity=8192, layout="token32")
+    )
+    b = await Daemon.spawn(confs[1])
+    try:
+        peers = [a.peer_info(), b.peer_info()]
+        for d in (a, b):
+            d.set_peers([PeerInfo(**vars(p)) for p in peers])
+        assert a.engine.table.layout.name == "token32"
+        out = await a.get_rate_limits([_mr("xk", 6)])
+        assert out[0].error == "" and out[0].remaining == 94
+        out = await b.get_rate_limits([_mr("xk", 3)])
+        assert out[0].error == "" and out[0].remaining == 97
+
+        async def converged():
+            ra = (await a.get_rate_limits([_mr("xk", 0)]))[0].remaining
+            rb = (await b.get_rate_limits([_mr("xk", 0)]))[0].remaining
+            return ra == rb == 91
+
+        await wait_for(converged, timeout_s=10)
+        assert a.engine.table.layout.name == "token32"  # no migration
+        assert a.region_manager.wire_sent >= 1
+        assert b.region_manager.wire_sent >= 1
+        assert a.region_manager.wire_fallback == 0
+    finally:
+        await asyncio.gather(a.close(), b.close())
