@@ -517,7 +517,10 @@ def regions_case(rng, now) -> dict:
         req = sync_regions_pb(enc, "bench", "dc-a", slots, lay)
         out[f"{label}_bytes_per_row"] = round(req.ByteSize() / B, 1)
     steady = sync_regions_pb(
-        enc, "bench", "dc-a", detail_rows=np.zeros(B, dtype=bool)
+        enc, "bench", "dc-a", detail_rows=np.zeros(B, dtype=bool),
+        # per-key cumulative dedup counters ride every production batch
+        # (+8 B/row — the price of exact convergence under retries)
+        cums=np.arange(1, B + 1, dtype=np.int64) * 1000,
     )
     out["steady_state_bytes_per_row"] = round(steady.ByteSize() / B, 1)
     proto = peers_pb.GetPeerRateLimitsReq(
@@ -603,6 +606,103 @@ def regions_case(rng, now) -> dict:
 
     out.update(asyncio.run(run()))
     out["converged_exact"] = True
+    return out
+
+
+def leases_case(rng, now) -> dict:
+    """Edge quota-lease phase (ISSUE 13): the fan-in cut the client-side
+    admission plane buys. One loopback daemon serves (a) a per-check RPC
+    baseline — 8 concurrent single-item GetRateLimits checkers, the cost
+    every check pays without delegation — and (b) a LocalLimiter under
+    LEASE CHURN (200 ms TTL, adaptive grants, live renew/return RPCs)
+    hammered by 2 admission threads. Records both rates, the ≥50× accept
+    bit, the adaptive grant-size trace, and the exact-conservation check
+    (admissions == server-side consumption — grants pre-consume, so the
+    no-crash over-admission is zero by construction; the crash-edge bound
+    is CI-gated in lease_smoke)."""
+    import asyncio
+
+    from gubernator_tpu.client import V1Client
+    from gubernator_tpu.edge import LocalLimiter
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from tests.cluster import Cluster
+
+    MINUTE = 60_000
+    out: dict = {}
+
+    async def run():
+        c = await Cluster.start(1)
+        d = c.daemons[0]
+        try:
+            cl = V1Client(d.conf.grpc_address)
+            rpc_n = 0
+
+            async def rpc_worker(i, deadline):
+                nonlocal rpc_n
+                while time.perf_counter() < deadline:
+                    await cl.get_rate_limits([pb.RateLimitReq(
+                        name="bench-rpc", unique_key=f"u{i}", hits=1,
+                        limit=1 << 30, duration=MINUTE,
+                    )])
+                    rpc_n += 1
+
+            t0 = time.perf_counter()
+            await asyncio.gather(
+                *(rpc_worker(i, t0 + 0.5) for i in range(8))
+            )
+            rpc_rate = rpc_n / (time.perf_counter() - t0)
+            out["per_check_rpc_per_sec"] = round(rpc_rate, 1)
+
+            lim = LocalLimiter(
+                d.conf.grpc_address, "bench-edge", "hot",
+                limit=1 << 24, duration=MINUTE, ttl_ms=200,
+                initial_grant=4096,
+            )
+            await lim.start()
+            stop = [False]
+            counts = [0, 0]
+
+            def admit_worker(i):
+                while not stop[0]:
+                    if lim.allow():
+                        counts[i] += 1
+                    else:
+                        time.sleep(0.0005)
+
+            loop = asyncio.get_running_loop()
+            t0 = time.perf_counter()
+            futs = [loop.run_in_executor(None, admit_worker, i)
+                    for i in range(2)]
+            await asyncio.sleep(0.8)
+            stop[0] = True
+            await asyncio.gather(*futs)
+            wall = time.perf_counter() - t0
+            local_rate = sum(counts) / wall
+            await lim.close()
+            srv = (await cl.get_rate_limits([pb.RateLimitReq(
+                name="bench-edge", unique_key="hot", hits=0,
+                limit=1 << 24, duration=MINUTE,
+            )])).responses[0]
+            await cl.close()
+            return {
+                "client_admissions_per_sec": round(local_rate, 1),
+                "fanin_cut_x": round(local_rate / max(rpc_rate, 1), 1),
+                "accept_ge_50x": bool(local_rate >= 50 * rpc_rate),
+                "lease_renewals": lim.stats.grants,
+                "grant_size_trace": lim.stats.grant_sizes[:16],
+                "tokens_granted": lim.stats.tokens_granted,
+                "tokens_returned": lim.stats.tokens_returned,
+                "admitted_total": lim.stats.local_admits,
+                "consumed_server_side": int((1 << 24) - srv.remaining),
+                "conservation_exact": bool(
+                    lim.stats.local_admits
+                    <= (1 << 24) - srv.remaining
+                ),
+            }
+        finally:
+            await c.stop()
+
+    out.update(asyncio.run(run()))
     return out
 
 
@@ -2148,6 +2248,13 @@ def main() -> None:
     matrix["regions"] = _attempt(
         "regions",
         lambda: regions_case(np.random.default_rng(56), now),
+    )
+
+    # edge quota-lease phase (ISSUE 13): client-side admissions/s vs the
+    # per-check RPC rate (the ≥50× fan-in cut) + the adaptive grant trace
+    matrix["leases"] = _attempt(
+        "leases",
+        lambda: leases_case(np.random.default_rng(57), now),
     )
 
     # latency phase (sweep vs sparse vs xla device terms per table size);

@@ -13,11 +13,42 @@ from typing import Dict, List, Optional, Sequence, Union
 import grpc
 
 from gubernator_tpu.proto import gubernator_pb2 as pb
-from gubernator_tpu.types import RateLimitRequest
+from gubernator_tpu.types import RateLimitRequest, RateLimitResponse
 
 GET_RATE_LIMITS = "/pb.gubernator.V1/GetRateLimits"
 HEALTH_CHECK = "/pb.gubernator.V1/HealthCheck"
 LIVE_CHECK = "/pb.gubernator.V1/LiveCheck"
+LEASE_QUOTA = "/pb.gubernator.V1/LeaseQuota"
+
+
+def response_retry_after_ms(resp: "pb.RateLimitResp") -> int:
+    """The denied row's backoff hint as a first-class value.
+
+    The frozen proto schema carries retry_after only as
+    metadata["retry_after_ms"] (PR 11); this is the one place that knows the
+    spelling, so callers (the edge library's per-check fallback among them)
+    never string-key spelunk. 0 for allowed rows or pre-retry_after peers."""
+    raw = resp.metadata.get("retry_after_ms", "")
+    if raw:
+        try:
+            return max(0, int(raw))
+        except ValueError:
+            return 0
+    return 0
+
+
+def response_from_pb(resp: "pb.RateLimitResp") -> RateLimitResponse:
+    """pb.RateLimitResp → typed RateLimitResponse with `retry_after_ms`
+    populated as a first-class field (types.RateLimitResponse)."""
+    return RateLimitResponse(
+        status=int(resp.status),
+        limit=int(resp.limit),
+        remaining=int(resp.remaining),
+        reset_time=int(resp.reset_time),
+        error=resp.error,
+        metadata=dict(resp.metadata),
+        retry_after_ms=response_retry_after_ms(resp),
+    )
 
 
 def to_pb(r: Union[RateLimitRequest, Dict, "pb.RateLimitReq"]) -> "pb.RateLimitReq":
@@ -111,6 +142,28 @@ class V1Client:
     ) -> "pb.GetRateLimitsResp":
         req = pb.GetRateLimitsReq(requests=[to_pb(r) for r in requests])
         return await self._next_call()(req, timeout=timeout_s or self.timeout_s)
+
+    async def check(
+        self,
+        requests: Sequence[Union[RateLimitRequest, Dict, "pb.RateLimitReq"]],
+        timeout_s: Optional[float] = None,
+    ) -> List[RateLimitResponse]:
+        """get_rate_limits returning typed responses with retry_after_ms as
+        a first-class field — callers back off without metadata spelunking."""
+        resp = await self.get_rate_limits(requests, timeout_s=timeout_s)
+        return [response_from_pb(r) for r in resp.responses]
+
+    async def lease_quota(
+        self, req: "pb.LeaseQuotaReq", timeout_s: Optional[float] = None
+    ) -> "pb.LeaseQuotaResp":
+        """One edge quota-lease operation (acquire / renew / return —
+        docs/leases.md); the edge.LocalLimiter drives this."""
+        call = self._channel.unary_unary(
+            LEASE_QUOTA,
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.LeaseQuotaResp.FromString,
+        )
+        return await call(req, timeout=timeout_s or self.timeout_s)
 
     async def health_check(self, timeout_s: Optional[float] = None) -> "pb.HealthCheckResp":
         call = self._channel.unary_unary(

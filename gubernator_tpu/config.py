@@ -86,6 +86,16 @@ def _get_float_ms(env, key: str, default_ms: float) -> float:
     return val * {"us": 1e-3, "ms": 1.0, "s": 1e3, "m": 60e3}[unit]
 
 
+def _get_fraction(env, key: str, default: float) -> float:
+    raw = env.get(key)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ConfigError(f"{key}: expected a number, got {raw!r}")
+
+
 def _get_bool(env, key: str, default: bool = False) -> bool:
     raw = env.get(key, "")
     if raw == "":
@@ -336,6 +346,21 @@ class DaemonConfig:
     # deployments that treat internals as sensitive
     debug_endpoints: bool = True
 
+    # --- edge quota leases (service/lease_manager.py; docs/leases.md) ----
+    # ceiling on Σ outstanding leased tokens per key, as a fraction of the
+    # key's limit — sizes the documented over-admission bound (a lease is
+    # admission delegated to the edge; what's out there is what a
+    # partitioned/crashed client can still admit)
+    lease_max_fraction: float = 0.5
+    # lease TTL clamp: requested TTLs resolve into [min, max]; shorter TTLs
+    # reclaim crashed clients' tokens faster at more renew RPCs
+    lease_min_ttl_ms: float = 100.0
+    lease_max_ttl_ms: float = 30_000.0
+    # absolute per-key cap on Σ outstanding leased tokens (0 = only the
+    # fraction cap applies) — for huge limits where even a small fraction
+    # delegates more than an edge fleet should hold
+    lease_max_outstanding: int = 0
+
     # accepted client created_at skew (ms); requests outside now±tolerance are
     # clamped and counted (gubernator_created_at_clamped_count)
     created_at_tolerance_ms: float = 5 * 60 * 1000.0
@@ -524,6 +549,22 @@ class DaemonConfig:
             raise ConfigError("GUBER_HANDOFF_DEADLINE must be positive")
         if self.behaviors.handoff_chunk_rows <= 0:
             raise ConfigError("GUBER_HANDOFF_CHUNK_ROWS must be positive")
+        if not (0.0 < self.lease_max_fraction <= 1.0):
+            raise ConfigError(
+                "GUBER_LEASE_MAX_FRACTION must be in (0, 1] (the fraction "
+                "of a limit that may be delegated to edge leases)"
+            )
+        if self.lease_min_ttl_ms <= 0:
+            raise ConfigError("GUBER_LEASE_MIN_TTL_MS must be positive")
+        if self.lease_max_ttl_ms < self.lease_min_ttl_ms:
+            raise ConfigError(
+                "GUBER_LEASE_MAX_TTL_MS must be >= GUBER_LEASE_MIN_TTL_MS"
+            )
+        if self.lease_max_outstanding < 0:
+            raise ConfigError(
+                "GUBER_LEASE_MAX_OUTSTANDING must be >= 0 (0 = fraction "
+                "cap only)"
+            )
         if self.tls_client_auth not in ("", "require", "verify"):
             raise ConfigError("GUBER_TLS_CLIENT_AUTH must be require or verify")
         if self.created_at_tolerance_ms <= 0:
@@ -671,6 +712,14 @@ def setup_daemon_config(
             env, "GUBER_TELEMETRY_INTERVAL_MS", 5_000.0
         ),
         debug_endpoints=_get_bool(env, "GUBER_DEBUG_ENDPOINTS", True),
+        lease_max_fraction=_get_fraction(env, "GUBER_LEASE_MAX_FRACTION", 0.5),
+        lease_min_ttl_ms=_get_float_ms(env, "GUBER_LEASE_MIN_TTL_MS", 100.0),
+        lease_max_ttl_ms=_get_float_ms(
+            env, "GUBER_LEASE_MAX_TTL_MS", 30_000.0
+        ),
+        lease_max_outstanding=_get_int(
+            env, "GUBER_LEASE_MAX_OUTSTANDING", 0
+        ),
         created_at_tolerance_ms=_get_float_ms(
             env, "GUBER_CREATED_AT_TOLERANCE", 5 * 60 * 1000.0
         ),

@@ -125,6 +125,21 @@ def _bucket_math_impl(
     # algorithms", go:96-105,307-317).
     algo_match = exists & (s.algo == req.algo)
 
+    # Negative hits against a key with NO live matching state are a no-op,
+    # not an install, for the EXTENSION algorithms (GCRA, sliding-window,
+    # concurrency-lease — algo >= 2): the thing being released no longer
+    # exists (expired, evicted, or never seen), so writing a fresh slot
+    # would resurrect state from a pure return — a fresh lease row
+    # installed by a release would hold a full TTL for nothing. Such rows
+    # answer a full bucket and REMOVE (write an empty slot), the same
+    # writeback RESET_REMAINING uses; live-state releases are separately
+    # clamped per extension lane (docs/leases.md "Miss-safe returns").
+    # Token and leaky buckets are deliberately EXCLUDED: the reference's
+    # negative hits bank credit — remaining may exceed the limit, even on
+    # a fresh key (functional_test.go:297, TestGlobalNegativeHits) — and
+    # that wire behavior is pinned by the parity suite.
+    neg_miss = (h < 0) & ~algo_match & (req.algo >= 2)
+
     OVER = jnp.int32(int(Status.OVER_LIMIT))
     UNDER = jnp.int32(int(Status.UNDER_LIMIT))
 
@@ -149,7 +164,10 @@ def _bucket_math_impl(
         # new-item rule and the existing-item rule are the same
         # compare-and-advance
         g_tat0 = jnp.maximum(jnp.where(algo_match, s_aux, now), now)
-        g_tat1 = g_tat0 + h * g_T
+        # releases (h < 0) rewind the TAT but never below `now` — a fresh
+        # bucket is the most a return can restore (remaining ≤ burst), the
+        # GCRA analog of the token clamp at `limit`
+        g_tat1 = jnp.maximum(g_tat0 + h * g_T, now)
         g_deny = (h > 0) & (g_tat1 - g_tau > now)
         # deny: rejected hits don't advance (unless DRAIN_OVER_LIMIT, which
         # consumes the whole tolerance — the "drain to empty" analog of
@@ -171,8 +189,9 @@ def _bucket_math_impl(
         g_reset = jnp.where(g_deny & ~is_drain, g_tat1 - g_tau, g_reset)
         g_status = jnp.where(g_deny, OVER, UNDER)
         # RESET_REMAINING removes the item outright and reports a full
-        # bucket (token semantics, go:82-94)
-        g_rm = exists & is_reset
+        # bucket (token semantics, go:82-94); a miss-release removes too
+        # (see neg_miss above — a return must never install fresh state)
+        g_rm = (exists & is_reset) | neg_miss
         return dict(
             tat=g_tat_out,
             exp=jnp.maximum(g_tat_out, now),
@@ -229,6 +248,9 @@ def _bucket_math_impl(
     overask = ~zero_hits & ~at_limit & ~exact & (h > t_rem)  # go:179-190
     consume = ~zero_hits & ~at_limit & ~exact & ~overask  # go:192-194
 
+    # negative hits add back through the consume branch WITHOUT a top
+    # clamp: remaining may exceed the limit, matching the reference's
+    # credit-banking semantics (functional_test.go:297)
     tok_rem_out = jnp.where(
         exact | (overask & is_drain), i64(0), jnp.where(consume, t_rem - h, t_rem)
     )
@@ -241,6 +263,7 @@ def _bucket_math_impl(
 
     # --- new item (go:202-252)
     new_over = h > req.limit
+    # h < 0 on a fresh slot banks credit past the limit (reference rule)
     tokn_rem = jnp.where(new_over, req.limit, req.limit - h)
     tokn_status = jnp.where(new_over, OVER, UNDER)
     tokn_exp = req.expire_new
@@ -256,6 +279,7 @@ def _bucket_math_impl(
 
     # RESET_REMAINING on an existing item removes it outright and reports a
     # full bucket (go:82-94) — modeled as writing back an empty slot.
+    # (neg_miss never marks token rows — see its algo >= 2 scope.)
     tok_reset_rm = exists & is_reset
     tok_resp_status = jnp.where(tok_reset_rm, UNDER, tok_resp_status)
     tok_resp_rem = jnp.where(tok_reset_rm, req.limit, tok_resp_rem)
@@ -310,11 +334,13 @@ def _bucket_math_impl(
     w_used = w_cur + (w_prev * (w_dur - w_elapsed)) // w_dur
     w_deny = (h > 0) & (w_used + h > req.limit)
     w_take = jnp.where(w_deny & ~is_drain, i64(0), h)
-    w_cur_out = w_cur + w_take
+    # releases (h < 0) clamp at an empty window — a return can never drive
+    # the stored count negative (remaining past `limit`)
+    w_cur_out = jnp.maximum(w_cur + w_take, i64(0))
     w_rem = jnp.clip(req.limit - (w_used + w_take), 0, req.limit)
     w_reset = w_ws + w_dur
     w_status = jnp.where(w_deny, OVER, UNDER)
-    w_reset_rm = exists & is_reset
+    w_reset_rm = (exists & is_reset) | neg_miss
     w_resp_status = jnp.where(w_reset_rm, UNDER, w_status)
     w_resp_rem = jnp.where(w_reset_rm, req.limit, w_rem)
     w_resp_reset = jnp.where(w_reset_rm, i64(0), w_reset)
@@ -336,7 +362,11 @@ def _bucket_math_impl(
     )
     l_rem = jnp.clip(req.limit - l_inflight, 0, req.limit)
     l_status = jnp.where(l_deny, OVER, UNDER)
-    l_reset_rm = exists & is_reset
+    # a release (or RESET) of a lease key with no live state removes rather
+    # than installs — the headline miss-safety case: a crashed client's
+    # late release must not resurrect an already-TTL-reclaimed lease slot
+    # with a fresh TTL and zero inflight
+    l_reset_rm = (exists & is_reset) | neg_miss
     l_resp_status = jnp.where(l_reset_rm, UNDER, l_status)
     l_resp_rem = jnp.where(l_reset_rm, req.limit, l_rem)
     l_resp_reset = jnp.where(l_reset_rm, i64(0), l_exp)
@@ -358,6 +388,9 @@ def _bucket_math_impl(
 
     w_rem_store = req.limit - w_cur_out
     l_rem_store = req.limit - l_inflight
+    # the gcra/window/lease rm flags fold neg_miss (miss-releases remove
+    # for the extension lanes); token/leaky keep the reference's
+    # credit-banking install on negative hits
     remove_all = (
         (tok_reset_rm & is_token)
         | (g_reset_rm & is_gcra)
@@ -425,6 +458,8 @@ def _bucket_math_impl(
     lk_zero = ~lk_at_limit & ~lk_exact & ~lk_overask & (h == 0)  # go:422-424
     lk_consume = ~lk_at_limit & ~lk_exact & ~lk_overask & ~lk_zero
 
+    # negative hits refill past the burst like token's credit banking (the
+    # reference's leaky path has no top clamp either)
     lk_rem_out = jnp.where(
         lk_exact | (lk_overask & is_drain),
         f64(0.0),

@@ -241,6 +241,76 @@ def reconcile_region_rows(
     return out
 
 
+# per-source receive-ledger bound: ~1M keys per source before the ledger
+# resets wholesale (a reset degrades re-shipped batches to the legacy
+# under-grant rule, never over)
+DEDUP_LEDGER_CAP = 1 << 20
+
+
+def dedup_source_deltas(
+    ledger: dict,
+    fps: np.ndarray,
+    deltas: np.ndarray,
+    cums: Optional[np.ndarray],
+) -> np.ndarray:
+    """Receiver-side exact dedup of re-shipped region-sync batches.
+
+    `ledger` is this receiver's per-SOURCE map fp → highest cumulative
+    counter already APPLIED (committed by the caller only after the merge
+    lands — see RegionManager.dedup_recv). `cums[i]` is the sender's total
+    hits ever queued for `fps[i]` toward this region, INCLUDING this
+    batch's `deltas[i]`. The effective delta to apply is::
+
+        cum >  seen  →  min(delta, cum - seen)   (normal / partial overlap)
+        cum == seen  →  0                        (exact duplicate: skip)
+        cum <  seen  →  delta                    (sender restarted or its
+                                                  ledger reset: its new
+                                                  counter counts only new
+                                                  hits — apply them, and
+                                                  re-baseline below)
+
+    The `min(delta, ·)` cap matters when the sender DROPPED batches
+    (bounded requeue, GUBER_REGION_REQUEUE_RETRIES): the gap between
+    counters then includes hits that were never shipped and never will be
+    — applying more than this batch actually carries would fabricate them.
+    Every branch errs toward applying less, so dedup can only remove the
+    double-apply under-grant, never over-grant. Returns the effective
+    delta array; does NOT touch `ledger` (commit after the merge lands so
+    a failed/cancelled apply is re-appliable)."""
+    deltas = np.asarray(deltas, dtype=i64)
+    if cums is None:
+        return deltas  # pre-dedup sender: legacy at-least-once rule
+    cums = np.asarray(cums, dtype=i64)
+    eff = deltas.copy()
+    for i, fp in enumerate(np.asarray(fps, dtype=i64)):
+        seen = ledger.get(int(fp))
+        if seen is None:
+            continue
+        c = int(cums[i])
+        if c > seen:
+            eff[i] = min(int(deltas[i]), c - seen)
+        elif c == seen:
+            eff[i] = 0
+        # c < seen: sender reset — apply the delta as shipped
+    return eff
+
+
+def commit_source_cums(
+    ledger: dict, fps: np.ndarray, cums: Optional[np.ndarray]
+) -> None:
+    """Record a successfully MERGED batch's cumulative counters into the
+    per-source ledger (the second half of dedup_source_deltas). A
+    sender-reset (cum below the stored baseline) re-baselines downward so
+    the sender's fresh counter stream keeps deduping."""
+    if cums is None:
+        return
+    if len(ledger) + fps.shape[0] > DEDUP_LEDGER_CAP:
+        ledger.clear()  # degrade to legacy under-grant, bounded memory
+    cums = np.asarray(cums, dtype=i64)
+    for i, fp in enumerate(np.asarray(fps, dtype=i64)):
+        ledger[int(fp)] = int(cums[i])
+
+
 def apply_region_sync(
     engine,
     fps: np.ndarray,

@@ -26,6 +26,15 @@ Schema (proto3, package pb.gubernator):
                               // (zero row = slot evicted sender-side;
                               // empty buffer = sender shipped no rows)
       uint32 layout    = 11;  // ops/layout code of `slots` (0 = full)
+      bytes  cums      = 12;  // count × int64 LE per-key CUMULATIVE hit
+                              // counters (total hits the sender has ever
+                              // queued toward this region for the key) —
+                              // the receiver-side dedup ledger skips
+                              // re-shipped batches after a lost ack
+                              // EXACTLY (ops/reconcile.dedup_source_
+                              // deltas); empty buffer = sender predates
+                              // the dedup plane (receiver applies deltas
+                              // verbatim — the legacy under-grant rule)
     }
     message SyncRegionsWireResp {
       uint32 applied = 1;  // rows the receiver merged
@@ -63,6 +72,7 @@ for _name, _num, _type in (
     ("strings", 9, _FD.TYPE_BYTES),
     ("slots", 10, _FD.TYPE_BYTES),
     ("layout", 11, _FD.TYPE_UINT32),
+    ("cums", 12, _FD.TYPE_BYTES),
 ):
     _f = _req.field.add()
     _f.name, _f.number, _f.type = _name, _num, _type

@@ -176,6 +176,13 @@ class Daemon:
         from gubernator_tpu.service.region_manager import RegionManager
 
         self.region_manager = RegionManager(self)
+        # edge quota leases (docs/leases.md): the V1 LeaseQuota surface —
+        # bounded slices of a limit delegated to client-side admission,
+        # accounted through the normal decide path + a CONCURRENCY_LEASE
+        # outstanding ledger (TTL reclamation)
+        from gubernator_tpu.service.lease_manager import LeaseManager
+
+        self.lease_manager = LeaseManager(self)
         # incremental-checkpoint plane (service/checkpoint.py): inert unless
         # GUBER_CHECKPOINT_INTERVAL_MS > 0 — then a background loop appends
         # dirty-block delta frames beside the base snapshot and restart
@@ -901,6 +908,14 @@ class Daemon:
                 self.metrics.over_limit_counter.inc()
         return out  # type: ignore[return-value]
 
+    async def lease_quota(self, req: "pb.LeaseQuotaReq") -> "pb.LeaseQuotaResp":
+        """One edge quota-lease operation (service/lease_manager.py): grant
+        a bounded slice of a limit for client-side admission, renew it, or
+        take unused tokens back. The grant/refund rows ride the exact
+        routing this daemon's GetRateLimits uses, so ownership, GLOBAL and
+        MULTI_REGION behaviors see leased consumption as ordinary hits."""
+        return await self.lease_manager.lease_quota(req)
+
     # ------------------------------------------------- native raw fast path
     # requests below this many wire bytes parse inline: the door-pool
     # executor hop costs more than the parse itself for small buffers
@@ -1437,10 +1452,21 @@ class Daemon:
         from gubernator_tpu.proto import regionsync_pb2 as regionsync_pb
         from gubernator_tpu.service.wire import sync_regions_arrays
 
-        fps, deltas, cfg, hash_keys, slots, layout = sync_regions_arrays(req)
+        fps, deltas, cfg, hash_keys, slots, layout, cums = (
+            sync_regions_arrays(req)
+        )
+        # per-source exact dedup: a re-shipped batch (lost ack + sender
+        # requeue) applies only the hits this receiver has not merged yet
+        # — convergence stays exact under retries. The ledger commits only
+        # after the merge lands (this handler runs shielded, so the pair
+        # cannot be split by a client-side cancel).
+        deltas, commit_dedup = self.region_manager.dedup_recv(
+            req.source, fps, deltas, cums
+        )
         applied = await self.runner.apply_region(
             fps, deltas, cfg, slots, layout
         )
+        commit_dedup()
         if (
             self._local_picker.size() > 0
             and self.conf.behaviors.handoff_enabled
@@ -1580,6 +1606,14 @@ class Daemon:
         backlog draining?)."""
         out = self.region_manager.debug()
         self.metrics.region_sync_staleness.set(out["staleness_s"])
+        return out
+
+    def debug_leases(self) -> dict:
+        """Edge quota-lease plane: outstanding tokens per key, grant/renew/
+        return/expire rates, and the live over-admission bound = Σ
+        outstanding leased tokens (docs/leases.md)."""
+        out = self.lease_manager.debug()
+        self.metrics.lease_outstanding.set(out["outstanding_tokens_total"])
         return out
 
     def debug_global(self) -> dict:

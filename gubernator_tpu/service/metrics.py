@@ -314,6 +314,51 @@ class DaemonMetrics:
             "(kernel2.merge2) on the region receive path",
             registry=r,
         )
+        self.region_dedup_skipped = Counter(
+            "gubernator_region_dedup_skipped_hits_total",
+            "Duplicate cross-region hit deltas skipped EXACTLY by the "
+            "per-source cumulative-counter ledger (re-shipped batches "
+            "after a lost ack) — convergence stays exact under retries "
+            "instead of degrading to under-grant",
+            registry=r,
+        )
+        # --- edge quota leases (service/lease_manager.py; docs/leases.md):
+        # the client-side admission plane's server-side accounting. The
+        # outstanding gauge IS the live over-admission bound the delegation
+        # adds on top of the limits (Σ tokens granted out, not yet returned
+        # or expired).
+        self.lease_ops = Counter(
+            # renders as gubernator_lease_ops_total
+            "gubernator_lease_ops",
+            "Edge quota-lease operations by kind (acquire = new lease, "
+            "renew = TTL/grant refresh, return = unused tokens back, deny "
+            "= zero-token answer, expire = TTL reclamation of an "
+            "unrenewed lease, unknown_return = return against a lease "
+            "this daemon no longer remembers)",
+            ["op"],  # acquire | renew | return | deny | expire |
+            # unknown_return
+            registry=r,
+        )
+        self.lease_tokens = Counter(
+            # renders as gubernator_lease_tokens_total
+            "gubernator_lease_tokens",
+            "Edge quota-lease tokens by flow: granted out to edge "
+            "limiters, returned unused, expired (reclaimed by TTL with "
+            "the real-limit consumption kept — conservative)",
+            ["kind"],  # granted | returned | expired
+            registry=r,
+        )
+        self.lease_outstanding = Gauge(
+            "gubernator_lease_outstanding_tokens",
+            "Σ outstanding leased tokens across keys on this daemon — the "
+            "live over-admission bound contribution (docs/leases.md)",
+            registry=r,
+        )
+        self.lease_active = Gauge(
+            "gubernator_lease_active",
+            "Live (unexpired) edge quota leases tracked by this daemon",
+            registry=r,
+        )
         # --- topology-change handoff (service/handoff.py; docs/robustness.md
         # "Topology change & drain") — the rolling-restart chaos test asserts
         # row-count parity between phases across daemons, so phase labels are

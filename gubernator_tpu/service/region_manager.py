@@ -105,6 +105,19 @@ class RegionManager:
         # as pure 32 B lane+hits entries. Cleared wholesale at the cap —
         # re-shipping detail is merely bytes, never wrong.
         self._shipped: Dict[str, set] = {}
+        # dc → hash_key → CUMULATIVE hits ever queued toward that region
+        # (incremented at queue time ONLY — a requeue re-merges already-
+        # counted hits). Shipped alongside each delta so the receiver's
+        # per-source ledger skips re-shipped batches after a lost ack
+        # EXACTLY (ops/reconcile.dedup_source_deltas). Cleared wholesale
+        # at the cap: the receiver sees the counter go backwards and falls
+        # back to the legacy under-grant rule for one round, never over.
+        self._cum: Dict[str, Dict[str, int]] = {}
+        # source address → fp → highest cumulative counter already MERGED
+        # on this daemon (the RECEIVE half; committed only after the merge
+        # lands so a cancelled apply is re-appliable)
+        self._recv_cum: Dict[str, Dict[int, int]] = {}
+        self.dedup_skipped = 0  # duplicate hits skipped exactly (receive)
         # lifetime path counters (debug plane; prometheus carries the same)
         self.wire_sent = 0
         self.wire_fallback = 0
@@ -177,7 +190,12 @@ class RegionManager:
         for dc in dcs:
             pend = self._pending.setdefault(dc, {})
             ages = self._age.setdefault(dc, {})
+            cum = self._cum.setdefault(dc, {})
             for k, it in entries:
+                if it.hits > 0:
+                    if len(cum) >= self._SHIPPED_CAP and k not in cum:
+                        cum.clear()
+                    cum[k] = cum.get(k, 0) + int(it.hits)
                 ages.setdefault(k, t)
                 agg = pend.get(k)
                 if agg is None:
@@ -326,6 +344,14 @@ class RegionManager:
                 )
                 slots = np.zeros((len(enc), layout.F), dtype=np.int32)
                 slots[detail] = got
+            # per-key cumulative counters ride every batch: the receiver's
+            # per-source ledger turns a re-shipped batch (lost ack +
+            # requeue) into an EXACT skip instead of an under-grant
+            cum = self._cum.get(dc, {})
+            cums = np.fromiter(
+                (cum.get(k, 0) for k, _ in enc), dtype=np.int64,
+                count=len(enc),
+            )
             req = sync_regions_pb(
                 enc,
                 self.daemon.conf.advertise_address,
@@ -333,6 +359,7 @@ class RegionManager:
                 slots,
                 layout,
                 detail_rows=detail,
+                cums=cums,
             )
             try:
                 await client.sync_regions_wire(req, timeout=self.timeout_s)
@@ -435,6 +462,31 @@ class RegionManager:
             return 0.0
         return max(0.0, time.monotonic() - oldest)
 
+    def dedup_recv(self, source: str, fps, deltas, cums):
+        """Receive-side exact dedup (ops/reconcile.dedup_source_deltas):
+        returns (effective_deltas, commit). The caller applies the
+        effective deltas through the merge and calls `commit()` ONLY after
+        the merge landed — so a cancelled/failed apply leaves the ledger
+        untouched and the sender's retry re-applies in full."""
+        from gubernator_tpu.ops.reconcile import (
+            commit_source_cums,
+            dedup_source_deltas,
+        )
+
+        ledger = self._recv_cum.setdefault(source, {})
+        eff = dedup_source_deltas(ledger, fps, deltas, cums)
+        skipped = int(
+            (np.asarray(deltas, dtype=np.int64) - eff).sum()
+        ) if cums is not None else 0
+
+        def commit():
+            commit_source_cums(ledger, fps, cums)
+            if skipped > 0:
+                self.dedup_skipped += skipped
+                self.metrics.region_dedup_skipped.inc(skipped)
+
+        return eff, commit
+
     def note_recv(self, n_entries: int, n_merged: int) -> None:
         """Receive-side accounting (daemon.sync_regions_wire)."""
         self.wire_recv += n_entries
@@ -490,6 +542,11 @@ class RegionManager:
                 "recv": self.wire_recv,
                 "fallback": self.wire_fallback,
                 "rows_merged": self.rows_merged,
+                # duplicate hits skipped EXACTLY by the per-source
+                # cumulative-counter ledger (re-shipped batches after a
+                # lost ack) — nonzero means retries happened AND exactness
+                # held instead of degrading to under-grant
+                "dedup_skipped_hits": self.dedup_skipped,
             },
             "regions": regions,
         }

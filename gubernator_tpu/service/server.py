@@ -84,6 +84,10 @@ def build_grpc_services(daemon):
         except RuntimeError as exc:
             await context.abort(grpc.StatusCode.UNAVAILABLE, str(exc))
 
+    @_timed(m, "/v1.LeaseQuota")
+    async def lease_quota(request: pb.LeaseQuotaReq, context):
+        return await daemon.lease_quota(request)
+
     @_timed(m, "/peers.GetPeerRateLimits")
     async def get_peer_rate_limits(request: peers_pb.GetPeerRateLimitsReq, context):
         return await daemon.get_peer_rate_limits(request)
@@ -136,6 +140,7 @@ def build_grpc_services(daemon):
             ),
             "HealthCheck": unary(health_check, pb.HealthCheckReq, pb.HealthCheckResp),
             "LiveCheck": unary(live_check, pb.LiveCheckReq, pb.LiveCheckResp),
+            "LeaseQuota": unary(lease_quota, pb.LeaseQuotaReq, pb.LeaseQuotaResp),
         },
     )
     peers = grpc.method_handlers_generic_handler(
@@ -200,6 +205,16 @@ def build_http_app(daemon, status_only: bool = False) -> web.Application:
             return web.json_response({"code": 3, "message": str(exc)}, status=400)
         return to_json(pb.GetRateLimitsResp(responses=resps))
 
+    async def lease_quota(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            req = json_format.ParseDict(body, pb.LeaseQuotaReq())
+        except Exception as exc:
+            return web.json_response(
+                {"code": 3, "message": f"invalid request: {exc}"}, status=400
+            )
+        return to_json(await daemon.lease_quota(req))
+
     async def health(request: web.Request) -> web.Response:
         return to_json(await daemon.health_check())
 
@@ -253,6 +268,8 @@ def build_http_app(daemon, status_only: bool = False) -> web.Application:
                 return web.json_response(daemon.debug_regions())
             if kind == "durability":
                 return web.json_response(daemon.debug_durability())
+            if kind == "leases":
+                return web.json_response(daemon.debug_leases())
         except Exception as exc:  # pragma: no cover - defensive
             return web.json_response(
                 {"code": 13, "message": f"debug snapshot failed: {exc}"},
@@ -260,13 +277,14 @@ def build_http_app(daemon, status_only: bool = False) -> web.Application:
             )
         return web.json_response(
             {"code": 5, "message": f"unknown debug plane {kind!r}; one of: "
-             "table, pipeline, peers, global, regions, durability"},
+             "table, pipeline, peers, global, regions, durability, leases"},
             status=404,
         )
 
     app = web.Application()
     if not status_only:
         app.router.add_post("/v1/GetRateLimits", get_rate_limits)
+        app.router.add_post("/v1/LeaseQuota", lease_quota)
     app.router.add_get("/v1/HealthCheck", health)
     app.router.add_post("/v1/HealthCheck", health)
     app.router.add_get("/v1/LiveCheck", live)

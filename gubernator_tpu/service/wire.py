@@ -671,6 +671,7 @@ def sync_regions_pb(
     slots: Optional[np.ndarray] = None,
     layout=None,
     detail_rows: Optional[np.ndarray] = None,
+    cums: Optional[np.ndarray] = None,
 ):
     """Pack one region-bound delta batch (already split_region_encodable-
     filtered) into a SyncRegionsWireReq. `slots` are the sender's stored
@@ -682,8 +683,15 @@ def sync_regions_pb(
     A key's FIRST replication to a region ships detailed; steady-state
     deltas for already-shipped keys are pure 32 B lane+hits entries
     (zero-length strings, zero slot row) — the receiver merges them by
-    fingerprint against its own stored state. The receive half is
-    sync_regions_arrays → ops/reconcile.apply_region_sync."""
+    fingerprint against its own stored state.
+
+    `cums` (int64 (n,), optional) are the sender's PER-KEY CUMULATIVE hit
+    counters toward this region (total ever queued, including this batch's
+    deltas) — the receiver's per-source dedup ledger uses them to skip
+    re-shipped batches after a lost ack EXACTLY instead of under-granting
+    (ops/reconcile.dedup_source_deltas). Absent = pre-dedup sender; the
+    receiver then applies deltas verbatim (the legacy at-least-once rule).
+    The receive half is sync_regions_arrays → apply_region_sync."""
     from gubernator_tpu.ops import wire as wire_mod
     from gubernator_tpu.ops.layout import FULL
     from gubernator_tpu.proto import regionsync_pb2 as regionsync_pb
@@ -727,6 +735,10 @@ def sync_regions_pb(
         assert slots.shape == (n, layout.F), "slots misaligned with pairs"
         slots = np.where(detail_rows[:, None], slots, 0)
         slot_bytes = np.ascontiguousarray(slots, dtype=np.int32).tobytes()
+    cum_bytes = b""
+    if cums is not None:
+        assert len(cums) == n, "cums misaligned with pairs"
+        cum_bytes = np.ascontiguousarray(cums, dtype=np.int64).tobytes()
     return regionsync_pb.SyncRegionsWireReq(
         source=source,
         region=region,
@@ -739,15 +751,17 @@ def sync_regions_pb(
         strings=b"".join(b for pair in zip(names, keys) for b in pair),
         slots=slot_bytes,
         layout=layout.code,
+        cums=cum_bytes,
     )
 
 
 def sync_regions_arrays(req):
     """Decode a SyncRegionsWireReq into the reconcile inputs:
-    (fps i64, deltas i64, cfg column dict, hash_keys, slots, layout).
+    (fps i64, deltas i64, cfg column dict, hash_keys, slots, layout, cums).
     `slots` come back in the SENDER's layout (None when the sender shipped
-    no rows); every buffer length is validated — a short buffer must fail
-    loudly, not merge garbage rows."""
+    no rows); `cums` are the per-key cumulative counters (None when the
+    sender predates the dedup plane); every buffer length is validated — a
+    short buffer must fail loudly, not merge garbage rows."""
     from gubernator_tpu.ops.layout import layout_by_code
     from gubernator_tpu.ops.wire import WIRE_LANES, decode_wire_host
 
@@ -773,6 +787,15 @@ def sync_regions_arrays(req):
                 f"lanes, want {n}×{layout.F} (layout {layout.name})"
             )
         slots = slots.reshape(n, layout.F)
+    cums = None
+    if req.cums:
+        cums = np.frombuffer(req.cums, dtype="<i8")
+        if cums.shape[0] != n:
+            raise ValueError(
+                f"SyncRegionsWireReq: cums buffer holds {cums.shape[0]} "
+                f"entries, want {n}"
+            )
+        cums = cums.astype(np.int64)
     hash_keys = []
     off = 0
     blob = req.strings
@@ -791,6 +814,7 @@ def sync_regions_arrays(req):
         hash_keys,
         slots,
         layout,
+        cums,
     )
 
 

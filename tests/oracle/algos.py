@@ -38,8 +38,17 @@ class GcraOracle:
         burst = burst or limit
         T = max(duration // max(limit, 1), 1)
         tau = T * burst
+        stored = self.tat.get(key)
+        if hits < 0 and (stored is None or stored < now):
+            # miss-release (ops/math.py neg_miss): a return against a key
+            # with no live TAT removes instead of installing — full
+            # bucket, reset 0
+            self.tat.pop(key, None)
+            return (0, burst, 0)
         tat0 = max(self.tat.get(key, now), now)
-        tat1 = tat0 + hits * T
+        # releases rewind the TAT but never below now (the GCRA analog of
+        # the token clamp at `limit`)
+        tat1 = max(tat0 + hits * T, now)
         deny = hits > 0 and tat1 - tau > now
         if deny:
             out = now + tau if drain else tat0
@@ -69,6 +78,11 @@ class SlidingWindowOracle:
         dur = max(duration, 1)
         ws = now - now % dur
         s_ws, s_cur, s_prev = self.state.get(key, (None, 0, 0))
+        if hits < 0 and (s_ws is None or now >= s_ws + 2 * dur):
+            # miss-release: the slot (exp = ws + 2·dur) is gone — remove,
+            # never install fresh state from a return (ops/math.py)
+            self.state.pop(key, None)
+            return (0, limit, 0)
         if s_ws == ws:
             cur, prev = s_cur, s_prev
         elif s_ws == ws - dur:
@@ -78,7 +92,9 @@ class SlidingWindowOracle:
         used = cur + (prev * (dur - (now - ws))) // dur
         deny = hits > 0 and used + hits > limit
         take = 0 if (deny and not drain) else hits
-        cur += take
+        # releases clamp at an empty window — a return can never drive the
+        # stored count negative (remaining past `limit`)
+        cur = max(cur + take, 0)
         self.state[key] = (ws, cur, prev)
         rem = _clip(limit - (used + take), 0, limit)
         return (1 if deny else 0, rem, ws + dur)
@@ -99,6 +115,12 @@ class LeaseOracle:
         inflight, exp = self.state.get(key, (0, None))
         if exp is None or exp < now:  # lazy expiry (exp >= now keeps it live)
             inflight, exp = 0, None
+        if hits < 0 and exp is None:
+            # miss-release: a late release after TTL reclamation (or of a
+            # never-seen key) removes instead of installing — the
+            # miss-safety rule (ops/math.py neg_miss)
+            self.state.pop(key, None)
+            return (0, limit, 0)
         deny = hits > 0 and inflight + hits > limit
         take = 0 if (deny and not drain) else hits
         inflight = max(inflight + take, 0)

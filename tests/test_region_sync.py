@@ -219,7 +219,8 @@ def test_region_codec_split_and_roundtrip():
     assert [k for k, _ in enc] == ["mr_k1"]
     assert [k for k, _ in fb] == ["mr_k2", "mr_k3", "mr_k4", "mr_k5"]
     req = sync_regions_pb(enc, "127.0.0.1:1", "dc-a")
-    fps, deltas, cfg, hks, slots, lay = sync_regions_arrays(req)
+    fps, deltas, cfg, hks, slots, lay, cums = sync_regions_arrays(req)
+    assert cums is None  # no cum ledger passed = pre-dedup shape
     from gubernator_tpu.hashing import fingerprint
 
     assert fps[0] == fingerprint("mr", "k1")
@@ -227,6 +228,133 @@ def test_region_codec_split_and_roundtrip():
     assert int(cfg["limit"][0]) == 100
     assert int(cfg["duration"][0]) == MINUTE
     assert int(cfg["created_at"][0]) == NOW
+
+
+def test_dedup_source_deltas_rules():
+    """The receiver-side ledger math (ops/reconcile.dedup_source_deltas):
+    exact-duplicate skip, partial overlap, sender reset, and the
+    dropped-batch cap — every branch errs toward applying LESS."""
+    from gubernator_tpu.ops.reconcile import (
+        commit_source_cums, dedup_source_deltas,
+    )
+
+    fps = np.array([1, 2, 3, 4], dtype=np.int64)
+    ledger: dict = {}
+    d0 = np.array([5, 3, 7, 2], dtype=np.int64)
+    c0 = np.array([5, 3, 7, 2], dtype=np.int64)
+    assert (dedup_source_deltas(ledger, fps, d0, c0) == d0).all()
+    commit_source_cums(ledger, fps, c0)
+    # exact re-ship: skipped EXACTLY
+    assert (dedup_source_deltas(ledger, fps, d0, c0) == 0).all()
+    # partial overlap: key 1 re-ships 5 old + 4 new (delta 9, cum 9)
+    d1 = np.array([9], dtype=np.int64)
+    c1 = np.array([9], dtype=np.int64)
+    assert dedup_source_deltas(ledger, fps[:1], d1, c1)[0] == 4
+    # dropped-batch gap: cum jumped past delta (sender dropped a batch) —
+    # apply only what THIS batch carries, never fabricate the gap
+    d2 = np.array([2], dtype=np.int64)
+    c2 = np.array([50], dtype=np.int64)
+    assert dedup_source_deltas(ledger, fps[:1], d2, c2)[0] == 2
+    # sender reset (restart / ledger cap): counter went backwards — apply
+    # the delta as shipped and re-baseline
+    d3 = np.array([3], dtype=np.int64)
+    c3 = np.array([3], dtype=np.int64)
+    assert dedup_source_deltas(ledger, fps[:1], d3, c3)[0] == 3
+    commit_source_cums(ledger, fps[:1], c3)
+    assert ledger[1] == 3
+    # no cums (pre-dedup sender): deltas pass through verbatim
+    assert (dedup_source_deltas(ledger, fps, d0, None) == d0).all()
+
+
+@async_test
+async def test_duplicate_delivery_skipped_exactly():
+    """ROADMAP multi-region follow-up (d): a re-shipped batch after a lost
+    ack is skipped EXACTLY by the per-source cumulative counters — the
+    receiver's state is bit-stable under duplicate delivery, not merely
+    under-granting."""
+    from gubernator_tpu.service.wire import (
+        split_region_encodable, sync_regions_pb,
+    )
+
+    c = await Cluster.start(1, dcs=["dc-b"])
+    d = c.daemons[0]
+    try:
+        async def remaining():
+            return (await d.get_rate_limits([pb.RateLimitReq(
+                name="dup", unique_key="k", hits=0, limit=100,
+                duration=MINUTE,
+            )]))[0].remaining
+
+        def batch(hits, cum):
+            it = pb.RateLimitReq(
+                name="dup", unique_key="k", hits=hits, limit=100,
+                duration=MINUTE, behavior=int(Behavior.MULTI_REGION),
+                created_at=d.now_ms(),
+            )
+            enc, fb = split_region_encodable([("dup_k", it)])
+            assert enc and not fb
+            return sync_regions_pb(
+                enc, "sender:1", "dc-a",
+                cums=np.array([cum], dtype=np.int64),
+            )
+
+        req = batch(5, 5)
+        await d.sync_regions_wire(req)
+        assert await remaining() == 95
+        # the lost-ack retry: same batch again, twice
+        await d.sync_regions_wire(req)
+        await d.sync_regions_wire(req)
+        assert await remaining() == 95  # EXACT, not merely ≤
+        assert d.region_manager.dedup_skipped == 10
+        assert d.region_manager.debug()["wire"]["dedup_skipped_hits"] == 10
+        # a requeue FOLDED with fresh hits (delta 5 old + 3 new, cum 8):
+        # only the 3 unseen hits apply
+        await d.sync_regions_wire(batch(8, 8))
+        assert await remaining() == 92
+        # pre-dedup sender (no cums): legacy at-least-once under-grant
+        it = pb.RateLimitReq(
+            name="dup", unique_key="k", hits=2, limit=100,
+            duration=MINUTE, behavior=int(Behavior.MULTI_REGION),
+            created_at=d.now_ms(),
+        )
+        enc, _ = split_region_encodable([("dup_k", it)])
+        legacy = sync_regions_pb(enc, "old:1", "dc-a")
+        await d.sync_regions_wire(legacy)
+        assert await remaining() == 90
+        await d.sync_regions_wire(legacy)
+        assert await remaining() <= 90  # under-grant only, never over
+    finally:
+        await c.stop()
+
+
+@async_test
+async def test_sender_ships_cumulative_counters():
+    """The sender's per-(region, key) cumulative ledger increments at
+    queue time only (requeues don't double-count) and rides every
+    compact-wire batch — two-region traffic converges exactly AND the
+    counters on the wire match the queued totals."""
+    c = await Cluster.start(2, dcs=["dc-a", "dc-b"])
+    a, b = c.daemons
+    try:
+        for hits in (3, 4):
+            r = (await a.get_rate_limits([_mr("ck", hits)]))[0]
+            assert not r.error
+
+        async def landed():
+            return (await b.get_rate_limits(
+                [_mr("ck", 0)]
+            ))[0].remaining == 93
+
+        await wait_for(landed, timeout_s=10)
+        # sender-side cumulative for dc-b reflects every queued hit
+        assert a.region_manager._cum["dc-b"]["mr_ck"] == 7
+        # receiver-side ledger committed the same cum under a's address
+        src_ledgers = b.region_manager._recv_cum
+        assert any(
+            7 in led.values() for led in src_ledgers.values()
+        ), src_ledgers
+    finally:
+        await c.stop()
 
 
 # ---------------------------------------------------------------- e2e layer
