@@ -128,7 +128,8 @@ class Case:
     vs xla on identical traffic)."""
 
     def __init__(self, name, capacity, batches, seed_batches=None, seed_iter=None,
-                 math="mixed", active_counts=None, write=None, layout=None):
+                 math="mixed", active_counts=None, write=None, layout=None,
+                 probe="xla"):
         self.name = name
         from gubernator_tpu.ops.layout import resolve_layout
 
@@ -140,6 +141,10 @@ class Case:
         self.seed_iter = seed_iter  # lazy seeding for huge keyspaces
         self.math = math
         self.write = write or WRITE
+        # table-walk kernel (GUBER_PROBE_KERNEL): "xla" = gather + sweep,
+        # "pallas" = the fused megakernel (ops/pallas_probe.py) — the
+        # probe phase drives both on identical traffic
+        self.probe = probe
         # active rows per staged batch, known host-side at construction
         # (padded cases pass the real counts; fetching active.sum() from the
         # device would cost a serialized tunnel RTT per batch)
@@ -152,7 +157,8 @@ class Case:
 
     def dispatch(self, b):
         self.table, resp, stats = decide2(
-            self.table, b, write=self.write, math=self.math
+            self.table, b, write=self.write, math=self.math,
+            probe=self.probe,
         )
         return stats
 
@@ -193,7 +199,7 @@ class Case:
             t0 = time.perf_counter()
             self.table, acc = decide_loop(
                 self.table, stacked, jnp.int32(k), write=self.write,
-                math=self.math
+                math=self.math, probe=self.probe
             )
             # ONE fetch of the whole counter vector forces the launch chain
             # (per-element int() would pay one tunnel RTT per counter)
@@ -222,7 +228,19 @@ class Case:
         # loop can always reach an acceptable window.
         MIN_DT = 0.15
         K_CAP = 65536  # at the smallest case (~60 us/iter) dt reaches ~4s
-        k_short, k_long = 4, 68
+        # Autotune the short/long split PER CONFIG instead of the fixed
+        # 4/68 the 10M cases were sized for: at the 100M-key config a
+        # 68-iteration window conflates loop-entry overhead with the
+        # table-walk cost it is supposed to isolate (the BENCH_r05 note).
+        # One probe window prices this config's own per-iteration cost;
+        # the short window is sized past launch jitter and the long one
+        # straight to the acceptance floor, and the JSON records the
+        # resolved split + the probe estimate so a recorded rate is
+        # auditable against its window geometry.
+        t_probe, _ = timed(4)
+        per_est = max(t_probe / 4, 1e-5)
+        k_short = max(4, int(0.2 * MIN_DT / per_est) + 1)
+        k_long = k_short + min(K_CAP, int(1.5 * MIN_DT / per_est) + 1)
         for attempt in range(8):
             try:
                 t_short = min(timed(k_short)[0] for _ in range(3))
@@ -243,6 +261,8 @@ class Case:
                     "device_decisions_per_sec": round(s.rate, 1),
                     "device_ms": round(s.per_iter_ms, 3),
                     "device_loop_k": [k_short, k_long],
+                    "device_loop_autotuned": True,
+                    "device_loop_per_iter_probe_ms": round(per_est * 1e3, 3),
                 }
             # size the next window from whatever signal this one carried;
             # 1.5x overshoot on the floor because the per_iter estimate is
@@ -772,6 +792,100 @@ def layout_case(rng, now) -> dict:
     out["capacity_gain"] = round(
         out["full"]["table_bytes"] / out["gcra32"]["table_bytes"], 2
     )
+    return out
+
+
+def probe_case(rng, now) -> dict:
+    """Fused-megakernel phase (ISSUE 14): the XLA gather + sweep/sparse
+    write kernel vs the Pallas probe→decide→write megakernel
+    (GUBER_PROBE_KERNEL, ops/pallas_probe.py) on identical all-GCRA
+    traffic, both slot layouts, at the HBM-bound geometries — TPU: 10M
+    AND 100M live keys (the record-book claim is ≥1.3× device decisions/s
+    at the 100M config); CPU: a 1M-key interpret-mode proxy so the phase
+    stays exercised. HBM bytes/decision is reported per kernel × layout
+    from the roofline model (docs/kernel.md), so the headline number
+    ships with its bandwidth argument attached."""
+    from gubernator_tpu.ops.layout import LAYOUTS
+    from gubernator_tpu.ops.pallas_probe import hbm_bytes_per_decision
+    from gubernator_tpu.ops.table2 import n_buckets_for
+
+    on_tpu = jax.default_backend() == "tpu"
+    sizes = (
+        (
+            ("10M", 10_000_000, 1 << 24, 1 << 17),
+            ("100M", 100_000_000, 1 << 27, 1 << 20),
+        )
+        if on_tpu
+        else (("1M", 1 << 20, 1 << 21, 1 << 14),)
+    )
+    LIMIT, DUR = 16, 86_400_000  # GCRA state stays live across the loop
+    out: dict = {}
+    for label, live, capacity, batch in sizes:
+        keyspace = rng.integers(1, (1 << 63) - 1, size=live, dtype=np.int64)
+        idx = np.unique(
+            rng.integers(0, live, size=batch * 10, dtype=np.int64)
+        )
+        idx = rng.permutation(idx)[: batch * 8]
+        algo = np.full(batch, int(Algorithm.GCRA), dtype=np.int32)
+
+        def batches(idx=idx, keyspace=keyspace, batch=batch, algo=algo):
+            return [
+                jax.device_put(
+                    make_req_batch(
+                        keyspace[idx[i * batch : (i + 1) * batch]], now,
+                        algo=algo, limit=LIMIT, duration=DUR,
+                    )
+                )
+                for i in range(8)
+            ]
+
+        def seed_iter(keyspace=keyspace, live=live, batch=batch, algo=algo):
+            for i in range(0, live, batch):
+                chunk = keyspace[i : i + batch]
+                if chunk.shape[0] < batch:
+                    chunk = np.pad(chunk, (0, batch - chunk.shape[0]))
+                b = make_req_batch(chunk, now, algo=algo, limit=LIMIT,
+                                   duration=DUR)
+                if (chunk == 0).any():
+                    b = b._replace(active=jnp.asarray(chunk != 0))
+                yield jax.device_put(b)
+
+        sz: dict = {"live_keys": live, "batch": batch}
+        rates = {}
+        nb = n_buckets_for(capacity)
+        for lay_name in ("full", "gcra32"):
+            for probe in ("xla", "pallas"):
+                case = Case(
+                    f"probe-{label}-{lay_name}-{probe}", capacity,
+                    batches(), seed_iter=seed_iter, math="gcra",
+                    layout=lay_name, probe=probe,
+                )
+                case.seed()
+                res = case.device_loop()
+                rates[(lay_name, probe)] = res.get(
+                    "device_decisions_per_sec"
+                )
+                sz[f"{lay_name}-{probe}"] = {
+                    **res,
+                    "hbm_bytes_per_decision": round(
+                        hbm_bytes_per_decision(
+                            LAYOUTS[lay_name], batch, nb, WRITE, probe
+                        ),
+                        1,
+                    ),
+                }
+                del case  # release the table before the next HBM claim
+        for lay_name in ("full", "gcra32"):
+            a = rates.get((lay_name, "xla"))
+            b = rates.get((lay_name, "pallas"))
+            if a and b:
+                sz[f"pallas_speedup_{lay_name}"] = round(b / a, 3)
+        out[label] = sz
+    # the record-book acceptance bit lives on the LARGEST geometry; the
+    # CPU proxy records the ratio but claims nothing (interpret mode
+    # prices the movement emulation, not the chip)
+    sp = out[sizes[-1][0]].get("pallas_speedup_full")
+    out["accept_ge_1_3x"] = (bool(sp >= 1.3) if (on_tpu and sp) else None)
     return out
 
 
@@ -2240,6 +2354,16 @@ def main() -> None:
     matrix["layout"] = _attempt(
         "layout",
         lambda: layout_case(np.random.default_rng(55), now),
+    )
+
+    # fused probe-megakernel phase (ISSUE 14): XLA gather+write vs the
+    # Pallas probe→decide→write kernel, both layouts, 10M + 100M keys on
+    # TPU (≥1.3× at 100M is the record-book acceptance bit) with the HBM
+    # bytes/decision roofline attached — docs/kernel.md. Late for the
+    # same HBM-claim reason as the layout phase.
+    matrix["probe"] = _attempt(
+        "probe",
+        lambda: probe_case(np.random.default_rng(56), now),
     )
 
     # multi-region replication phase (ISSUE 12): codec bytes/row (merge

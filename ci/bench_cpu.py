@@ -1689,6 +1689,134 @@ def lease_smoke() -> dict:
     return out
 
 
+def probe_smoke() -> dict:
+    """Fused Pallas probe-kernel gate (ops/pallas_probe.py, interpret
+    mode — the same lowering CPU CI's oracle suite runs):
+
+    * BIT-IDENTITY: both kernels drive the same seeded ~1M-live-key table
+      through the same mixed-algorithm batch sequence; any output-row or
+      table-byte divergence fails the build;
+    * WALL-TIME: the Pallas path must stay within 10% of the XLA path per
+      dispatch at the 1M-key config (interleaved best-of-3, so machine
+      weather cancels) — the interpret movement layer discharges to the
+      same gather/scatter XLA runs, and a regression here means someone
+      re-introduced a per-row loop or a full-table copy into it.
+    """
+    import jax.numpy as jnp
+
+    from gubernator_tpu.ops.kernel2 import decide2_packed_cols
+    from gubernator_tpu.ops.table2 import Table2, new_table2
+
+    B_P = 4096
+    CAP = 1 << 21  # ~1M live keys at ~0.5 load
+    LIVE = 1_000_000
+    rng = np.random.default_rng(23)
+    keys = np.unique(rng.integers(1, (1 << 62), size=LIVE + (LIVE >> 3),
+                                  dtype=np.int64))[:LIVE]
+
+    def arr12(fp, algo, hits, now):
+        n = fp.shape[0]
+        z = np.zeros(n, dtype=np.int64)
+        a = np.stack([
+            fp, algo.astype(np.int64), z, hits,
+            np.full(n, 1 << 16, dtype=np.int64), z,
+            np.full(n, 3_600_000, dtype=np.int64),
+            np.full(n, now, dtype=np.int64),
+            np.full(n, now + 3_600_000, dtype=np.int64), z,
+            np.full(n, 3_600_000, dtype=np.int64),
+            np.ones(n, dtype=np.int64),
+        ])
+        return jnp.asarray(a)
+
+    def batch(i, now, algos=False):
+        fp = keys[(i * B_P) % LIVE:][:B_P]
+        if fp.shape[0] < B_P:
+            fp = keys[:B_P]
+        algo = (
+            np.array([(0, 2, 3, 4)[j % 4] for j in range(B_P)],
+                     dtype=np.int64)
+            if algos else np.zeros(B_P, dtype=np.int64)
+        )
+        hits = rng.integers(0, 3, size=B_P).astype(np.int64)
+        return arr12(fp, algo, hits, now)
+
+    # seed ONCE through the XLA kernel, then hand both kernels identical
+    # table bytes (seeding twice would double the smoke's wall time)
+    t_seed = new_table2(CAP)
+    for i in range(LIVE // B_P):
+        t_seed, out = decide2_packed_cols(
+            t_seed, batch(i, NOW), write="xla", math="token"
+        )
+        if i % 32 == 31:
+            np.asarray(out)
+    rows_np = np.asarray(t_seed.rows)
+    tx = Table2(rows=jnp.asarray(rows_np))
+    tp = Table2(rows=jnp.asarray(rows_np.copy()))
+
+    # ---- parity drive: mixed algorithms over the seeded keyspace
+    mismatches = 0
+    for i in range(24):
+        b = batch(7 * i, NOW + 1_000 * i, algos=True)
+        tx, ox = decide2_packed_cols(tx, b, write="xla", math="int")
+        tp, op = decide2_packed_cols(
+            tp, b, write="xla", math="int", probe="pallas"
+        )
+        if not np.array_equal(np.asarray(ox), np.asarray(op)):
+            mismatches += 1
+    byte_equal = bool(np.array_equal(np.asarray(tx.rows), np.asarray(tp.rows)))
+    out = {"parity_dispatches": 24, "mismatched_dispatches": mismatches,
+           "table_bytes_equal": byte_equal}
+    if mismatches or not byte_equal:
+        print(json.dumps({"error": "probe smoke: pallas/xla divergence",
+                          **out}))
+        sys.exit(1)
+
+    # ---- wall-time: staged batches, reps interleaved so machine weather
+    # hits both kernels alike; best-of-3 per kernel
+    timed = [batch(3 * i, NOW) for i in range(16)]
+    tables = {
+        p: Table2(rows=jnp.asarray(rows_np.copy())) for p in ("xla", "pallas")
+    }
+    walls = {"xla": float("inf"), "pallas": float("inf")}
+    for p in walls:  # compile + warm
+        tables[p], o = decide2_packed_cols(
+            tables[p], timed[0], write="xla", math="token", probe=p
+        )
+        np.asarray(o)
+    for _ in range(3):
+        for p in walls:
+            t = tables[p]
+            t0 = time.perf_counter()
+            for b in timed:
+                t, o = decide2_packed_cols(
+                    t, b, write="xla", math="token", probe=p
+                )
+            np.asarray(o)
+            walls[p] = min(walls[p], time.perf_counter() - t0)
+            tables[p] = t
+
+    xla_ms = walls["xla"] / 16 * 1e3
+    pallas_ms = walls["pallas"] / 16 * 1e3
+    ratio = pallas_ms / xla_ms
+    from gubernator_tpu.ops.layout import FULL
+    from gubernator_tpu.ops.pallas_probe import hbm_bytes_per_decision
+
+    out.update({
+        "xla_ms_per_dispatch": round(xla_ms, 2),
+        "pallas_ms_per_dispatch": round(pallas_ms, 2),
+        "pallas_over_xla": round(ratio, 3),
+        "hbm_bytes_per_decision": {
+            p: round(hbm_bytes_per_decision(FULL, B_P, CAP >> 3, "xla", p), 1)
+            for p in ("xla", "pallas")
+        },
+    })
+    if ratio > 1.10:
+        print(json.dumps({"error": "probe smoke: pallas interpret path "
+                          ">10% over the XLA path", **out}))
+        sys.exit(1)
+    return out
+
+
 def main() -> None:
     eng = LocalEngine(capacity=1 << 15, write_mode="xla")
     rng = np.random.default_rng(0)
@@ -1716,6 +1844,7 @@ def main() -> None:
         "durability_smoke": durability_smoke(),
         "algo_smoke": algo_smoke(),
         "layout_smoke": layout_smoke(),
+        "probe_smoke": probe_smoke(),
         "region_smoke": region_smoke(),
         "lease_smoke": lease_smoke(),
     }))

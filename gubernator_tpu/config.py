@@ -280,6 +280,12 @@ class DaemonConfig:
     # real pod slice, 1 host otherwise). Read by parallel/mesh.make_mesh
     # through the environment, surfaced here for validation + visibility.
     mesh_hosts: int = 0
+    # table-walk kernel for decide dispatches (ops/plan.default_probe_kernel;
+    # GUBER_PROBE_KERNEL): "auto" (= xla until the device record flips it) |
+    # "xla" (row gather + sweep/sparse write) | "pallas" (the fused
+    # double-buffered probe→decide→write megakernel, ops/pallas_probe.py —
+    # interpret-mode on CPU backends)
+    probe_kernel: str = "auto"
     workers: int = 0  # 0 = auto; host-side executor width
 
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
@@ -482,6 +488,11 @@ class DaemonConfig:
             raise ConfigError(
                 "GUBER_MESH_HOSTS must be >= 0 (0 = topology from the runtime)"
             )
+        if self.probe_kernel not in ("auto", "xla", "pallas"):
+            raise ConfigError(
+                f"GUBER_PROBE_KERNEL: must be auto, xla or pallas, got "
+                f"{self.probe_kernel!r}"
+            )
         if self.cache_size <= 0:
             raise ConfigError("GUBER_CACHE_SIZE must be positive")
         if self.behaviors.batch_limit <= 0 or self.behaviors.batch_limit > 1000:
@@ -619,6 +630,7 @@ def setup_daemon_config(
         shard_dedup=_get(env, "GUBER_SHARD_DEDUP", "auto"),
         a2a_impl=_get(env, "GUBER_A2A_IMPL", "auto"),
         mesh_hosts=_get_int(env, "GUBER_MESH_HOSTS", 0),
+        probe_kernel=_get(env, "GUBER_PROBE_KERNEL", "auto"),
         workers=_get_int(env, "GUBER_WORKER_COUNT", 0),
         behaviors=BehaviorConfig(
             batch_timeout_ms=_get_float_ms(env, "GUBER_BATCH_TIMEOUT", 500.0),

@@ -743,8 +743,10 @@ class LocalEngine:
         store=None,
         wire: Optional[str] = None,
         layout: Optional[str] = None,
+        probe: Optional[str] = None,
     ):
         from gubernator_tpu.ops.layout import resolve_layout
+        from gubernator_tpu.ops.plan import default_probe_kernel
         from gubernator_tpu.ops.wire import default_wire_mode
 
         # slot layout (ops/layout.py): "full" (bit-compatible default),
@@ -780,6 +782,15 @@ class LocalEngine:
         # at EVERY batch size 2K-16K vs sweep 4.1-4.9 ms), so it picked a
         # 13× slower path exactly where latency mattered.
         self.write_mode = write_mode or default_write_mode()
+        # table-walk kernel for decide dispatches (GUBER_PROBE_KERNEL /
+        # probe=): "xla" — the row gather + sweep/sparse write every PR
+        # before the megakernel shipped — or "pallas", the fused
+        # probe→decide→write kernel (ops/pallas_probe.py) streaming the
+        # touched rows through VMEM with double-buffered DMA. A static jit
+        # arg like write/math, so both kernels can serve side by side.
+        if probe is not None and probe not in ("xla", "pallas"):
+            raise ValueError(f"probe must be 'xla' or 'pallas', got {probe!r}")
+        self.probe_mode = probe or default_probe_kernel()
         self._decide_fn = decide_fn
         # oracle engines return unpacked outputs; the begin/finish split
         # assumes the packed single-fetch layout
@@ -898,12 +909,12 @@ class LocalEngine:
 
             self.table, packed = decide2_wire_cols(
                 self.table, dev_arr, write=self.write_mode, math=math,
-                cascade=cascade,
+                cascade=cascade, probe=self.probe_mode,
             )
             return packed
         self.table, packed = decide2_packed_cols(
             self.table, dev_arr, write=self.write_mode, math=math,
-            cascade=cascade,
+            cascade=cascade, probe=self.probe_mode,
         )
         return packed
 
@@ -959,7 +970,23 @@ class LocalEngine:
             # engine thread — the only thread allowed to swap the table
             self.migrate_layout_full()
         self._seen_pad_sizes.add(batch_rows)
+        self.last_dispatch_rows = batch_rows
         return self._issue_from_dev(dev, batch_rows, math, wired, cascade)
+
+    def hbm_bytes_per_decision_estimate(self) -> float:
+        """Modeled HBM bytes the table walk moves per decision at the last
+        dispatch geometry (ops/pallas_probe.hbm_bytes_per_decision) — the
+        gubernator_table_hbm_bytes_per_decision gauge and the
+        /v1/debug/pipeline roofline field."""
+        from gubernator_tpu.ops.pallas_probe import hbm_bytes_per_decision
+
+        rows = getattr(self, "last_dispatch_rows", 0)
+        if not rows:
+            rows = max(self._seen_pad_sizes, default=4096)
+        return hbm_bytes_per_decision(
+            self.table.layout, rows, int(self.table.rows.shape[-2]),
+            self.write_mode, getattr(self, "probe_mode", "xla"),
+        )
 
     def finish_staged(self, pending, n: int):
         """Materialize one pass's packed output → ((s, l, r, t, dropped,

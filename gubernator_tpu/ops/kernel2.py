@@ -226,21 +226,35 @@ def sparse_geometry(n_buckets: int, batch: int) -> Tuple[int, int, int]:
     return blk, u, min(nblk, batch)
 
 
-def resolve_write(write: str, n_buckets: int, batch: int) -> str:
+def resolve_write(write: str, n_buckets: int, batch: int, layout=None) -> str:
     """Per-dispatch (static-shape) write-mode resolution. `"sparse"` falls
     back to the full sweep when the worst-case dirty coverage crosses
     GUBER_WRITE_SPARSE_CROSSOVER — a 131K-row headline dispatch on a 1 GiB
     table resolves to the sweep, a 4K serving dispatch to the sparse grid.
     Runs host-side at trace time (batch and table shapes are static), so the
-    jit cache key (the `write` string) stays stable per call site."""
+    jit cache key (the `write` string) stays stable per call site.
+
+    The crossover is BYTE-denominated, not row-denominated: the sweep's
+    cost is the table's bytes streamed through VMEM while the sparse grid's
+    dominant cost is per-block pipeline overhead (byte-count-independent at
+    its small BLK). A packed 32 B layout halves the bytes both sides touch
+    per row but not the sparse grid's per-block overhead, so the coverage
+    fraction where sparse still wins DOUBLES — the worst-case dirty
+    coverage is scaled by layout.F / 16 before the crossover compare, i.e.
+    the knob's value keeps meaning "sparse must touch ≤ 1/crossover of a
+    FULL-layout table's bytes". `layout=None` (or full) preserves the
+    pre-layout behavior bit-for-bit."""
     if write not in ("sweep", "sparse", "xla"):
         raise ValueError(
             f"unknown write mode {write!r}; expected 'sweep', 'sparse' or 'xla'"
         )
     if write != "sparse":
         return write
+    if layout is None:
+        from gubernator_tpu.ops.layout import FULL as layout
     blk, _u, g = sparse_geometry(n_buckets, batch)
-    if g * blk * sparse_write_crossover() >= n_buckets:
+    coverage_bytes_scaled = g * blk * (layout.F / float(F))
+    if coverage_bytes_scaled * sparse_write_crossover() >= n_buckets:
         return "sweep"
     return "sparse"
 
@@ -624,47 +638,19 @@ def _write_xla(rows_tbl, new16, c: Claim2, layout=None):
 # -------------------------------------------------------------------- decide
 
 
-def decide2_impl(
-    table: Table2, req: ReqBatch, *, write: str = "sweep", math: str = "mixed"
-) -> Tuple[Table2, RespBatch, BatchStats]:
-    """Un-jitted v2 kernel body — call through `decide2` / `decide2_xla`.
-
-    `math="token"` compiles the token-only decision graph (no emulated-f64
-    leaky lanes — see ops/math.bucket_math); the engine selects it per
-    dispatch after a host-side check that the batch carries no leaky row.
-    `write="sparse"` resolves per dispatch shape (resolve_write): the
-    block-sparse grid when its coverage is a small fraction of the table,
-    the full sweep otherwise. The table's slot layout (ops/layout.py)
-    threads through the probe gather and the write composition; packed
-    layouts only serve their own math mode — the engine migrates a packed
-    table to full before dispatching off-family traffic, so this guard
-    firing means a caller skipped the engine layer."""
-    layout = table.layout
-    if not layout.supports_math(math):
-        raise ValueError(
-            f"table layout {layout.name!r} cannot serve math={math!r}; "
-            "migrate the table to the full layout first (engine does this "
-            "automatically)"
-        )
-    B = req.fp.shape[0]
-    NB = table.rows.shape[0]
-    write = resolve_write(write, NB, B)
-    if write == "sparse":
-        blk, u, gsteps = sparse_geometry(NB, B)
-    else:
-        blk, u = sweep_geometry(NB, B)
+def decide_payload(lane16, req: ReqBatch, owns, *, math: str):
+    """The per-row DECIDE stage, shared VERBATIM by the XLA path below and
+    the fused Pallas probe kernel (ops/pallas_probe.py): the chosen lane's
+    canonical (B, 16) stored fields + the request rows → (exists, Decision,
+    canonical (B, 16) write-payload rows). Factoring it out is what makes
+    the two probe kernels bit-identical by construction on everything
+    downstream of the claim — algorithm math, payload packing, response
+    fields — instead of by parallel maintenance."""
     now = req.created_at
-    active = req.active
-
-    c = _probe_claim2(table.rows, req.fp, now, active, blk, u, layout)
-
-    # ---- apply: chosen lane's stored state
-    lane16 = jnp.take_along_axis(c.slots, c.chosen[:, None, None], axis=1)[
-        :, 0, :
-    ]  # (B, F)
+    B = req.fp.shape[0]
     g = lambda f: lane16[:, f]
     s_exp = _join64(g(EXP_LO), g(EXP_HI))
-    exists = c.owns & (s_exp >= now)
+    exists = owns & (s_exp >= now)
     s_flags = g(FLAGS)
     stored = StoredState(
         limit=g(LIMIT).astype(i64),
@@ -729,17 +715,16 @@ def decide2_impl(
         ],
         axis=1,
     )  # (B, F)
+    return exists, d, new16
 
-    if write == "sweep":
-        rows_out = _write_sweep(table.rows, new16, c, blk, u, layout)
-    elif write == "sparse":
-        rows_out = _write_sparse(table.rows, new16, c, blk, u, gsteps, layout)
-    else:
-        rows_out = _write_xla(table.rows, new16, c, layout)
 
+def assemble_resp(req: ReqBatch, d, exists, written, evict_live):
+    """Response + stats assembly shared by both probe kernels: the Decision
+    rows plus the claim outcome flags → (RespBatch, BatchStats)."""
+    active = req.active
     OVER = jnp.int32(int(Status.OVER_LIMIT))
     UNDER = jnp.int32(int(Status.UNDER_LIMIT))
-    dropped = active & ~c.written
+    dropped = active & ~written
     resp = RespBatch(
         status=jnp.where(active, d.resp_status, UNDER),
         limit=jnp.where(active, req.limit, i64(0)),
@@ -758,14 +743,81 @@ def decide2_impl(
         cache_hits=exists.sum(dtype=i64),
         cache_misses=(active & ~exists).sum(dtype=i64),
         over_limit=(active & (resp.status == OVER)).sum(dtype=i64),
-        evicted_unexpired=c.evict_live.sum(dtype=i64),
+        evicted_unexpired=evict_live.sum(dtype=i64),
         dropped=dropped.sum(dtype=i64),
     )
+    return resp, stats
+
+
+def decide2_impl(
+    table: Table2, req: ReqBatch, *, write: str = "sweep", math: str = "mixed",
+    probe: str = "xla",
+) -> Tuple[Table2, RespBatch, BatchStats]:
+    """Un-jitted v2 kernel body — call through `decide2` / `decide2_xla`.
+
+    `math="token"` compiles the token-only decision graph (no emulated-f64
+    leaky lanes — see ops/math.bucket_math); the engine selects it per
+    dispatch after a host-side check that the batch carries no leaky row.
+    `write="sparse"` resolves per dispatch shape (resolve_write): the
+    block-sparse grid when its coverage is a small fraction of the table,
+    the full sweep otherwise. The table's slot layout (ops/layout.py)
+    threads through the probe gather and the write composition; packed
+    layouts only serve their own math mode — the engine migrates a packed
+    table to full before dispatching off-family traffic, so this guard
+    firing means a caller skipped the engine layer.
+
+    `probe="pallas"` routes the WHOLE decide path — bucket-row fetch,
+    layout unpack, claim, algorithm math and dirty-row write-back — through
+    the fused double-buffered Pallas megakernel (ops/pallas_probe.py,
+    GUBER_PROBE_KERNEL) instead of the XLA gather + separate sweep/sparse
+    write; `write` is then moot (the megakernel writes its own dirty rows).
+    """
+    layout = table.layout
+    if not layout.supports_math(math):
+        raise ValueError(
+            f"table layout {layout.name!r} cannot serve math={math!r}; "
+            "migrate the table to the full layout first (engine does this "
+            "automatically)"
+        )
+    if probe not in ("xla", "pallas"):
+        raise ValueError(
+            f"unknown probe kernel {probe!r}; expected 'xla' or 'pallas'"
+        )
+    if probe == "pallas":
+        from gubernator_tpu.ops.pallas_probe import decide2_pallas_impl
+
+        return decide2_pallas_impl(table, req, math=math)
+    B = req.fp.shape[0]
+    NB = table.rows.shape[0]
+    write = resolve_write(write, NB, B, layout)
+    if write == "sparse":
+        blk, u, gsteps = sparse_geometry(NB, B)
+    else:
+        blk, u = sweep_geometry(NB, B)
+    now = req.created_at
+    active = req.active
+
+    c = _probe_claim2(table.rows, req.fp, now, active, blk, u, layout)
+
+    # ---- apply: chosen lane's stored state (shared decide stage)
+    lane16 = jnp.take_along_axis(c.slots, c.chosen[:, None, None], axis=1)[
+        :, 0, :
+    ]  # (B, F)
+    exists, d, new16 = decide_payload(lane16, req, c.owns, math=math)
+
+    if write == "sweep":
+        rows_out = _write_sweep(table.rows, new16, c, blk, u, layout)
+    elif write == "sparse":
+        rows_out = _write_sparse(table.rows, new16, c, blk, u, gsteps, layout)
+    else:
+        rows_out = _write_xla(table.rows, new16, c, layout)
+
+    resp, stats = assemble_resp(req, d, exists, c.written, c.evict_live)
     return Table2(rows=rows_out, layout=layout), resp, stats
 
 
 decide2 = functools.partial(
-    jax.jit, donate_argnums=(0,), static_argnames=("write", "math")
+    jax.jit, donate_argnums=(0,), static_argnames=("write", "math", "probe")
 )(decide2_impl)
 
 
@@ -834,9 +886,12 @@ def unpack_outputs(arr, n: int):
 
 
 def decide2_packed_impl(
-    table: Table2, req: ReqBatch, *, write: str = "sweep", math: str = "mixed"
+    table: Table2, req: ReqBatch, *, write: str = "sweep", math: str = "mixed",
+    probe: str = "xla",
 ) -> Tuple[Table2, jnp.ndarray]:
-    table, resp, stats = decide2_impl(table, req, write=write, math=math)
+    table, resp, stats = decide2_impl(
+        table, req, write=write, math=math, probe=probe
+    )
     return table, pack_outputs(resp, stats)
 
 
@@ -862,16 +917,18 @@ def req_from_arr(arr: jnp.ndarray) -> ReqBatch:
 
 def decide2_packed_cols_impl(
     table: Table2, arr: jnp.ndarray, *, write: str = "sweep",
-    math: str = "mixed", cascade: bool = False,
+    math: str = "mixed", cascade: bool = False, probe: str = "xla",
 ) -> Tuple[Table2, jnp.ndarray]:
     """Single-transfer serving entry: packed ingress array in, packed
     output array out — one host→device put and one device→host fetch per
     dispatch regardless of column count. `cascade=True` folds cascade
     groups' combined verdicts into their carrier rows in-trace (set by the
     engine for order-preserving single-device dispatches whose batch
-    carries level bits — see fold_cascade_packed)."""
+    carries level bits — see fold_cascade_packed). `probe` selects the
+    table-walk kernel (GUBER_PROBE_KERNEL): the XLA gather + sweep write,
+    or the fused Pallas megakernel (ops/pallas_probe.py)."""
     table, packed = decide2_packed_impl(
-        table, req_from_arr(arr), write=write, math=math
+        table, req_from_arr(arr), write=write, math=math, probe=probe
     )
     if cascade:
         packed = fold_cascade_packed(packed, arr)
@@ -879,7 +936,8 @@ def decide2_packed_cols_impl(
 
 
 decide2_packed_cols = functools.partial(
-    jax.jit, donate_argnums=(0,), static_argnames=("write", "math", "cascade")
+    jax.jit, donate_argnums=(0,),
+    static_argnames=("write", "math", "cascade", "probe"),
 )(decide2_packed_cols_impl)
 
 
@@ -1064,7 +1122,7 @@ def fold_cascade_packed(packed: jnp.ndarray, arr: jnp.ndarray) -> jnp.ndarray:
 
 def decide2_packed_dedup_impl(
     table: Table2, arr: jnp.ndarray, *, write: str = "sweep",
-    math: str = "mixed", cascade: bool = False,
+    math: str = "mixed", cascade: bool = False, probe: str = "xla",
 ) -> Tuple[Table2, jnp.ndarray]:
     """Single-transfer serving entry with IN-TRACE duplicate aggregation:
     raw (possibly duplicate-keyed) packed ingress in, packed outputs out
@@ -1075,7 +1133,9 @@ def decide2_packed_dedup_impl(
     additionally folds cascade groups' verdicts into their carriers
     (order-preserving traces only — see fold_cascade_packed)."""
     ded, carrier, member = dedup_packed_cols(arr)
-    table, packed = decide2_packed_cols_impl(table, ded, write=write, math=math)
+    table, packed = decide2_packed_cols_impl(
+        table, ded, write=write, math=math, probe=probe
+    )
     packed = fanout_packed(packed, carrier, member, arr.shape[1])
     if cascade:
         packed = fold_cascade_packed(packed, arr)
@@ -1096,7 +1156,7 @@ def install2_impl(
     layout = table.layout
     B = inst.fp.shape[0]
     NB = table.rows.shape[0]
-    write = resolve_write(write, NB, B)
+    write = resolve_write(write, NB, B, layout)
     if write == "sparse":
         blk, u, g = sparse_geometry(NB, B)
     else:
@@ -1231,7 +1291,7 @@ def merge2_impl(
     layout = table.layout
     B = fp.shape[0]
     NB = table.rows.shape[0]
-    write = resolve_write(write, NB, B)
+    write = resolve_write(write, NB, B, layout)
     if write == "sparse":
         blk, u, gsteps = sparse_geometry(NB, B)
     else:

@@ -47,7 +47,7 @@ def stack_batches(batches: List[ReqBatch]) -> ReqBatch:
 
 
 @functools.partial(
-    jax.jit, donate_argnums=(0,), static_argnames=("write", "math")
+    jax.jit, donate_argnums=(0,), static_argnames=("write", "math", "probe")
 )
 def decide_loop(
     table: Table2,
@@ -56,6 +56,7 @@ def decide_loop(
     *,
     write: str = "sweep",
     math: str = "mixed",
+    probe: str = "xla",
 ) -> Tuple[Table2, jnp.ndarray]:
     """Run `k` decide2 dispatches on-device, cycling over the stacked
     batches; returns (table', [hits, misses, over, dropped] i64 totals).
@@ -73,7 +74,9 @@ def decide_loop(
             lambda x: jax.lax.dynamic_index_in_dim(x, i % n, keepdims=False),
             stacked,
         )
-        table, _resp, stats = decide2_impl(table, b, write=write, math=math)
+        table, _resp, stats = decide2_impl(
+            table, b, write=write, math=math, probe=probe
+        )
         acc = acc + jnp.stack(
             [stats.cache_hits, stats.cache_misses, stats.over_limit,
              stats.dropped]

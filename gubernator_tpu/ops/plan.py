@@ -15,16 +15,47 @@ passes:
   OR RESET_REMAINING) and bounds worst-case passes under Zipf-skewed traffic.
 
 For the common all-unique batch this is a single pass with zero copies.
+
+This module also owns the PROBE-KERNEL plan (`probe_kernel_env` /
+`default_probe_kernel`): which table-walk kernel a dispatch compiles —
+the XLA gather + sweep/sparse write, or the fused double-buffered Pallas
+megakernel (ops/pallas_probe.py). Like the pass plan it is a host-side,
+per-engine decision that every dispatch path (local, mesh, wire) inherits
+through the engine's resolved mode.
 """
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import List
 
 import numpy as np
 
 from gubernator_tpu.ops.batch import HostBatch
+
+
+def probe_kernel_env() -> str:
+    """The GUBER_PROBE_KERNEL knob: auto | xla | pallas. Read per engine
+    construction (like GUBER_SLOT_LAYOUT) so a daemon restart picks up a
+    flip without code changes."""
+    v = os.environ.get("GUBER_PROBE_KERNEL", "auto")
+    if v not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"GUBER_PROBE_KERNEL must be auto, xla or pallas, got {v!r}"
+        )
+    return v
+
+
+def default_probe_kernel() -> str:
+    """Resolve the probe-kernel plan: "xla" (the gather + sweep path every
+    PR before this one shipped) unless GUBER_PROBE_KERNEL=pallas opts into
+    the fused megakernel. "auto" stays on xla until the bench `probe`
+    phase records the Pallas path ≥1.3× at the 100M-key config on a real
+    device run (ROADMAP; the CPU interpret path is a parity surface, not
+    a perf one)."""
+    v = probe_kernel_env()
+    return "xla" if v == "auto" else v
 
 
 @dataclass

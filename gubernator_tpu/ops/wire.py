@@ -444,31 +444,33 @@ def unpack_wire_out(arr: np.ndarray, n: int):
 
 
 def decide2_wire_cols_impl(
-    table, carr, *, write="sweep", math="mixed", cascade=False
+    table, carr, *, write="sweep", math="mixed", cascade=False, probe="xla"
 ):
     """Compact single-transfer serving entry: (5, B+1) int32 wire block in,
     (B+2, 4) int32 compact outputs out — the narrow-wire twin of
     kernel2.decide2_packed_cols_impl. `cascade=True` folds cascade verdicts
-    in-trace on the wide packed array BEFORE the egress narrowing."""
+    in-trace on the wide packed array BEFORE the egress narrowing; `probe`
+    selects the table-walk kernel (GUBER_PROBE_KERNEL)."""
     arr12, base = decode_wire_block(carr)
     table, packed = decide2_packed_cols_impl(
-        table, arr12, write=write, math=math, cascade=cascade
+        table, arr12, write=write, math=math, cascade=cascade, probe=probe
     )
     return table, encode_wire_out(packed, base)
 
 
 def decide2_wire_dedup_impl(
-    table, carr, *, write="sweep", math="mixed", cascade=False
+    table, carr, *, write="sweep", math="mixed", cascade=False, probe="xla"
 ):
     """Compact entry with in-trace duplicate aggregation (the mesh
     engines' dedup="device" program built on the narrow wire)."""
     arr12, base = decode_wire_block(carr)
     table, packed = decide2_packed_dedup_impl(
-        table, arr12, write=write, math=math, cascade=cascade
+        table, arr12, write=write, math=math, cascade=cascade, probe=probe
     )
     return table, encode_wire_out(packed, base)
 
 
 decide2_wire_cols = functools.partial(
-    jax.jit, donate_argnums=(0,), static_argnames=("write", "math", "cascade")
+    jax.jit, donate_argnums=(0,),
+    static_argnames=("write", "math", "cascade", "probe"),
 )(decide2_wire_cols_impl)

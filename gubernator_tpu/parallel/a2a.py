@@ -86,7 +86,7 @@ def pair_capacity(c: int, D: int) -> int:
 
 def make_a2a_decide(
     mesh: Mesh, c: int, math: str = "mixed", write=None, dedup: bool = False,
-    wire: bool = False, impl: "str | None" = None,
+    wire: bool = False, impl: "str | None" = None, probe: str = "xla",
 ):
     """Jitted all-shards decide with ON-DEVICE routing: (Table2[D,·],
     (D, 12, c) arrival-order grid, (D, c+2, 4) recycled egress buffer) →
@@ -172,11 +172,11 @@ def make_a2a_decide(
             # carriers; aggregate them before the kernel (its unique-fp
             # contract) and fan the response back to every received row
             table, packed = decide2_packed_dedup_impl(
-                table, local, write=write, math=math
+                table, local, write=write, math=math, probe=probe
             )
         else:
             table, packed = decide2_packed_cols_impl(
-                table, local, write=write, math=math
+                table, local, write=write, math=math, probe=probe
             )
         resp = packed[: D * C].reshape(D, C, 4)
         stats_rows = packed[D * C :]  # (2, 4)

@@ -432,6 +432,7 @@ class GlobalShardedEngine(ShardedEngine):
         wire: Optional[str] = None,
         a2a: Optional[str] = None,
         layout: Optional[str] = None,
+        probe: Optional[str] = None,
     ):
         super().__init__(
             mesh,
@@ -445,6 +446,7 @@ class GlobalShardedEngine(ShardedEngine):
             wire=wire,
             a2a=a2a,
             layout=layout,
+            probe=probe,
         )
         # the replica table + collective step materialize on first GLOBAL
         # use: clustered daemons route GLOBAL over the host peer plane and

@@ -129,7 +129,7 @@ def default_shard_dedup() -> str:
 
 def make_sharded_decide(
     mesh: Mesh, math: str = "mixed", write: Optional[str] = None,
-    dedup: bool = False, wire: bool = False,
+    dedup: bool = False, wire: bool = False, probe: str = "xla",
 ):
     """Build the jitted all-shards decision step over the SINGLE-TRANSFER
     packed layout: (Table2[D,·], (D, 12, b) i64 ingress grid, (D, b+2, 4)
@@ -160,10 +160,14 @@ def make_sharded_decide(
         impl = decide2_packed_dedup_impl if dedup else decide2_packed_cols_impl
         if wire:
             arr12, base = decode_wire_block(arr[0])
-            table, packed = impl(table, arr12, write=write, math=math)
+            table, packed = impl(
+                table, arr12, write=write, math=math, probe=probe
+            )
             packed = encode_wire_out(packed, base)
         else:
-            table, packed = impl(table, arr[0], write=write, math=math)
+            table, packed = impl(
+                table, arr[0], write=write, math=math, probe=probe
+            )
         expand = lambda t: jax.tree.map(lambda x: x[None], t)
         return expand(table), packed[None]
 
@@ -361,8 +365,10 @@ class ShardedEngine:
         wire: Optional[str] = None,
         a2a: Optional[str] = None,
         layout: Optional[str] = None,
+        probe: Optional[str] = None,
     ):
         from gubernator_tpu.ops.layout import resolve_layout
+        from gubernator_tpu.ops.plan import default_probe_kernel
         from gubernator_tpu.ops.wire import default_wire_mode
         from gubernator_tpu.parallel.ring import a2a_impl
 
@@ -409,6 +415,13 @@ class ShardedEngine:
         # None = the backend default (kernel2.resolve_write still falls the
         # sparse mode back to the full sweep per dispatch shape)
         self.write_mode = write_mode or default_write_mode()
+        # table-walk kernel for decide dispatches (GUBER_PROBE_KERNEL):
+        # the per-shard programs thread it into decide2_* unchanged — the
+        # PR-8 shard_map mesh path composes with the Pallas megakernel for
+        # free because the kernel runs per device shard inside shard_map
+        if probe is not None and probe not in ("xla", "pallas"):
+            raise ValueError(f"probe must be 'xla' or 'pallas', got {probe!r}")
+        self.probe_mode = probe or default_probe_kernel()
         # host↔device wire format for decide dispatches and the GLOBAL sync
         # outbox: "compact" ships 5-lane int32 ingress grids + int32 egress
         # (ops/wire.py — the TPU default, GUBER_WIRE_COMPACT), "full" the
@@ -978,7 +991,7 @@ class ShardedEngine:
                 fn = self._decide_fns[key] = make_a2a_decide(
                     self.mesh, staged.c, math=staged.math,
                     write=self.write_mode, dedup=dedup, wire=staged.wire,
-                    impl=self.a2a_impl,
+                    impl=self.a2a_impl, probe=self.probe_mode,
                 )
             rows = staged.c
         else:
@@ -987,7 +1000,7 @@ class ShardedEngine:
             if fn is None:
                 fn = self._decide_fns[key] = make_sharded_decide(
                     self.mesh, math=staged.math, write=self.write_mode,
-                    dedup=dedup, wire=staged.wire,
+                    dedup=dedup, wire=staged.wire, probe=self.probe_mode,
                 )
             rows = staged.b_local
         out_buf = self._take_egress(
@@ -998,9 +1011,22 @@ class ShardedEngine:
 
     def issue_staged(self, staged: "_Staged", batch_rows: int):
         # dispatch count is folded in via the finish delta (engine thread)
+        self.last_dispatch_rows = batch_rows
         table, out = self._decide(self.table, staged)
         self.table = table
         return staged, out
+
+    def hbm_bytes_per_decision_estimate(self) -> float:
+        """Per-shard table-walk bytes/decision at the last dispatch
+        geometry (the LocalEngine twin; rows here are PER-SHARD rows)."""
+        from gubernator_tpu.ops.pallas_probe import hbm_bytes_per_decision
+
+        rows = getattr(self, "last_dispatch_rows", 0) or 4096
+        per_shard = max(1, rows // self.n_shards)
+        return hbm_bytes_per_decision(
+            self.table.layout, per_shard, int(self.table.rows.shape[-2]),
+            self.write_mode, self.probe_mode,
+        )
 
     def finish_staged(self, pending, n: int):
         staged, out = pending

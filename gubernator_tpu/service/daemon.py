@@ -135,12 +135,18 @@ class Daemon:
                 # exchange schedule for device-routed dispatches
                 # (parallel/ring.py; "auto" = ring on TPU backends)
                 a2a=None if conf.a2a_impl == "auto" else conf.a2a_impl,
+                # table-walk kernel (ops/pallas_probe.py; "auto" = xla
+                # until the device bench record flips the default)
+                probe=None if conf.probe_kernel == "auto"
+                else conf.probe_kernel,
             )
         else:
             self.engine = LocalEngine(
                 capacity=conf.cache_size,
                 created_at_tolerance_ms=int(conf.created_at_tolerance_ms),
                 store=store,
+                probe=None if conf.probe_kernel == "auto"
+                else conf.probe_kernel,
             )
         self.runner = EngineRunner(
             self.engine,
@@ -1534,6 +1540,15 @@ class Daemon:
                 "kind": type(eng).__name__,
                 "wire": getattr(eng, "wire", None),
                 "write_mode": getattr(eng, "write_mode", None),
+                # table-walk kernel (GUBER_PROBE_KERNEL) + the modeled HBM
+                # bytes/decision at the current layout × write × geometry —
+                # the live view of gubernator_table_hbm_bytes_per_decision
+                "probe_kernel": getattr(eng, "probe_mode", None),
+                "hbm_bytes_per_decision": (
+                    round(eng.hbm_bytes_per_decision_estimate(), 1)
+                    if hasattr(eng, "hbm_bytes_per_decision_estimate")
+                    else None
+                ),
                 "n_shards": getattr(eng, "n_shards", 1),
                 "n_hosts": getattr(eng, "n_hosts", 1),
                 "devices_per_host": getattr(eng, "devices_per_host", None),

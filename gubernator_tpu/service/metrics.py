@@ -525,6 +525,15 @@ class DaemonMetrics:
             registry=r,
             buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
         )
+        self.table_hbm_bytes_per_decision = Gauge(
+            "gubernator_table_hbm_bytes_per_decision",
+            "Modeled HBM bytes the decide path's table walk moves per "
+            "decision (worst case) at the engine's current slot layout, "
+            "write mode, probe kernel and last dispatch geometry "
+            "(ops/pallas_probe.hbm_bytes_per_decision) — the roofline "
+            "denominator behind the decisions/s record (docs/kernel.md)",
+            registry=r,
+        )
         # --- durability plane (service/checkpoint.py; docs/durability.md):
         # the incremental checkpoint loop's cost, volume, and freshness —
         # kind=delta for epoch frames, kind=base for compactions/shutdown

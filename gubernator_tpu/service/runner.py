@@ -220,6 +220,7 @@ class EngineRunner:
                         time.perf_counter() - t0
                     )
                     self.metrics.observe_engine(self.engine.stats)
+                    self._observe_probe_bytes()
                     # GLOBAL batches ride the pipeline too: without this the
                     # queue-length gauge would only ever be observed post-
                     # drain (sync_global) and read 0 forever
@@ -264,6 +265,14 @@ class EngineRunner:
             if rows > 0:
                 self.metrics.a2a_overflow.labels(impl=impl).inc(rows)
 
+    def _observe_probe_bytes(self) -> None:
+        """Refresh the gubernator_table_hbm_bytes_per_decision gauge from
+        the engine's current layout × write-mode × probe-kernel × dispatch
+        geometry (a few integer ops — the model, not a measurement)."""
+        est = getattr(self.engine, "hbm_bytes_per_decision_estimate", None)
+        if est is not None:
+            self.metrics.table_hbm_bytes_per_decision.set(est())
+
     async def check_columns(
         self, cols: RequestColumns, now_ms: Optional[int] = None
     ) -> ResponseColumns:
@@ -277,6 +286,7 @@ class EngineRunner:
                 self.metrics.dispatch_duration.observe(time.perf_counter() - t0)
                 self._observe_shard_stages()
                 self.metrics.observe_engine(self.engine.stats)
+                self._observe_probe_bytes()
                 gs = getattr(self.engine, "global_stats", None)
                 if gs is not None:
                     self.metrics.observe_global(gs)

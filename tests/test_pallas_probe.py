@@ -1,0 +1,370 @@
+"""Fused Pallas probe→decide→write megakernel suite (ops/pallas_probe.py).
+
+The acceptance surface of the probe tentpole:
+
+* `GUBER_PROBE_KERNEL=pallas` is BIT-IDENTICAL to the XLA gather+write
+  path (`decide2_impl`, the oracle) across all three slot layouts ×
+  all five algorithms × the nasty claim corners — bucket-full eviction,
+  same-target dedup (owner wins), expired-slot reclaim, negative-hit
+  release on a missing key, RESET/DRAIN behaviors, inactive padding —
+  responses, stats AND raw table bytes, through multi-step aging;
+* the carry machinery (bucket runs straddling grid-block boundaries) is
+  exercised with tiny GUBER_PROBE_BLK values and engineered collisions;
+* the knob threads through LocalEngine and the 8-device shard_map mesh
+  (ShardedEngine route/dedup="device") unchanged;
+* the layout-aware sparse-write crossover is pinned at the boundary
+  (packed rows halve bytes → sparse survives to 2× the dirty coverage);
+* the HBM bytes/decision roofline model is monotone and layout-scaled.
+
+Everything runs the interpret-mode lowering (CPU CI), the same execution
+CI's probe_smoke gates.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+from gubernator_tpu.ops.batch import ReqBatch, RequestColumns
+from gubernator_tpu.ops.engine import LocalEngine
+from gubernator_tpu.ops.kernel2 import decide2_impl, resolve_write
+from gubernator_tpu.ops.layout import FULL, GCRA32, TOKEN32
+from gubernator_tpu.ops.pallas_probe import hbm_bytes_per_decision
+from gubernator_tpu.ops.table2 import new_table2
+
+NOW = 1_700_000_000_000
+
+RESP_FIELDS = ("status", "limit", "remaining", "reset_time", "cache_hit",
+               "dropped")
+
+
+def mkreq(rng, n, n_active=None, algos=(0,), hits=None, behavior=0,
+          limit=100, dur=60_000, now=NOW, bucket_pool=None, pool_nb=64,
+          greg=0):
+    """Unique-fp request batch; `bucket_pool` concentrates fps into that
+    many hash buckets of a pool_nb-bucket table (collision pressure)."""
+    n_active = n if n_active is None else n_active
+    if bucket_pool:
+        base = rng.integers(1, pool_nb, size=bucket_pool, dtype=np.int64)
+        fp = base[rng.integers(0, bucket_pool, size=2 * n)] + pool_nb * \
+            rng.integers(1, 1 << 40, size=2 * n, dtype=np.int64)
+    else:
+        fp = rng.integers(1, 1 << 62, size=2 * n, dtype=np.int64)
+    fp = np.unique(fp)
+    while fp.shape[0] < n:
+        fp = np.unique(np.concatenate(
+            [fp, rng.integers(1, 1 << 62, size=n, dtype=np.int64)]
+        ))
+    fp = fp[:n]
+    rng.shuffle(fp)
+    h = (np.asarray(hits, dtype=np.int64) if hits is not None
+         else rng.integers(-2, 4, size=n).astype(np.int64))
+    if h.ndim == 0:
+        h = np.full(n, h, dtype=np.int64)
+    algo = np.array([algos[i % len(algos)] for i in range(n)], dtype=np.int32)
+    return ReqBatch(
+        fp=jnp.asarray(fp),
+        algo=jnp.asarray(algo),
+        behavior=jnp.full(n, behavior, dtype=jnp.int32),
+        hits=jnp.asarray(h),
+        limit=jnp.full(n, limit, dtype=jnp.int64),
+        burst=jnp.full(n, limit, dtype=jnp.int64),
+        duration=jnp.full(n, dur, dtype=jnp.int64),
+        created_at=jnp.full(n, now, dtype=jnp.int64),
+        expire_new=jnp.full(n, now + dur, dtype=jnp.int64),
+        greg_interval=jnp.full(n, greg, dtype=jnp.int64),
+        duration_eff=jnp.full(n, dur, dtype=jnp.int64),
+        active=jnp.asarray(np.arange(n) < n_active),
+    )
+
+
+def assert_parity(cap, req, math="mixed", layout=None, steps=3,
+                  step_ms=20_000):
+    """Drive both probe kernels over the same traffic and assert response,
+    stats and raw-table-byte identity at every step."""
+    tx = new_table2(cap, layout=layout)
+    tp = new_table2(cap, layout=layout)
+    for s in range(steps):
+        r = req._replace(
+            created_at=req.created_at + s * step_ms,
+            expire_new=req.expire_new + s * step_ms,
+        )
+        tx, rx, sx = decide2_impl(tx, r, write="xla", math=math)
+        tp, rp, sp = decide2_impl(tp, r, write="xla", math=math,
+                                  probe="pallas")
+        act = np.asarray(r.active)
+        for f in RESP_FIELDS:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(rx, f)), np.asarray(getattr(rp, f)),
+                err_msg=f"step {s}: RespBatch.{f}",
+            )
+        # aux/rem_store are broadcast-plane echoes, defined for ACTIVE rows
+        # (inactive rows carry deterministic-garbage lanes in both paths)
+        for f in ("aux", "rem_store"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(rx, f))[act],
+                np.asarray(getattr(rp, f))[act],
+                err_msg=f"step {s}: RespBatch.{f}",
+            )
+        for f in sx._fields:
+            assert int(getattr(sx, f)) == int(getattr(sp, f)), \
+                f"step {s}: BatchStats.{f}"
+        np.testing.assert_array_equal(
+            np.asarray(tx.rows), np.asarray(tp.rows),
+            err_msg=f"step {s}: table bytes",
+        )
+
+
+# ------------------------------------------------- algorithm × layout parity
+
+
+@pytest.mark.parametrize("algo,math", [
+    (0, "token"), (1, "mixed"), (2, "gcra"), (3, "int"), (4, "int"),
+])
+def test_parity_per_algorithm(algo, math):
+    rng = np.random.default_rng(algo + 1)
+    assert_parity(512, mkreq(rng, 128, algos=(algo,)), math=math, steps=4)
+
+
+def test_parity_mixed_batch_all_algorithms():
+    rng = np.random.default_rng(42)
+    assert_parity(512, mkreq(rng, 128, algos=(0, 1, 2, 3, 4)), math="mixed",
+                  steps=4)
+
+
+@pytest.mark.parametrize("lay,algo,math", [
+    (GCRA32, 2, "gcra"), (TOKEN32, 0, "token"),
+])
+def test_parity_packed_layouts(lay, algo, math):
+    rng = np.random.default_rng(9)
+    assert_parity(512, mkreq(rng, 128, algos=(algo,)), math=math, layout=lay,
+                  steps=4)
+    # and under collision pressure (carry + eviction on packed rows)
+    req = mkreq(rng, 128, algos=(algo,), bucket_pool=6, pool_nb=16)
+    assert_parity(128, req, math=math, layout=lay, steps=4)
+
+
+# --------------------------------------------------------- claim corners
+
+
+def test_parity_bucket_full_eviction(monkeypatch):
+    """More unique keys per bucket than K=8 lanes: rank-overflow drops,
+    soonest-expiring eviction of LIVE lanes, multi-evict bursts."""
+    monkeypatch.setenv("GUBER_PROBE_BLK", "32")
+    rng = np.random.default_rng(2)
+    req = mkreq(rng, 256, algos=(0, 2), bucket_pool=4, pool_nb=8, hits=1)
+    assert_parity(64, req, math="int", steps=4)
+
+
+def test_parity_same_target_dedup(monkeypatch):
+    """Owner-vs-inserter lane collisions (the sorted-dup rule): aged state
+    makes owners' lanes expired/evictable, so fresh inserters pick them."""
+    monkeypatch.setenv("GUBER_PROBE_BLK", "16")
+    rng = np.random.default_rng(3)
+    req = mkreq(rng, 128, algos=(0,), bucket_pool=8, pool_nb=16,
+                dur=5_000, hits=1)
+    assert_parity(128, req, math="token", steps=5, step_ms=4_000)
+
+
+def test_parity_expired_slot_reclaim():
+    """Steps larger than the duration: every slot expires between steps and
+    is reclaimed through the vacant-first candidate order."""
+    rng = np.random.default_rng(4)
+    req = mkreq(rng, 128, algos=(0, 2, 3, 4), bucket_pool=8, pool_nb=16,
+                dur=5_000, hits=2)
+    assert_parity(128, req, math="int", steps=4, step_ms=30_000)
+
+
+def test_parity_negative_hit_release_on_missing_key():
+    """The PR-13 miss-safety corner: releases against keys with no live
+    state must not install for the extension algorithms."""
+    rng = np.random.default_rng(5)
+    req = mkreq(rng, 128, algos=(2, 3, 4), hits=-3)
+    assert_parity(512, req, math="int", steps=3)
+
+
+def test_parity_reset_and_drain_behaviors():
+    rng = np.random.default_rng(6)
+    assert_parity(
+        512, mkreq(rng, 128, algos=(0, 2), behavior=8), math="int", steps=3
+    )  # RESET_REMAINING removes
+    assert_parity(
+        512, mkreq(rng, 128, algos=(0, 1, 2, 3, 4), behavior=16, hits=60),
+        math="mixed", steps=3,
+    )  # DRAIN_OVER_LIMIT
+    req = mkreq(rng, 128, algos=(0,), behavior=4, hits=1)
+    req = req._replace(greg_interval=jnp.full(128, 86_400_000, jnp.int64))
+    assert_parity(512, req, math="mixed", steps=3)  # Gregorian token rows
+
+
+def test_parity_inactive_padding_rows():
+    rng = np.random.default_rng(7)
+    assert_parity(512, mkreq(rng, 128, n_active=70), math="mixed", steps=3)
+    # all-padding warm batch
+    assert_parity(512, mkreq(rng, 64, n_active=0), math="token", steps=2)
+
+
+def test_parity_block_boundary_carries(monkeypatch):
+    """Bucket runs straddling grid blocks: tiny blocks force multi-block
+    carries, deferred-inserter patches and carry flushes at every shape."""
+    rng = np.random.default_rng(8)
+    for blk in ("8", "16", "64", "1024"):
+        monkeypatch.setenv("GUBER_PROBE_BLK", blk)
+        req = mkreq(rng, 96, n_active=77, algos=(0, 2, 4), bucket_pool=9,
+                    pool_nb=32)
+        assert_parity(256, req, math="int", steps=3)
+    monkeypatch.delenv("GUBER_PROBE_BLK")
+
+
+def test_parity_single_bucket_whole_batch(monkeypatch):
+    """Degenerate carry: EVERY request hashes to one bucket — the run spans
+    every grid block, so the carry lives from block 0 to the last flush."""
+    monkeypatch.setenv("GUBER_PROBE_BLK", "8")
+    rng = np.random.default_rng(10)
+    req = mkreq(rng, 64, algos=(0,), bucket_pool=1, pool_nb=4, hits=1)
+    assert_parity(32, req, math="token", steps=3)
+
+
+# ------------------------------------------------------------- engine layer
+
+
+def cols(fp, algo, hits=1, limit=64, now=NOW):
+    n = fp.shape[0]
+    h = (np.asarray(hits, dtype=np.int64) if np.ndim(hits)
+         else np.full(n, hits, dtype=np.int64))
+    return RequestColumns(
+        fp=fp.astype(np.int64),
+        algo=np.full(n, algo, dtype=np.int32),
+        behavior=np.zeros(n, dtype=np.int32),
+        hits=h,
+        limit=np.full(n, limit, dtype=np.int64),
+        burst=np.zeros(n, dtype=np.int64),
+        duration=np.full(n, 8_000, dtype=np.int64),
+        created_at=np.full(n, now, dtype=np.int64),
+        err=np.zeros(n, dtype=np.int8),
+    )
+
+
+def rc_equal(a, b):
+    for f in ("status", "limit", "remaining", "reset_time", "err"):
+        np.testing.assert_array_equal(getattr(a, f), getattr(b, f),
+                                      err_msg=f)
+
+
+def test_local_engine_probe_parity():
+    """GUBER_PROBE_KERNEL threads through the serving engine: identical
+    responses and identical raw table bytes, wire path included."""
+    rng = np.random.default_rng(11)
+    ex = LocalEngine(capacity=1 << 12, write_mode="xla", probe="xla")
+    ep = LocalEngine(capacity=1 << 12, write_mode="xla", probe="pallas")
+    assert ep.probe_mode == "pallas"
+    fp = rng.integers(1, (1 << 63) - 1, size=512, dtype=np.int64)
+    t = NOW
+    for step in range(3):
+        t += int(rng.integers(500, 4_000))
+        sel = fp.copy()
+        if step == 1:
+            sel[256:] = sel[:256]  # duplicate keys → planner passes
+        c = cols(sel, (0, 2)[step % 2], hits=rng.integers(0, 3, size=512))
+        rc_equal(ex.check_columns(c, now_ms=t), ep.check_columns(c, now_ms=t))
+    np.testing.assert_array_equal(ex.snapshot(), ep.snapshot())
+
+
+def test_probe_env_resolution(monkeypatch):
+    from gubernator_tpu.ops.plan import default_probe_kernel
+
+    monkeypatch.delenv("GUBER_PROBE_KERNEL", raising=False)
+    assert default_probe_kernel() == "xla"  # auto = today's kernel
+    monkeypatch.setenv("GUBER_PROBE_KERNEL", "pallas")
+    assert default_probe_kernel() == "pallas"
+    assert LocalEngine(capacity=1 << 10).probe_mode == "pallas"
+    monkeypatch.setenv("GUBER_PROBE_KERNEL", "bogus")
+    with pytest.raises(ValueError):
+        default_probe_kernel()
+    with pytest.raises(ValueError):
+        LocalEngine(capacity=1 << 10, probe="bogus")
+    with pytest.raises(ValueError):
+        decide2_impl(new_table2(256), mkreq(np.random.default_rng(0), 16),
+                     probe="bogus")
+
+
+def test_config_probe_kernel_validation():
+    from gubernator_tpu.config import ConfigError, DaemonConfig
+
+    conf = DaemonConfig(probe_kernel="pallas")
+    conf.validate()
+    with pytest.raises(ConfigError):
+        DaemonConfig(probe_kernel="nope").validate()
+
+
+def test_sharded_mesh_probe_parity():
+    """The PR-8 shard_map mesh path composes unchanged: the megakernel runs
+    per device shard inside the routed program (8-device CPU mesh,
+    route/dedup=device — the TPU serving defaults)."""
+    from gubernator_tpu.parallel import make_mesh
+    from gubernator_tpu.parallel.sharded import ShardedEngine
+
+    mesh = make_mesh(8)
+    kw = dict(capacity_per_shard=1 << 10, write_mode="xla",
+              route="device", dedup="device")
+    ex = ShardedEngine(mesh, probe="xla", **kw)
+    ep = ShardedEngine(mesh, probe="pallas", **kw)
+    assert ep.probe_mode == "pallas"
+    rng = np.random.default_rng(12)
+    fp = rng.integers(1, (1 << 63) - 1, size=1024, dtype=np.int64)
+    t = NOW
+    for step in range(3):
+        t += int(rng.integers(100, 2_000))
+        sel = fp.copy()
+        if step == 2:
+            sel[512:] = sel[:512]  # duplicates → in-trace dedup carriers
+        c = cols(sel, 2, hits=rng.integers(0, 4, size=1024), limit=32, now=t)
+        rc_equal(ex.check_columns(c, now_ms=t), ep.check_columns(c, now_ms=t))
+    np.testing.assert_array_equal(ex.snapshot(), ep.snapshot())
+
+
+# --------------------------------------- layout-aware write crossover
+
+
+def test_sparse_crossover_is_layout_aware(monkeypatch):
+    """The crossover is byte-denominated: a geometry whose worst-case dirty
+    coverage sits just past the FULL-layout bound still resolves sparse on
+    a 32 B packed layout (half the bytes per row → twice the row budget)."""
+    monkeypatch.setenv("GUBER_WRITE_SPARSE_BLK", "64")
+    monkeypatch.setenv("GUBER_WRITE_SPARSE_CROSSOVER", "4")
+    # batch 128 → g = 128 grid steps × blk = 64 rows = 8192 rows worst-case
+    # dirty coverage. With crossover 4 the sweep fallback fires when
+    # scaled_coverage·4 ≥ NB: full scales ×1 → fires for NB ≤ 32768; packed
+    # ×0.5 → fires only for NB ≤ 16384. NB = 24576 (12 × 2048) sits in the
+    # boundary band where the two layouts DECIDE DIFFERENTLY.
+    nb, batch = 12 * 2048, 128
+    assert resolve_write("sparse", nb, batch, FULL) == "sweep"
+    assert resolve_write("sparse", nb, batch, GCRA32) == "sparse"
+    assert resolve_write("sparse", nb, batch, TOKEN32) == "sparse"
+    # defaulted layout keeps the pre-layout behavior bit-for-bit
+    assert resolve_write("sparse", nb, batch) == "sweep"
+    # far side of the boundary: both layouts agree again
+    assert resolve_write("sparse", 1 << 21, 128, FULL) == "sparse"
+    assert resolve_write("sparse", 1 << 11, 1 << 17, GCRA32) == "sweep"
+
+
+def test_hbm_bytes_per_decision_model():
+    nb, b = 1 << 17, 4096
+    # packed rows halve every term
+    for write in ("sweep", "xla"):
+        full_b = hbm_bytes_per_decision(FULL, b, nb, write)
+        gcra_b = hbm_bytes_per_decision(GCRA32, b, nb, write)
+        assert gcra_b == pytest.approx(full_b / 2)
+    # the fused kernel is batch-proportional: 2 rows/decision worst case
+    assert hbm_bytes_per_decision(FULL, b, nb, "sweep", probe="pallas") == \
+        2 * FULL.row * 4
+    # the sweep amortizes the whole table over the batch; sparse (when it
+    # resolves) touches strictly fewer bytes than the sweep
+    sw = hbm_bytes_per_decision(FULL, b, nb, "sweep")
+    sp = hbm_bytes_per_decision(FULL, b, nb, "sparse")
+    assert sp <= sw
+    assert hbm_bytes_per_decision(FULL, b, nb, "sweep") > \
+        hbm_bytes_per_decision(FULL, 2 * b, nb, "sweep")
