@@ -726,6 +726,124 @@ def leases_case(rng, now) -> dict:
     return out
 
 
+def tiering_case(rng, now) -> dict:
+    """Hot-set tiering phase (ISSUE 15, docs/tiering.md): capacity past
+    the HBM wall. (a) tracked-keys-vs-capacity curve — drive 1×/2×/4×
+    table capacity in tracked keys through a shadow-armed engine and
+    record where the state actually lives (HBM live rows vs shadow rows)
+    plus a zero-over-grant sample check; (b) hot-set decisions/s with
+    tiering armed vs the no-tiering engine on identical Zipf hot-set
+    batches (interleaved best-of-3) — the ≥0.9× acceptance bit belongs
+    to THIS phase on the TPU run (the CPU proxy's serial front end
+    exaggerates the fixed overhead; tier_smoke gates it at 0.85 with the
+    rationale in its docstring). HBM bytes/decision attached per engine
+    from the roofline model (ops/pallas_probe)."""
+    from gubernator_tpu.ops.batch import RequestColumns
+    from gubernator_tpu.tier import ROW_BYTES, ShadowTable
+
+    on_tpu = jax.default_backend() == "tpu"
+    CAP = (1 << 23) if on_tpu else (1 << 20)  # slots: 8M TPU / 1M CPU
+    TRACKED = 4 * CAP                         # 32M TPU / 4M CPU keys
+    BATCH = (1 << 16) if on_tpu else (1 << 13)
+    LIMIT = 12
+    keys = rng.integers(1, (1 << 62), size=TRACKED, dtype=np.int64)
+    keys = np.unique(keys)
+    TRACKED = keys.shape[0]
+
+    def mkcols(fp, t, hits=1):
+        n = fp.shape[0]
+        return RequestColumns(
+            fp=fp, algo=np.zeros(n, dtype=np.int32),
+            behavior=np.zeros(n, dtype=np.int32),
+            hits=np.full(n, hits, dtype=np.int64),
+            limit=np.full(n, LIMIT, dtype=np.int64),
+            burst=np.zeros(n, dtype=np.int64),
+            duration=np.full(n, 86_400_000, dtype=np.int64),
+            created_at=np.full(n, t, dtype=np.int64),
+            err=np.zeros(n, dtype=np.int8),
+        )
+
+    from gubernator_tpu.ops.engine import LocalEngine
+
+    eng = LocalEngine(capacity=CAP)
+    eng.attach_shadow(ShadowTable(max_bytes=TRACKED * ROW_BYTES))
+    t = now
+    curve = []
+    sample = rng.permutation(TRACKED)[:4096]
+    consumed = np.zeros(TRACKED, dtype=np.int64)
+    for mult in (1, 2, 4):
+        hi = min(TRACKED, mult * CAP)
+        lo = 0 if mult == 1 else min(TRACKED, (mult // 2) * CAP)
+        for i in range(lo, hi, BATCH):
+            w = keys[i:i + BATCH]
+            rc = eng.check_columns(mkcols(w, t, hits=3), now_ms=t)
+            ok = (np.asarray(rc.status) == 0) & (rc.err == 0)
+            consumed[i:i + BATCH][ok] += 3
+            t += 7
+        st = eng.shadow.stats()
+        curve.append({
+            "tracked_keys": hi,
+            "tracked_x_capacity": round(hi / CAP, 2),
+            "hbm_live": eng.live_count(t),
+            "shadow_ram_rows": st["ram_rows"],
+            "demoted_evict": st["demoted_evict"],
+            "promoted": st["promoted"],
+        })
+    # zero-over-grant sample: drain each sampled key to its limit
+    over = 0
+    for i in range(0, sample.shape[0], BATCH):
+        si = sample[i:i + BATCH]
+        rc = eng.check_columns(mkcols(keys[si], t, hits=LIMIT), now_ms=t)
+        ok = (np.asarray(rc.status) == 0) & (rc.err == 0)
+        consumed[si[ok]] += LIMIT
+        t += 7
+    over = int((consumed[sample] > LIMIT).sum())
+    out = {
+        "capacity_slots": CAP,
+        "tracked_keys": int(TRACKED),
+        "curve": curve,
+        "over_grant_sample_keys": over,
+        "zero_over_grant": over == 0,
+        "shadow_nominal_bytes": eng.shadow.nominal_bytes,
+    }
+
+    # ---- hot-set rate, tiering vs baseline (identical Zipf batches)
+    HOT = CAP // 8
+    hot = keys[:HOT]
+    zr = np.minimum(rng.zipf(1.05, size=16 * BATCH) - 1, HOT - 1)
+    batches = []
+    tb = t + 10_000_000
+    for i in range(12):
+        batches.append((np.unique(hot[zr[i * BATCH:(i + 1) * BATCH]]), tb))
+        tb += 13
+    rates = {}
+    for tag in ("tiering", "baseline"):
+        if tag == "tiering":
+            e = eng  # already tracks 4× capacity; re-warm the hot set
+        else:
+            e = LocalEngine(capacity=CAP)
+        e.check_columns(mkcols(hot, tb, hits=0), now_ms=tb)
+        for fp, bt in batches[:2]:
+            e.check_columns(mkcols(fp, bt, hits=0), now_ms=bt)
+        rows_total = sum(b[0].shape[0] for b in batches[2:])
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for fp, bt in batches[2:]:
+                e.check_columns(mkcols(fp, bt, hits=0), now_ms=bt)
+            best = min(best, time.perf_counter() - t0)
+        rates[tag] = rows_total / best
+        out[f"hot_set_rate_{tag}"] = round(rates[tag], 1)
+    ratio = rates["tiering"] / rates["baseline"]
+    out["hot_set_ratio"] = round(ratio, 3)
+    out["accept_ge_0_9x"] = bool(ratio >= 0.9)
+    out["hbm_bytes_per_decision"] = round(
+        eng.hbm_bytes_per_decision_estimate(), 1
+    )
+    out["backend"] = jax.default_backend()
+    return out
+
+
 def layout_case(rng, now) -> dict:
     """Packed slot-layout phase (PR 11): device decisions/s for the SAME
     all-GCRA traffic on the full 64 B layout vs the packed 32 B gcra32
@@ -2379,6 +2497,15 @@ def main() -> None:
     matrix["leases"] = _attempt(
         "leases",
         lambda: leases_case(np.random.default_rng(57), now),
+    )
+
+    # hot-set tiering phase (ISSUE 15): tracked-keys-vs-capacity curve on
+    # a shadow-armed engine + hot-set rate vs the no-tiering baseline
+    # (the ≥0.9× acceptance bit on the TPU run) with HBM bytes/decision
+    # attached — docs/tiering.md
+    matrix["tiering"] = _attempt(
+        "tiering",
+        lambda: tiering_case(np.random.default_rng(58), now),
     )
 
     # latency phase (sweep vs sparse vs xla device terms per table size);

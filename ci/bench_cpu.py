@@ -1817,6 +1817,219 @@ def probe_smoke() -> dict:
     return out
 
 
+def tier_smoke() -> dict:
+    """Hot-set tiering gate (ISSUE 15 acceptance, docs/tiering.md):
+
+    (a) **capacity**: ≥4× tracked keys beyond table capacity with ZERO
+        over-grants vs the token-bucket oracle (non-refilling window ⇒
+        per-key admissions ≤ limit) — eviction is a tiering event, not a
+        permissive re-grant. A control run without tiering must
+        over-grant, or the scenario stopped exercising eviction;
+    (b) **hot-set throughput**: Zipf traffic whose hot set lives in HBM
+        must stay within 15% of the no-tiering engine on the SAME
+        batches (interleaved best-of-5). The CPU proxy's serial python
+        front end exaggerates the sidecar/probe overhead a TPU pipeline
+        overlaps — run-to-run machine noise alone swings this ratio
+        ±5%, so the CPU gate carries margin and the ≥0.9× acceptance
+        bit is recorded by the bench `tiering` phase on the device run
+        (the same split as the layout/probe TPU claims);
+    (c) **byte bound**: the shadow's RAM set stays within
+        GUBER_TIER_SHADOW_BYTES with LRU shedding counted.
+    """
+    from gubernator_tpu.tier import ROW_BYTES, ShadowTable
+
+    rng = np.random.default_rng(31)
+    CAP = 1 << 12          # 4096 slots (512 buckets)
+    TRACKED = 4 * CAP      # the ≥4× capacity claim
+    LIMIT = 10
+    keys = np.unique(
+        rng.integers(1, 1 << 62, size=TRACKED + 256, dtype=np.int64)
+    )[:TRACKED]
+
+    def mkcols(fp, now, hits):
+        n = fp.shape[0]
+        return RequestColumns(
+            fp=fp, algo=np.zeros(n, dtype=np.int32),
+            behavior=np.zeros(n, dtype=np.int32),
+            hits=np.full(n, hits, dtype=np.int64),
+            limit=np.full(n, LIMIT, dtype=np.int64),
+            burst=np.zeros(n, dtype=np.int64),
+            duration=np.full(n, 3_600_000, dtype=np.int64),
+            created_at=np.full(n, now, dtype=np.int64),
+            err=np.zeros(n, dtype=np.int8),
+        )
+
+    def drive(eng):
+        adm = np.zeros(TRACKED, dtype=np.int64)
+        t = NOW
+        for _ in range(4):
+            for i in range(0, TRACKED, 2048):
+                rc = eng.check_columns(mkcols(keys[i:i + 2048], t, 3),
+                                       now_ms=t)
+                ok = (rc.status == 0) & (rc.err == 0)
+                adm[i:i + 2048][ok] += 3
+                t += 7
+        return adm
+
+    eng = LocalEngine(capacity=CAP, write_mode="xla")
+    eng.attach_shadow(ShadowTable(max_bytes=TRACKED * ROW_BYTES))
+    adm = drive(eng)
+    over = int((adm > LIMIT).sum())
+    st = eng.shadow.stats()
+    out = {
+        "capacity_slots": CAP,
+        "tracked_keys": TRACKED,
+        "tracked_x_capacity": TRACKED / CAP,
+        "over_granted_keys": over,
+        "demoted_evict": st["demoted_evict"],
+        "promoted": st["promoted"],
+    }
+    if over:
+        print(json.dumps({"error": "tier smoke: over-grants with tiering "
+                          "on (eviction lost state)", **out}))
+        sys.exit(1)
+    if st["demoted_evict"] == 0:
+        print(json.dumps({"error": "tier smoke: no demotions — the drive "
+                          "no longer exercises eviction", **out}))
+        sys.exit(1)
+    ctrl = LocalEngine(capacity=CAP, write_mode="xla")
+    adm_ctrl = drive(ctrl)
+    out["control_over_granted_keys"] = int((adm_ctrl > LIMIT).sum())
+    if out["control_over_granted_keys"] == 0:
+        print(json.dumps({"error": "tier smoke: the no-tiering control "
+                          "did not over-grant", **out}))
+        sys.exit(1)
+
+    # ---- (b) hot-set throughput, interleaved best-of-3. The claim under
+    # test: the tiering MACHINERY (sidecar fetch, shadow probes) costs
+    # the HBM-resident hot set ≤ 10% — so the gate times Zipf-shaped
+    # HOT-SET batches on an engine tracking 4× capacity (cold majority
+    # demoted by the sweep, the TierManager operating point) against the
+    # all-HBM no-tiering baseline. The mixed 90/10 stream — where ~10% of
+    # rows FAULT BACK through the merge, work the baseline skips by
+    # over-granting — is measured and REPORTED (mixed_rate_*), not
+    # gated: paging the tail is the new capability, not overhead.
+    # the hot set is the LAST-seeded slice: the idle reference is the
+    # stored stamp (a token row's window creation — docs/tiering.md
+    # "idle detection"), so the sweep separates hot from cold by
+    # creation order here. Collision-capped at ≤6 keys per bucket so no
+    # bucket hosts > K hot keys (a bucket that does thrashes by
+    # GEOMETRY, tiering or not — the >K pathology docs/tiering.md
+    # bounds); Zipf-shaped draws at the serving plane's coalesced batch
+    # size (unique ~1.7K rows/dispatch).
+    NBUCK = CAP // 8
+    tail = keys[TRACKED - CAP // 2:]
+    per = {}
+    hot_sel = []
+    for k in tail.tolist():
+        b = k % NBUCK
+        if per.get(b, 0) < 6:
+            per[b] = per.get(b, 0) + 1
+            hot_sel.append(k)
+    hot = np.asarray(hot_sel, dtype=np.int64)
+    HOT = hot.shape[0]
+    zr = np.minimum(rng.zipf(1.05, size=80 * 2048) - 1, HOT - 1)
+    t = NOW + 10_000_000
+    hot_batches = []
+    for i in range(16):
+        fp = np.unique(hot[zr[i * 3072:(i + 1) * 3072]])
+        hot_batches.append((fp, t))
+        t += 13
+    mixed_batches = []
+    for i in range(8):
+        h = hot[zr[(16 + i) * 3072:(16 + i) * 3072 + 1844]]
+        cold_draw = keys[:TRACKED - HOT][
+            rng.integers(0, TRACKED - HOT, size=204)
+        ]
+        fp = np.unique(np.concatenate([h, cold_draw]))
+        mixed_batches.append((fp, t))
+        t += 13
+    engines = {}
+    for tag in ("tiering", "baseline"):
+        e = LocalEngine(capacity=CAP, write_mode="xla")
+        tt = NOW + 9_000_000
+        if tag == "tiering":
+            e.attach_shadow(ShadowTable(max_bytes=TRACKED * ROW_BYTES))
+            # seed the COLD majority, then the hot set a beat later —
+            # the idle sweep keys off the stored stamp (a token row's
+            # window creation, docs/tiering.md), so the age gap is what
+            # separates the tiers here
+            cold_keys = keys[:TRACKED - HOT]
+            for i in range(0, cold_keys.shape[0], 2048):
+                e.check_columns(mkcols(cold_keys[i:i + 2048], tt, 1),
+                                now_ms=tt)
+                tt += 7
+            tt += 2_000
+            e.check_columns(mkcols(hot, tt, 1), now_ms=tt)
+            # the cadence sweep a live daemon runs (TierManager):
+            # demotes the cold seed waves, keeps the fresher hot set
+            fps, slots = e.extract_idle(tt + 100, 1_000, max_rows=TRACKED)
+            if fps.shape[0]:
+                e.tombstone_fps(fps)
+                e.shadow.offer(
+                    fps, np.asarray(e.table.layout.unpack(slots)), tt + 100,
+                    reason="idle",
+                )
+        else:
+            e.check_columns(mkcols(hot, tt, 1), now_ms=tt)
+        # warm every compiled shape before timing
+        for fp, bt in hot_batches[:4]:
+            e.check_columns(mkcols(fp, bt, 1), now_ms=bt)
+        engines[tag] = e
+    walls = {"tiering": float("inf"), "baseline": float("inf")}
+    rows_total = sum(b[0].shape[0] for b in hot_batches[4:])
+    for _ in range(5):  # interleaved best-of-5: CI-runner weather cancels
+        for tag, e in engines.items():
+            t0 = time.perf_counter()
+            for fp, bt in hot_batches[4:]:
+                e.check_columns(mkcols(fp, bt, 1), now_ms=bt)
+            walls[tag] = min(walls[tag], time.perf_counter() - t0)
+    rate = {k: rows_total / v for k, v in walls.items()}
+    ratio = rate["tiering"] / rate["baseline"]
+    # mixed 90/10 stream with live fault-backs — reported, not gated
+    # (best-of-3; early reps eat the promote/rehydrate compiles)
+    mixed_rows = sum(b[0].shape[0] for b in mixed_batches)
+    mixed = {}
+    for tag, e in engines.items():
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for fp, bt in mixed_batches:
+                e.check_columns(mkcols(fp, bt, 1), now_ms=bt)
+            best = min(best, time.perf_counter() - t0)
+        mixed[tag] = mixed_rows / best
+    out["mixed_rate_tiering"] = round(mixed["tiering"], 1)
+    out["mixed_rate_baseline"] = round(mixed["baseline"], 1)
+    out["mixed_ratio"] = round(mixed["tiering"] / mixed["baseline"], 3)
+    out.update({
+        "hot_set_rate_tiering": round(rate["tiering"], 1),
+        "hot_set_rate_baseline": round(rate["baseline"], 1),
+        "hot_set_ratio": round(ratio, 3),
+    })
+    if ratio < 0.85:
+        print(json.dumps({"error": "tier smoke: hot-set rate with "
+                          "tiering fell below 0.85x the no-tiering "
+                          "baseline (CPU-proxy gate; the 0.9x claim is "
+                          "the device bench's)", **out}))
+        sys.exit(1)
+
+    # ---- (c) byte bound + LRU shed accounting
+    sh = ShadowTable(max_bytes=64 * ROW_BYTES)
+    fps = np.arange(1, 257, dtype=np.int64)
+    rows = np.zeros((256, 16), dtype=np.int32)
+    rows[:, 0] = fps.astype(np.int32)
+    rows[:, 10] = 1
+    sh.offer(fps, rows, 0)
+    out["shadow_bound_bytes"] = sh.max_bytes
+    out["shadow_nominal_bytes"] = sh.nominal_bytes
+    out["shadow_shed"] = sh.shed
+    if sh.nominal_bytes > sh.max_bytes or sh.shed != 256 - 64:
+        print(json.dumps({"error": "tier smoke: shadow byte bound or "
+                          "shed accounting broken", **out}))
+        sys.exit(1)
+    return out
+
+
 def main() -> None:
     eng = LocalEngine(capacity=1 << 15, write_mode="xla")
     rng = np.random.default_rng(0)
@@ -1847,6 +2060,7 @@ def main() -> None:
         "probe_smoke": probe_smoke(),
         "region_smoke": region_smoke(),
         "lease_smoke": lease_smoke(),
+        "tier_smoke": tier_smoke(),
     }))
 
 

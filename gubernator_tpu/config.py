@@ -343,6 +343,23 @@ class DaemonConfig:
     # delta-log file; default <checkpoint_path>.delta
     checkpoint_delta_path: str = ""
 
+    # --- hot-set tiering (gubernator_tpu/tier/; docs/tiering.md) --------
+    # demote evicted/idle rows to a host-RAM shadow table and fault them
+    # back through the conservative merge — capacity scales with TRACKED
+    # keys while HBM holds the hot set. Off (default) = the pre-tiering
+    # behavior: live evictions silently discard state.
+    tier_enabled: bool = False
+    # rows idle (no update) past this horizon demote out of HBM on the
+    # background sweep (telemetry cadence)
+    tier_idle_ms: float = 60_000.0
+    # RAM budget for the shadow's resident rows (64 B canonical rows);
+    # over-budget rows shed to the spill file when configured, else drop
+    # (counted — exactly today's eviction loss)
+    tier_shadow_bytes: int = 1 << 28
+    # optional spill file (DeltaLog frame format): makes demotions durable
+    # across restarts and lets the shadow overflow RAM losslessly
+    tier_spill_path: str = ""
+
     # background device-table telemetry cadence (ops/telemetry.py; the scan
     # overlaps serving and feeds gubernator_tpu_table_* + /v1/debug/table);
     # 0 disables the loop (the debug endpoint then scans on demand)
@@ -602,6 +619,24 @@ class DaemonConfig:
             raise ConfigError(
                 "GUBER_CHECKPOINT_COMPACT_FRAMES must be >= 1"
             )
+        if self.tier_idle_ms <= 0:
+            raise ConfigError(
+                "GUBER_TIER_IDLE_MS must be positive (the demote-on-idle "
+                "horizon)"
+            )
+        if self.tier_shadow_bytes < 64:
+            raise ConfigError(
+                "GUBER_TIER_SHADOW_BYTES must hold at least one 64 B "
+                "canonical row"
+            )
+        if self.tier_enabled and self.tier_spill_path and not os.path.isdir(
+            os.path.dirname(os.path.abspath(self.tier_spill_path))
+        ):
+            # fail at boot, not at the first sweep: a typo'd spill dir
+            # would silently downgrade durability to RAM-only
+            raise ConfigError(
+                "GUBER_TIER_SPILL_PATH parent directory does not exist"
+            )
 
 
 def setup_daemon_config(
@@ -720,6 +755,12 @@ def setup_daemon_config(
             env, "GUBER_CHECKPOINT_COMPACT_FRAMES", 64
         ),
         checkpoint_delta_path=_get(env, "GUBER_CHECKPOINT_DELTA_PATH", ""),
+        tier_enabled=_get_bool(env, "GUBER_TIER_ENABLED", False),
+        tier_idle_ms=_get_float_ms(env, "GUBER_TIER_IDLE_MS", 60_000.0),
+        tier_shadow_bytes=_get_int(
+            env, "GUBER_TIER_SHADOW_BYTES", 1 << 28
+        ),
+        tier_spill_path=_get(env, "GUBER_TIER_SPILL_PATH", ""),
         telemetry_interval_ms=_get_float_ms(
             env, "GUBER_TELEMETRY_INTERVAL_MS", 5_000.0
         ),

@@ -240,6 +240,126 @@ def _plan(engine, hb):
     return plan_passes(hb, max_exact=engine.max_exact_passes)
 
 
+def shadow_probe(engine, fps: np.ndarray, now_ms: int):
+    """Fault-back probe (hot-set tiering, gubernator_tpu/tier/): exact-
+    match the batch's fingerprints against the host-RAM shadow and REMOVE
+    the hits — (fps, canonical rows) or None. Misses cost one dict lookup
+    per unique fp, the off-hot-path contract; hits must be installed
+    through the conservative merge BEFORE the batch's decide dispatch
+    (promote_rows / PendingCheck.promote)."""
+    shadow = getattr(engine, "shadow", None)
+    if shadow is None:
+        return None
+    pf, rows = shadow.take(fps, now_ms)
+    if pf.shape[0] == 0:
+        return None
+    return pf, rows
+
+
+def promote_rows(engine, promote, now_ms: int):
+    """Install a shadow_probe result into HBM through kernel2.merge2
+    (engine thread — mutates the table). The merge's conservatism is the
+    tiering soundness argument: a stale, duplicated, or raced promote can
+    only UNDER-grant (docs/tiering.md). The closed-state-set discipline:
+    live rows the installs displace demote onward to the shadow
+    (merge2's evictee sidecar), and promote rows whose claim dropped
+    (> K same-bucket inserters in one batch) retry and finally RETURN to
+    the shadow instead of vanishing. Returns (installed_count,
+    putback_fps) — the fingerprints this promote handed BACK to the
+    shadow (returned leftovers + promote-displaced evictees): exactly
+    the rows whose decide this batch may run against absent state, i.e.
+    the miss re-check's eligibility set (_shadow_rehydrate). Rows the
+    DECIDE dispatch itself later evicts are NOT eligible — their decide
+    already served correctly from pre-evict state, and re-dispatching
+    them would apply their hits twice."""
+    if promote is None:
+        return 0, np.empty(0, dtype=np.int64)
+    from gubernator_tpu.ops.layout import FULL
+
+    pf, rows = promote
+    shadow = getattr(engine, "shadow", None)
+    total = 0
+    putback = []
+    for _ in range(max(1, getattr(engine, "max_claim_retries", 3))):
+        n, mask, ev_fps, ev_rows = engine.merge_rows(
+            pf, rows, now_ms=now_ms, layout=FULL, collect=True
+        )
+        total += n
+        if shadow is not None and ev_fps.shape[0]:
+            shadow.offer(ev_fps, ev_rows, now_ms=0, reason="evict")
+            putback.append(ev_fps)
+        if mask.all():
+            pf = pf[:0]
+            break
+        pf, rows = pf[~mask], rows[~mask]
+    if shadow is not None and pf.shape[0]:
+        shadow.offer(pf, rows, now_ms=0, reason="return")
+        putback.append(pf)
+    if not putback:
+        return total, np.empty(0, dtype=np.int64)
+    return total, np.concatenate(putback)
+
+
+def _batch_fps(batch, n: int) -> np.ndarray:
+    """Output-row-aligned fingerprints of a pass batch (HostBatch or the
+    fused front door's lazy wire batch — cheap column view, no pack)."""
+    if isinstance(batch, HostBatch):
+        return np.asarray(batch.fp[:n])
+    return batch.fp_view()[:n]
+
+
+def _shadow_rehydrate(engine, batch, n, outs, active, now, redispatch,
+                      eligible):
+    """Tiering miss re-check (the Store `_rehydrate_misses` pattern):
+    device-reported misses whose state the PROMOTE stage handed back to
+    the shadow (`eligible` = promote_rows' putback fps — returned
+    leftovers and promote-displaced evictees under > K-same-bucket
+    pressure) are promoted through the conservative merge and
+    RE-DISPATCHED, overwriting their phase-1 fresh-grant responses. The
+    phase-1 slot merges with the shadow row (remaining = min), so the
+    corrected response is exact when the shadow state is tighter and
+    conservative otherwise. Eligibility is strictly the promote putback
+    set: a row the DECIDE dispatch itself evicted was served correctly
+    from pre-evict state before landing in the shadow, and re-dispatching
+    it would double-apply its hits. Single-shot: a residual miss (a
+    second >K collision within the re-dispatch itself) keeps its fresh
+    grant, the state stays shadowed for the next batch, and the incident
+    is bounded by one limit (docs/tiering.md). `redispatch(fn)` runs fn
+    on the engine thread and returns its result. Returns
+    (outs, changed)."""
+    shadow = getattr(engine, "shadow", None)
+    if shadow is None or eligible is None or eligible.shape[0] == 0:
+        return outs, False
+    s, l, r, t, dropped, hit = outs
+    miss = ~hit[:n] & active
+    if not miss.any():
+        return outs, False
+    rows = np.nonzero(miss)[0]
+    fps = _batch_fps(batch, n)[rows]
+    has = np.isin(fps, eligible) & shadow.contains(fps)
+    if not has.any():
+        return outs, False
+    # unique-fp contract for the re-dispatch: duplicate-fp rows (mesh
+    # member fan-outs) keep their phase-1 response; the first occurrence
+    # carries the correction
+    sel = np.nonzero(has)[0]
+    _, first = np.unique(fps[sel], return_index=True)
+    fr = rows[sel[np.sort(first)]]
+    sub_fps = _batch_fps(batch, n)[fr]
+
+    def run():
+        promote_rows(engine, shadow_probe(engine, sub_fps, now), now)
+        sub = HostBatch(*[f[fr] for f in batch])
+        return engine._redispatch_rows(sub, len(fr))
+
+    s2, l2, r2, t2, d2, h2 = redispatch(run)
+    m = len(fr)
+    s[fr], l[fr], r[fr], t[fr] = s2[:m], l2[:m], r2[:m], t2[:m]
+    dropped[fr] = d2[:m]
+    hit[fr] = h2[:m]
+    return (s, l, r, t, dropped, hit), True
+
+
 def serve_columns(engine, cols, now_ms, dispatch) -> ResponseColumns:
     """The shared columns-in/columns-out serving loop: pack + clamp-count,
     plan same-key passes, dispatch each (member-row fan-out, ERR_DROPPED for
@@ -253,6 +373,13 @@ def serve_columns(engine, cols, now_ms, dispatch) -> ResponseColumns:
     hb, err = pack_columns(cols, now, tolerance_ms=engine.created_at_tolerance_ms)
     engine.stats.created_at_clamped += int(
         ((cols.created_at != 0) & (hb.created_at != cols.created_at)).sum()
+    )
+    # fault-back (tiering): shadowed keys re-enter HBM through the
+    # conservative merge BEFORE their decide dispatch — this serial path
+    # already runs on the engine thread, so probe + promote inline. The
+    # putback fps feed the miss re-check's eligibility below.
+    _, promote_putback = promote_rows(
+        engine, shadow_probe(engine, hb.fp, now), now
     )
     n = hb.fp.shape[0]
     status = np.zeros(n, dtype=np.int32)
@@ -277,6 +404,14 @@ def serve_columns(engine, cols, now_ms, dispatch) -> ResponseColumns:
             # state (reference algorithms.go:45-51). Only pass 0 can miss:
             # later passes hit what pass 0 created.
             outs = _rehydrate_misses(engine, p.batch, np_, outs, now, dispatch)
+        if pi == 0 and getattr(engine, "shadow", None) is not None:
+            # tiering miss re-check (serial path runs on the engine
+            # thread already — redispatch inline)
+            outs, _ = _shadow_rehydrate(
+                engine, p.batch, np_,
+                outs, np.asarray(p.batch.active[:np_]), now,
+                lambda fn: fn(), promote_putback,
+            )
         s, l, r, t, dropped, _hit = outs
         if p.member_rows:
             # fan the aggregate's response out to every member row
@@ -389,12 +524,12 @@ class PendingCheck:
 
     __slots__ = (
         "hb", "err", "now", "passes", "clamped", "stacked", "rows", "mark",
-        "casc", "casc_intrace",
+        "casc", "casc_intrace", "promote", "promote_putback",
     )
 
     def __init__(
         self, hb, err, now, passes, clamped, rows=None, mark=None,
-        casc=False, casc_intrace=False,
+        casc=False, casc_intrace=False, promote=None,
     ):
         self.stacked = None  # same-shape pass outputs fused for ONE fetch
         self.hb = hb
@@ -415,6 +550,15 @@ class PendingCheck:
         # dropped-row retry invalidated a carrier
         self.casc = casc
         self.casc_intrace = casc_intrace
+        # shadow fault-back rows (tiering): (fps, canonical rows) probed
+        # OUT of the shadow on the prep thread, merged into HBM by
+        # issue_check_columns on the engine thread BEFORE the launches —
+        # the promote-stage ordering that keeps a promoted row's state
+        # ahead of the decide that needs it (races stay conservative)
+        self.promote = promote
+        # fps the promote handed back to the shadow (the miss re-check's
+        # eligibility set — set by issue_check_columns)
+        self.promote_putback = None
 
 
 class _LazyWireBatch:
@@ -450,6 +594,15 @@ class _LazyWireBatch:
 
     def __iter__(self):
         return iter(self._materialize())
+
+    def fp_view(self) -> np.ndarray:
+        """Fingerprint column without materializing the HostBatch (the
+        tiering miss re-check's cheap gate)."""
+        if self._hb is not None:
+            return np.asarray(self._hb.fp)
+        if len(self._parts) == 1:
+            return self._parts[0].fp
+        return np.concatenate([p.fp for p in self._parts])
 
 
 def _padded_rows(batch) -> int:
@@ -534,6 +687,7 @@ def prepare_check_wire(engine, parts, now_ms=None) -> "PendingCheck | None":
     return PendingCheck(
         hb=lazy, err=err, now=now, passes=[[p, n, lazy, staged]],
         clamped=clamped, rows=n, mark=act_fp, casc=casc, casc_intrace=casc,
+        promote=shadow_probe(engine, act_fp, now),
     )
 
 
@@ -572,6 +726,7 @@ def prepare_check_columns(engine, cols, now_ms=None) -> PendingCheck:
     return PendingCheck(
         hb=hb, err=err, now=now, passes=passes, clamped=clamped, mark=hb.fp,
         casc=casc, casc_intrace=casc_intrace,
+        promote=shadow_probe(engine, hb.fp, now),
     )
 
 
@@ -582,6 +737,14 @@ def issue_check_columns(engine, pending: PendingCheck) -> PendingCheck:
     replaced by its pending (un-fetched) output handle."""
     if not isinstance(pending, PendingCheck):  # engine-specific pending
         return engine.issue_pending(pending)
+    if pending.promote is not None:
+        # shadow fault-back lands through the conservative merge BEFORE
+        # this batch's launches (engine thread — merge_rows marks the
+        # checkpoint tracker itself)
+        _, pending.promote_putback = promote_rows(
+            engine, pending.promote, pending.now
+        )
+        pending.promote = None
     if pending.mark is not None and getattr(engine, "ckpt", None) is not None:
         # dirty-block marking for incremental checkpoints: same engine-
         # thread job as the launches below (ops/checkpoint.py contract)
@@ -690,6 +853,21 @@ def finish_check_columns(
             s[rows], l[rows], r[rows], t[rows] = s2, l2, r2, t2
             dropped[rows] = d2
             hit[rows] = h2
+        if pi == 0 and getattr(engine, "shadow", None) is not None:
+            # tiering miss re-check: promote + re-dispatch run on the
+            # engine thread through the same fixup the dropped-claim
+            # retries use. Fused wire batches carry no HostBatch activity
+            # mask — their staged-inactive rows are exactly the error
+            # rows (prepare_check_wire), so err==0 is the mask.
+            if isinstance(batch, HostBatch):
+                act = np.asarray(batch.active[:np_])
+            else:
+                act = (err == 0)[:np_]
+            (s, l, r, t, dropped, hit), changed = _shadow_rehydrate(
+                engine, batch, np_, (s, l, r, t, dropped, hit), act,
+                pending.now, fixup, pending.promote_putback,
+            )
+            retried_any = retried_any or changed
         if p.member_rows:
             members = np.concatenate(p.member_rows)
             src = np.repeat(np.arange(np_), [len(m) for m in p.member_rows])
@@ -808,6 +986,13 @@ class LocalEngine:
         # runs with GUBER_CHECKPOINT_INTERVAL_MS > 0; None = zero marking
         # cost on the serving path
         self.ckpt = None
+        # hot-set tiering (gubernator_tpu/tier/): host-RAM ShadowTable
+        # attached by the daemon's TierManager (or tests). Non-None flips
+        # the dispatch entries' static `evictees` flag — victim rows ride
+        # the fetched outputs home and demote instead of vanishing — and
+        # arms the fault-back probe in the serving paths. None = zero
+        # cost, bit-identical dispatch graphs.
+        self.shadow = None
         self.stats = EngineStats()
         self._seen_pad_sizes: set = set()  # compiled batch shapes (for resize warm)
         # reason string when a failed donated launch left device state
@@ -823,6 +1008,55 @@ class LocalEngine:
         interleave FIFO and no dirtied block falls between epochs."""
         if self.ckpt is not None:
             self.ckpt.mark(np.asarray(fps))
+
+    # --------------------------------------------------------------- tiering
+
+    @property
+    def _evictees(self) -> bool:
+        """Whether dispatches compile the evictee sidecar (a shadow tier
+        is attached; the v1 oracle's unpacked outputs carry no sidecar)."""
+        return self.shadow is not None and self._decide_fn is None
+
+    def attach_shadow(self, shadow) -> None:
+        """Arm hot-set tiering: evict capture + fault-back from `shadow`
+        (tier.ShadowTable). Call before serving — flipping it mid-flight
+        only costs recompiles, the sidecar decode keys off the flag at
+        each dispatch's own issue."""
+        self.shadow = shadow
+
+    def _harvest_evictees(self, host_arr: np.ndarray) -> None:
+        """Demote-on-evict: decode the dispatch's evictee sidecar and
+        append the victim rows to the shadow. `host_arr` must come from a
+        dispatch issued with evictees=True. Runs wherever the output was
+        fetched (engine thread on the serial path, a fetch worker on the
+        pipelined one) — ShadowTable is lock-guarded. Expiry filtering is
+        left to promote time (`take` drops dead rows against the request
+        timeline; wall clock here could disagree with a test's synthetic
+        clock)."""
+        if self.shadow is None:
+            return
+        # the stats row's evicted_unexpired cell gates the decode: the
+        # common hot-set dispatch evicts nothing and pays ONE cell read
+        if int(host_arr[-2, 3]) == 0:
+            return
+        from gubernator_tpu.ops.kernel2 import unpack_evictees
+
+        fps, rows = unpack_evictees(host_arr)
+        if fps.shape[0]:
+            self.shadow.offer(fps, rows, now_ms=0, reason="evict")
+
+    def extract_idle(self, now_ms: int, idle_ms: int,
+                     max_rows: int = 1 << 16):
+        """Live rows idle past `idle_ms`: (fps (N,) i64, slots (N,
+        F_layout) i32), N ≤ max_rows — the demote-on-idle sweep's read
+        half (EngineRunner.tier_demote_idle pairs it with tombstone_fps
+        in ONE engine-thread job so no decide interleaves)."""
+        from gubernator_tpu.ops.table2 import extract_idle_rows
+
+        return extract_idle_rows(
+            self.table.rows, now_ms, idle_ms, layout=self.table.layout,
+            max_rows=max_rows,
+        )
 
     # ---------------------------------------------------------- slot layout
 
@@ -872,11 +1106,16 @@ class LocalEngine:
         if self._batch_needs_full(math, hb):
             self.migrate_layout_full()
         dev, wired = self._stage_ingress(hb)
-        return np.asarray(
+        out = np.asarray(
             self._issue_from_dev(
                 dev, int(hb.fp.shape[0]), math, wired, cascade
             )
         )
+        if self._evictees:
+            # serial path: the fetch happened right here — demote the
+            # victims before the caller decodes responses
+            self._harvest_evictees(out)
+        return out
 
     def _stage_ingress(self, batch: HostBatch):
         """Stage ONE ingress array for a padded batch: the compact wire
@@ -904,17 +1143,18 @@ class LocalEngine:
         """Issue one dispatch from a staged ingress array WITHOUT fetching:
         the table advances immediately; the packed output is fetched later
         on a fetch thread while this thread launches the next dispatch."""
+        ev = self._evictees
         if wired:
             from gubernator_tpu.ops.wire import decide2_wire_cols
 
             self.table, packed = decide2_wire_cols(
                 self.table, dev_arr, write=self.write_mode, math=math,
-                cascade=cascade, probe=self.probe_mode,
+                cascade=cascade, probe=self.probe_mode, evictees=ev,
             )
             return packed
         self.table, packed = decide2_packed_cols(
             self.table, dev_arr, write=self.write_mode, math=math,
-            cascade=cascade, probe=self.probe_mode,
+            cascade=cascade, probe=self.probe_mode, evictees=ev,
         )
         return packed
 
@@ -992,8 +1232,13 @@ class LocalEngine:
         """Materialize one pass's packed output → ((s, l, r, t, dropped,
         hit), (hits, misses, over, evicted), uncounted). The single-device
         kernel probes every row, so `uncounted` is always None here (cf.
-        ShardedEngine's a2a capacity drops)."""
-        outs, st = unpack_outputs(np.asarray(pending), n)
+        ShardedEngine's a2a capacity drops). With a shadow attached the
+        fetched array carries the evictee sidecar — harvested here, on
+        the fetch thread, before the response decode."""
+        arr = np.asarray(pending)
+        if self._evictees:
+            self._harvest_evictees(arr)
+        outs, st = unpack_outputs(arr, n)
         return outs, st, None
 
     def _redispatch_rows(self, batch, n: int, uncounted=None):
@@ -1218,8 +1463,8 @@ class LocalEngine:
 
     def merge_rows(
         self, fps: np.ndarray, slots: np.ndarray,
-        now_ms: Optional[int] = None, layout=None,
-    ) -> int:
+        now_ms: Optional[int] = None, layout=None, collect: bool = False,
+    ):
         """Conservatively merge transferred slot rows (TransferState receive
         path): remaining=min, expiry=max, newest config wins. Returns the
         number of rows merged/installed. `slots` may arrive in any sender
@@ -1228,7 +1473,14 @@ class LocalEngine:
         conservatism is layout-independent. Duplicate fingerprints within
         one call merge as sequential passes — the claim machinery's
         unique-fp contract, same as the serving planner's (a chunk from one
-        extract is always unique, but crossed transfers may not be)."""
+        extract is always unique, but crossed transfers may not be).
+
+        `collect=True` (the tiering promote path — unique fps only)
+        instead returns (count, merged_mask (n,), evictee_fps, evictee
+        canonical rows): the mask says which incoming rows actually
+        landed (a claim-dropped promote must return to the shadow, not
+        vanish) and the evictees are LIVE rows the installs displaced
+        (demoted onward instead of destroyed)."""
         import jax.numpy as jnp
 
         from gubernator_tpu.ops.kernel2 import merge2
@@ -1236,10 +1488,18 @@ class LocalEngine:
 
         n = fps.shape[0]
         if n == 0:
+            if collect:
+                return 0, np.zeros(0, dtype=bool), np.empty(
+                    0, dtype=np.int64
+                ), np.empty((0, 16), dtype=np.int32)
             return 0
         slots = self._slots_to_full(slots, layout)
         rank = _occurrence_rank(fps)
         if rank.max() > 0:
+            if collect:
+                raise ValueError(
+                    "merge_rows(collect=True) requires unique fingerprints"
+                )
             return sum(
                 self.merge_rows(fps[rank == r], slots[rank == r], now_ms)
                 for r in range(int(rank.max()) + 1)
@@ -1255,14 +1515,27 @@ class LocalEngine:
         slots_p[:n] = slots
         active = np.zeros(size, dtype=bool)
         active[:n] = True
-        self.table, merged = merge2(
+        args = (
             self.table,
             jnp.asarray(fp_p),
             jnp.asarray(slots_p),
             jnp.asarray(np.full(size, now, dtype=np.int64)),
             jnp.asarray(active),
-            write=self.write_mode,
         )
+        if collect:
+            self.table, merged, ev = merge2(
+                *args, write=self.write_mode, evictees=True
+            )
+            self.stats.dispatches += 1
+            mask = np.asarray(merged)[:n].copy()
+            ev_h = np.asarray(ev)
+            ev_lo = ev_h[:, 0].astype(np.int64) & 0xFFFFFFFF
+            ev_fp = (ev_h[:, 1].astype(np.int64) << 32) | ev_lo
+            keep = ev_fp != 0
+            return (
+                int(mask.sum()), mask, ev_fp[keep], ev_h[keep].copy()
+            )
+        self.table, merged = merge2(*args, write=self.write_mode)
         self.stats.dispatches += 1
         return int(np.asarray(merged).sum())
 

@@ -751,7 +751,7 @@ def assemble_resp(req: ReqBatch, d, exists, written, evict_live):
 
 def decide2_impl(
     table: Table2, req: ReqBatch, *, write: str = "sweep", math: str = "mixed",
-    probe: str = "xla",
+    probe: str = "xla", evictees: bool = False,
 ) -> Tuple[Table2, RespBatch, BatchStats]:
     """Un-jitted v2 kernel body — call through `decide2` / `decide2_xla`.
 
@@ -771,6 +771,15 @@ def decide2_impl(
     the fused double-buffered Pallas megakernel (ops/pallas_probe.py,
     GUBER_PROBE_KERNEL) instead of the XLA gather + separate sweep/sparse
     write; `write` is then moot (the megakernel writes its own dirty rows).
+
+    `evictees=True` (static — compiled only when a shadow tier is attached,
+    gubernator_tpu/tier/) additionally returns the EVICTEE SIDECAR: a
+    (B, 16) int32 array of the canonical full-width rows the claim
+    displaced (`evict_live` rows' pre-dispatch lane state, zero rows
+    elsewhere) — the state today's eviction silently discards, captured so
+    the engine can demote it to the host-RAM shadow instead. The return
+    grows a 4th element; `evictees=False` keeps the historic 3-tuple and
+    a bit-identical trace.
     """
     layout = table.layout
     if not layout.supports_math(math):
@@ -786,7 +795,7 @@ def decide2_impl(
     if probe == "pallas":
         from gubernator_tpu.ops.pallas_probe import decide2_pallas_impl
 
-        return decide2_pallas_impl(table, req, math=math)
+        return decide2_pallas_impl(table, req, math=math, evictees=evictees)
     B = req.fp.shape[0]
     NB = table.rows.shape[0]
     write = resolve_write(write, NB, B, layout)
@@ -813,11 +822,15 @@ def decide2_impl(
         rows_out = _write_xla(table.rows, new16, c, layout)
 
     resp, stats = assemble_resp(req, d, exists, c.written, c.evict_live)
+    if evictees:
+        ev16 = jnp.where(c.evict_live[:, None], lane16, 0).astype(i32)
+        return Table2(rows=rows_out, layout=layout), resp, stats, ev16
     return Table2(rows=rows_out, layout=layout), resp, stats
 
 
 decide2 = functools.partial(
-    jax.jit, donate_argnums=(0,), static_argnames=("write", "math", "probe")
+    jax.jit, donate_argnums=(0,),
+    static_argnames=("write", "math", "probe", "evictees"),
 )(decide2_impl)
 
 
@@ -844,6 +857,68 @@ def pack_outputs(resp: RespBatch, stats: BatchStats) -> jnp.ndarray:
     )[None, :]
     srow1 = jnp.stack([stats.dropped, z, z, z])[None, :]
     return jnp.concatenate([rows, srow0, srow1], axis=0)
+
+
+# ------------------------------------------------------- evictee sidecar
+#
+# Hot-set tiering (gubernator_tpu/tier/, docs/tiering.md): when a shadow
+# table is attached the decide dispatch also returns the canonical rows it
+# evicted, riding the SAME fetched array as the responses and stats. The
+# sidecar rows are inserted BETWEEN the response rows and the two stats
+# rows, so every existing decoder (`arr[:n]` responses, `arr[-2]` stats)
+# keeps working unchanged; only unpack_evictees knows the middle exists.
+#   int64 packed outputs: each (16,) i32 row rides as 8 int64 lanes
+#     ((hi<<32)|lo over adjacent field pairs) → 2 extra rows of 4 per
+#     request → (3B+2, 4).
+#   int32 compact-wire outputs: raw fields, 4 extra rows of 4 per request
+#     → (5B+2, 4) (slot fields must NOT ride the clamped response
+#     narrowing — they are raw bit patterns).
+
+
+def attach_evictees(packed: jnp.ndarray, ev16: jnp.ndarray) -> jnp.ndarray:
+    """Insert a (B, 16) i32 evictee sidecar into a full-width (B+2, 4)
+    int64 pack_outputs array → (3B+2, 4)."""
+    B = ev16.shape[0]
+    ev64 = _join64(ev16[:, 0::2], ev16[:, 1::2]).reshape(2 * B, 4)
+    return jnp.concatenate([packed[:B], ev64, packed[B:]], axis=0)
+
+
+def attach_evictees_wire(enc: jnp.ndarray, ev16: jnp.ndarray) -> jnp.ndarray:
+    """Insert a (B, 16) i32 evictee sidecar into a compact (B+2, 4) int32
+    egress array → (5B+2, 4) (raw fields, dtype already int32)."""
+    B = ev16.shape[0]
+    return jnp.concatenate(
+        [enc[:B], ev16.reshape(4 * B, 4), enc[B:]], axis=0
+    )
+
+
+def unpack_evictees(arr: np.ndarray):
+    """Host-side sidecar decode: fetched output array (either wire format,
+    evictees attached) → (fps (E,) i64, rows (E, 16) i32 canonical
+    full-width) for the E nonzero-fingerprint evictee rows. The caller
+    must KNOW the dispatch ran with evictees=True — a sidecar-less array
+    is not self-distinguishing (a (3B+2)-row sidecar array and a plain
+    (B'+2)-row array can share a shape)."""
+    arr = np.asarray(arr)
+    if arr.dtype == np.int32:
+        B = (arr.shape[0] - 2) // 5
+        ev = np.ascontiguousarray(arr[B:5 * B]).reshape(B, 16)
+    else:
+        B = (arr.shape[0] - 2) // 3
+        ev64 = np.ascontiguousarray(arr[B:3 * B]).reshape(B, 8)
+        lo_u = ev64 & 0xFFFFFFFF
+        lo = np.where(lo_u >= (1 << 31), lo_u - (1 << 32), lo_u).astype(
+            np.int32
+        )
+        hi = (ev64 >> 32).astype(np.int32)
+        ev = np.empty((B, 16), dtype=np.int32)
+        ev[:, 0::2] = lo
+        ev[:, 1::2] = hi
+    lo_f = ev[:, 0].astype(np.int64) & 0xFFFFFFFF
+    hi_f = ev[:, 1].astype(np.int64)
+    fps = (hi_f << 32) | lo_f
+    keep = fps != 0
+    return fps[keep], ev[keep]
 
 
 # flag bits of pack_outputs' 4th column — the single source of truth for
@@ -887,8 +962,15 @@ def unpack_outputs(arr, n: int):
 
 def decide2_packed_impl(
     table: Table2, req: ReqBatch, *, write: str = "sweep", math: str = "mixed",
-    probe: str = "xla",
-) -> Tuple[Table2, jnp.ndarray]:
+    probe: str = "xla", evictees: bool = False,
+):
+    """(table', packed (B+2, 4) i64[, evictee sidecar (B, 16) i32]) — the
+    sidecar element exists only under evictees=True (see decide2_impl)."""
+    if evictees:
+        table, resp, stats, ev16 = decide2_impl(
+            table, req, write=write, math=math, probe=probe, evictees=True
+        )
+        return table, pack_outputs(resp, stats), ev16
     table, resp, stats = decide2_impl(
         table, req, write=write, math=math, probe=probe
     )
@@ -918,6 +1000,7 @@ def req_from_arr(arr: jnp.ndarray) -> ReqBatch:
 def decide2_packed_cols_impl(
     table: Table2, arr: jnp.ndarray, *, write: str = "sweep",
     math: str = "mixed", cascade: bool = False, probe: str = "xla",
+    evictees: bool = False,
 ) -> Tuple[Table2, jnp.ndarray]:
     """Single-transfer serving entry: packed ingress array in, packed
     output array out — one host→device put and one device→host fetch per
@@ -926,7 +1009,17 @@ def decide2_packed_cols_impl(
     engine for order-preserving single-device dispatches whose batch
     carries level bits — see fold_cascade_packed). `probe` selects the
     table-walk kernel (GUBER_PROBE_KERNEL): the XLA gather + sweep write,
-    or the fused Pallas megakernel (ops/pallas_probe.py)."""
+    or the fused Pallas megakernel (ops/pallas_probe.py). `evictees=True`
+    rides the evictee sidecar home in the same fetched array
+    (attach_evictees; decoded host-side by unpack_evictees)."""
+    if evictees:
+        table, packed, ev16 = decide2_packed_impl(
+            table, req_from_arr(arr), write=write, math=math, probe=probe,
+            evictees=True,
+        )
+        if cascade:
+            packed = fold_cascade_packed(packed, arr)
+        return table, attach_evictees(packed, ev16)
     table, packed = decide2_packed_impl(
         table, req_from_arr(arr), write=write, math=math, probe=probe
     )
@@ -937,7 +1030,7 @@ def decide2_packed_cols_impl(
 
 decide2_packed_cols = functools.partial(
     jax.jit, donate_argnums=(0,),
-    static_argnames=("write", "math", "cascade", "probe"),
+    static_argnames=("write", "math", "cascade", "probe", "evictees"),
 )(decide2_packed_cols_impl)
 
 
@@ -1262,8 +1355,9 @@ install2 = functools.partial(
 
 
 def merge2_impl(
-    table: Table2, fp, slots, now, active, *, write: str = "xla"
-) -> Tuple[Table2, jnp.ndarray]:
+    table: Table2, fp, slots, now, active, *, write: str = "xla",
+    evictees: bool = False,
+):
     """Conservative merge of transferred table slots (the TransferState
     receive path, docs/robustness.md "Topology change & drain").
 
@@ -1287,7 +1381,13 @@ def merge2_impl(
     Absent keys install the incoming slot verbatim (claim/evict machinery
     shared with install2). Incoming rows already expired at the receiver's
     clock are dropped — stale state must not resurrect. Returns
-    (table', merged_mask)."""
+    (table', merged_mask).
+
+    `evictees=True` (static — the tiering promote path) additionally
+    returns the (B, 16) i32 canonical rows of LIVE entries this merge's
+    installs displaced, so a shadow fault-back that lands in a full
+    bucket demotes the victim instead of silently destroying it — the
+    invariant that makes HBM + shadow a closed state set."""
     layout = table.layout
     B = fp.shape[0]
     NB = table.rows.shape[0]
@@ -1396,9 +1496,12 @@ def merge2_impl(
         rows_out = _write_sparse(table.rows, new16, c, blk, u, gsteps, layout)
     else:
         rows_out = _write_xla(table.rows, new16, c, layout)
+    if evictees:
+        ev16 = jnp.where(c.evict_live[:, None], lane16, 0).astype(i32)
+        return Table2(rows=rows_out, layout=layout), active & c.written, ev16
     return Table2(rows=rows_out, layout=layout), active & c.written
 
 
 merge2 = functools.partial(
-    jax.jit, donate_argnums=(0,), static_argnames=("write",)
+    jax.jit, donate_argnums=(0,), static_argnames=("write", "evictees")
 )(merge2_impl)

@@ -194,6 +194,35 @@ class SlotLayout:
         out = self.pack(rows_full.reshape(shape + (K, 16)))
         return out.reshape(shape + (K * self.F,))
 
+    def idle_ref(self, slots):
+        """Per-slot last-activity reference (ms, int64) for the tiering
+        idle sweep (gubernator_tpu/tier/): the stored stamp (UpdatedAt)
+        when the layout keeps one, else ``exp - duration`` — exact for
+        token32 (the pack derives the stamp the same way) and the best
+        available proxy for gcra32 (the stamp is dropped; TAT-duration
+        under-estimates activity, which only makes the sweep demote
+        LATER, never wrongly expire state — demote/fault-back is
+        correctness-preserving either way). Works on numpy and traced
+        arrays ((…, F) slot fields in THIS layout)."""
+        xp = _xp(slots)
+        i64 = xp.int64
+        p = lambda i: slots[..., i]
+        exp = (p(self.exp_hi_i).astype(i64) << 32) | (
+            p(self.exp_lo_i).astype(i64) & 0xFFFFFFFF
+        )
+        if self is FULL:
+            dur_hi = p(_DUR_HI)
+        else:
+            dur_hi = p(7) & _DUR_HI_MASK
+        dur = (dur_hi.astype(i64) << 32) | (p(_DUR_LO).astype(i64) & 0xFFFFFFFF)
+        ref = exp - dur
+        if self is FULL:
+            stamp = (p(_STAMP_HI).astype(i64) << 32) | (
+                p(_STAMP_LO).astype(i64) & 0xFFFFFFFF
+            )
+            ref = xp.where(stamp != 0, stamp, ref)
+        return ref
+
     # ---------------------------------------------------------- predicates
 
     def supports_math(self, math: str) -> bool:

@@ -65,6 +65,7 @@ from gubernator_tpu.ops.batch import BatchStats, ReqBatch, RespBatch
 from gubernator_tpu.ops.kernel2 import (
     _biased,
     _hi32,
+    _join64,
     _lo32,
     _sweep_x64_ctx,
     assemble_resp,
@@ -92,6 +93,13 @@ if _ANY is None:  # jax 0.4.x spells it TPUMemorySpace.ANY
 _OC_STATUS, _OC_REM, _OC_RESET, _OC_EXISTS = 0, 1, 2, 3
 _OC_WRITTEN, _OC_EVICT, _OC_AUX, _OC_REMSTORE = 4, 5, 6, 7
 _OUTW = 8
+# with evictees=True the out rows widen by 8 int64 lanes carrying the
+# CANDIDATE victim row (the claimed lane's pre-dispatch canonical 16
+# fields as (hi<<32)|lo pairs). Deferred inserters' candidates ride the
+# carry machinery untouched (the patch only flips _OC_WRITTEN/_OC_EVICT),
+# and the epilogue masks candidates by the FINAL _OC_EVICT verdict — so
+# a carried inserter killed by a later owner emits no victim row.
+_OUTW_EV = 16
 
 
 def probe_blk(batch: int) -> int:
@@ -236,7 +244,7 @@ def _sorted_schedule(req: ReqBatch, NB: int, rblk: int):
 
 
 def _make_probe_kernel(layout, rblk: int, NB: int, G: int, math: str,
-                       interp: bool):
+                       interp: bool, evictees: bool = False):
     """Kernel factory (closes over static geometry + layout + math mode).
 
     Scratch protocol (persists across grid steps):
@@ -548,6 +556,15 @@ def _make_probe_kernel(layout, rblk: int, NB: int, G: int, math: str,
             ],
             axis=1,
         )  # (rblk, _OUTW)
+        if evictees:
+            # candidate victim row (pre-dispatch claimed-lane state); the
+            # FINAL verdict is the patched _OC_EVICT — epilogue masks
+            ev16 = jnp.where(
+                (claim_ok & lane_live)[:, None], lane16, 0
+            ).astype(i32)
+            outb = jnp.concatenate(
+                [outb, _join64(ev16[:, 0::2], ev16[:, 1::2])], axis=1
+            )  # (rblk, _OUTW_EV)
         if interp:
             resp_out[pl.ds(g * i32(rblk), rblk)] = outb
         else:
@@ -692,17 +709,22 @@ def _make_probe_kernel(layout, rblk: int, NB: int, G: int, math: str,
 
 
 def decide2_pallas_impl(
-    table: Table2, req: ReqBatch, *, math: str = "mixed"
-) -> Tuple[Table2, RespBatch, BatchStats]:
+    table: Table2, req: ReqBatch, *, math: str = "mixed",
+    evictees: bool = False,
+):
     """Fused-megakernel twin of `kernel2.decide2_impl` (reached through its
     ``probe="pallas"`` switch — call sites never import this directly).
     Same signature contract: (table', RespBatch, BatchStats), decision-
-    bit-identical modulo the sweep-window divergence documented above."""
+    bit-identical modulo the sweep-window divergence documented above.
+    ``evictees=True`` (static) widens the out rows by the candidate-victim
+    lanes (_OUTW_EV) and returns a 4th element: the (B, 16) i32 evictee
+    sidecar, victim rows where the final evict verdict holds."""
     layout = table.layout
     NB = table.rows.shape[0]
     B = req.fp.shape[0]
     rblk = probe_blk(B)
     idx_s, arr12_s, meta, sb, bkf, G = _sorted_schedule(req, NB, rblk)
+    outw = _OUTW_EV if evictees else _OUTW
 
     interpret = jax.default_backend() == "cpu"
     if interpret:
@@ -715,14 +737,14 @@ def decide2_pallas_impl(
             jax.ShapeDtypeStruct((B, layout.F), jnp.int32),  # pay
             jax.ShapeDtypeStruct((1, G), jnp.int32),  # ctgt
             jax.ShapeDtypeStruct((G, layout.row), jnp.int32),  # crows
-            jax.ShapeDtypeStruct((B, _OUTW), jnp.int64),  # resp
+            jax.ShapeDtypeStruct((B, outw), jnp.int64),  # resp
         )
         out_specs = [pl.BlockSpec(memory_space=_ANY)] * 5
         aliases = {}
     else:
         out_shape = (
             jax.ShapeDtypeStruct(table.rows.shape, table.rows.dtype),
-            jax.ShapeDtypeStruct((B, _OUTW), jnp.int64),
+            jax.ShapeDtypeStruct((B, outw), jnp.int64),
         )
         out_specs = [pl.BlockSpec(memory_space=_ANY)] * 2
         aliases = {5: 0}
@@ -738,14 +760,14 @@ def decide2_pallas_impl(
         out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((2, rblk, layout.row), jnp.int32),  # fbuf
-            pltpu.VMEM((rblk, _OUTW), jnp.int64),  # obuf
+            pltpu.VMEM((rblk, outw), jnp.int64),  # obuf
             pltpu.VMEM((1, layout.row), jnp.int32),  # cstage
-            pltpu.VMEM((K, _OUTW), jnp.int64),  # pstage
+            pltpu.VMEM((K, outw), jnp.int64),  # pstage
             pltpu.VMEM((1, layout.row), jnp.int32),  # crow
             pltpu.VMEM((K, layout.F), jnp.int32),  # cop
             pltpu.VMEM((K, layout.F), jnp.int32),  # cip
             pltpu.VMEM((2, K), jnp.int32),  # cmask
-            pltpu.VMEM((K, _OUTW), jnp.int64),  # cdo
+            pltpu.VMEM((K, outw), jnp.int64),  # cdo
             pltpu.VMEM((4, K), jnp.int32),  # cdmeta
             pltpu.SMEM((8,), jnp.int32),  # cscal
             pltpu.SemaphoreType.DMA,  # fsem
@@ -756,7 +778,8 @@ def decide2_pallas_impl(
     )
     with _sweep_x64_ctx(interpret):
         outs = pl.pallas_call(
-            _make_probe_kernel(layout, rblk, NB, G, math, interpret),
+            _make_probe_kernel(layout, rblk, NB, G, math, interpret,
+                               evictees),
             interpret=interpret,
             out_shape=out_shape,
             grid_spec=grid_spec,
@@ -779,7 +802,7 @@ def decide2_pallas_impl(
         rows_out, resp_s = outs
 
     # un-sort the response rows back to batch order
-    out = jnp.zeros((B, _OUTW), dtype=i64).at[idx_s].set(resp_s)
+    out = jnp.zeros((B, outw), dtype=i64).at[idx_s].set(resp_s)
     d_like = SimpleNamespace(
         resp_status=out[:, _OC_STATUS].astype(i32),
         resp_rem=out[:, _OC_REM],
@@ -791,11 +814,18 @@ def decide2_pallas_impl(
     written = out[:, _OC_WRITTEN] != 0
     evict_live = out[:, _OC_EVICT] != 0
     resp, stats = assemble_resp(req, d_like, exists, written, evict_live)
+    if evictees:
+        evcols = out[:, _OUTW:]  # (B, 8) i64 candidate victim pairs
+        ev16 = jnp.stack(
+            [_lo32(evcols), _hi32(evcols)], axis=-1
+        ).reshape(B, 16)
+        ev16 = jnp.where(evict_live[:, None], ev16, 0)
+        return Table2(rows=rows_out, layout=layout), resp, stats, ev16
     return Table2(rows=rows_out, layout=layout), resp, stats
 
 
 decide2_pallas = functools.partial(
-    jax.jit, donate_argnums=(0,), static_argnames=("math",)
+    jax.jit, donate_argnums=(0,), static_argnames=("math", "evictees")
 )(decide2_pallas_impl)
 
 

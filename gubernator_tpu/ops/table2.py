@@ -213,6 +213,68 @@ def extract_live_rows(rows, now_ms: int, layout=None):
     )
 
 
+def _extract_idle_core(rows2d, now_ms, idle_ms, layout):
+    """Traced core of the tiering idle sweep (gubernator_tpu/tier/):
+    live slots whose last-activity reference (layout.idle_ref — stamp, or
+    exp-duration for layouts that drop it) is at least `idle_ms` behind
+    `now_ms`, sorted to the front. `rows2d` is (T, ROW_layout); returns
+    (slots (T·K, F_layout) idle-first, fp (T·K,), idle_count) — slots stay
+    in the table's own layout, the demote path unpacks only the fetched
+    prefix. Shared by the single-array jit below and the per-shard
+    shard_map body (parallel/sharded.make_sharded_extract_idle)."""
+    slots = rows2d.reshape(-1, layout.F)
+    lo = slots[:, FP_LO].astype(jnp.int64) & 0xFFFFFFFF
+    hi = slots[:, FP_HI].astype(jnp.int64)
+    fp = (hi << 32) | lo
+    exp = (slots[:, layout.exp_lo_i].astype(jnp.int64) & 0xFFFFFFFF) | (
+        slots[:, layout.exp_hi_i].astype(jnp.int64) << 32
+    )
+    live = (fp != 0) & (exp >= now_ms)
+    idle = live & ((now_ms - layout.idle_ref(slots)) >= idle_ms)
+    order = jnp.argsort(jnp.where(idle, 0, 1).astype(jnp.int32))
+    return slots[order], fp[order], idle.sum()
+
+
+@functools.partial(jax.jit, static_argnames=("layout",))
+def _extract_idle_sorted(rows, now_ms, idle_ms, *, layout):
+    """Single-array entry: any (..., ROW_layout) rows array (the flatten
+    folds a shard axis in, like _extract_sorted)."""
+    return _extract_idle_core(
+        rows.reshape(-1, layout.row), now_ms, idle_ms, layout
+    )
+
+
+def extract_idle_rows(rows, now_ms: int, idle_ms: int, layout=None,
+                      max_rows: int = 1 << 16):
+    """Idle-past-the-horizon live slots of a device-resident rows array:
+    (fps (N,) i64, slots (N, F_layout) i32) host copies, N ≤ max_rows (the
+    per-sweep demote cap — bounds the engine-thread job; the remainder
+    stays for the next sweep). The filter + pack runs on-device; the host
+    fetches only the idle prefix (the extract_live_rows fetch rule)."""
+    if layout is None:
+        from gubernator_tpu.ops.layout import layout_for_row
+
+        layout = layout_for_row(int(rows.shape[-1]))
+    slots_s, fp_s, cnt = _extract_idle_sorted(
+        rows, jnp.asarray(np.int64(now_ms)), jnp.asarray(np.int64(idle_ms)),
+        layout=layout,
+    )
+    n = min(int(cnt), int(max_rows))
+    if n == 0:
+        return (
+            np.empty(0, dtype=np.int64),
+            np.empty((0, layout.F), dtype=np.int32),
+        )
+    pad = 256
+    while pad < n:
+        pad *= 2
+    pad = min(pad, int(fp_s.shape[0]))
+    return (
+        np.asarray(fp_s[:pad])[:n].copy(),
+        np.asarray(slots_s[:pad])[:n].copy(),
+    )
+
+
 def gather_slots_impl(rows: jnp.ndarray, fp: jnp.ndarray,
                       active: jnp.ndarray, layout=None):
     """Read the slots holding each fingerprint WITHOUT mutating anything:

@@ -444,14 +444,32 @@ def unpack_wire_out(arr: np.ndarray, n: int):
 
 
 def decide2_wire_cols_impl(
-    table, carr, *, write="sweep", math="mixed", cascade=False, probe="xla"
+    table, carr, *, write="sweep", math="mixed", cascade=False, probe="xla",
+    evictees=False,
 ):
     """Compact single-transfer serving entry: (5, B+1) int32 wire block in,
     (B+2, 4) int32 compact outputs out — the narrow-wire twin of
     kernel2.decide2_packed_cols_impl. `cascade=True` folds cascade verdicts
     in-trace on the wide packed array BEFORE the egress narrowing; `probe`
-    selects the table-walk kernel (GUBER_PROBE_KERNEL)."""
+    selects the table-walk kernel (GUBER_PROBE_KERNEL). `evictees=True`
+    appends the raw int32 evictee sidecar AFTER the narrowing (slot fields
+    are bit patterns, never clamped — kernel2.attach_evictees_wire)."""
     arr12, base = decode_wire_block(carr)
+    if evictees:
+        from gubernator_tpu.ops.kernel2 import (
+            attach_evictees_wire,
+            decide2_packed_impl,
+            fold_cascade_packed,
+            req_from_arr,
+        )
+
+        table, packed, ev16 = decide2_packed_impl(
+            table, req_from_arr(arr12), write=write, math=math, probe=probe,
+            evictees=True,
+        )
+        if cascade:
+            packed = fold_cascade_packed(packed, arr12)
+        return table, attach_evictees_wire(encode_wire_out(packed, base), ev16)
     table, packed = decide2_packed_cols_impl(
         table, arr12, write=write, math=math, cascade=cascade, probe=probe
     )
@@ -472,5 +490,5 @@ def decide2_wire_dedup_impl(
 
 decide2_wire_cols = functools.partial(
     jax.jit, donate_argnums=(0,),
-    static_argnames=("write", "math", "cascade", "probe"),
+    static_argnames=("write", "math", "cascade", "probe", "evictees"),
 )(decide2_wire_cols_impl)

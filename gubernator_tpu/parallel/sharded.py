@@ -210,27 +210,37 @@ def make_sharded_install(mesh: Mesh, write: Optional[str] = None):
     return jax.jit(fn, donate_argnums=(0,))
 
 
-def make_sharded_merge(mesh: Mesh, write: Optional[str] = None):
+def make_sharded_merge(mesh: Mesh, write: Optional[str] = None,
+                       evictees: bool = False):
     """All-shards conservative-merge step (kernel2.merge2_impl) — the
     TransferState receive path on a sharded daemon: transferred slot rows
     are routed to their owning shard and merged with remaining=min /
-    expiry=max / newest-config-wins semantics per device."""
+    expiry=max / newest-config-wins semantics per device. `evictees=True`
+    (the tiering promote path) additionally yields each shard's displaced
+    live rows as canonical (b, 16) grids."""
     write = write or default_write_mode()
 
     def per_device(table: Table2, fp, slots, now, active):
         from gubernator_tpu.ops.kernel2 import merge2_impl
 
         table = jax.tree.map(lambda x: x[0], table)
+        expand = lambda t: jax.tree.map(lambda x: x[None], t)
+        if evictees:
+            table, merged, ev = merge2_impl(
+                table, fp[0], slots[0], now[0], active[0], write=write,
+                evictees=True,
+            )
+            return expand(table), expand(merged), expand(ev)
         table, merged = merge2_impl(
             table, fp[0], slots[0], now[0], active[0], write=write
         )
-        expand = lambda t: jax.tree.map(lambda x: x[None], t)
         return expand(table), expand(merged)
 
     spec = shard_spec(mesh)
+    n_out = 3 if evictees else 2
     fn = shard_map_compat(
         per_device, mesh=mesh, in_specs=(spec, spec, spec, spec, spec),
-        out_specs=(spec, spec), check_vma=False
+        out_specs=(spec,) * n_out, check_vma=False
     )
     return jax.jit(fn, donate_argnums=(0,))
 
@@ -250,6 +260,30 @@ def make_sharded_extract_dirty(mesh: Mesh, blk: int, layout=None):
 
         slots, fp, cnt = _extract_blocks_core(
             rows[0], bidx[0], now[0], blk, layout
+        )
+        return slots[None], fp[None], cnt[None]
+
+    spec = shard_spec(mesh)
+    fn = shard_map_compat(
+        per_device, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=(spec, spec, spec), check_vma=False
+    )
+    return jax.jit(fn)
+
+
+def make_sharded_extract_idle(mesh: Mesh, layout=None):
+    """All-shards idle-row extract step (hot-set tiering,
+    gubernator_tpu/tier/): each device filters ITS shard's live slots
+    whose last-activity reference is idle past the horizon and packs them
+    to the front (table2._extract_idle_core) — no slot row crosses a
+    device boundary; the host fetches only per-shard idle prefixes
+    (ShardedEngine.extract_idle)."""
+
+    def per_device(rows, now, idle):
+        from gubernator_tpu.ops.table2 import _extract_idle_core
+
+        slots, fp, cnt = _extract_idle_core(
+            rows[0], now[0], idle[0], layout
         )
         return slots[None], fp[None], cnt[None]
 
@@ -438,6 +472,14 @@ class ShardedEngine:
         # cost), per-shard extract step built lazily on first checkpoint
         self.ckpt = None
         self._extract_dirty_fn = None
+        # hot-set tiering (gubernator_tpu/tier/): host-RAM shadow attached
+        # by the daemon's TierManager. Mesh engines participate through
+        # the idle sweep (extract_idle below) and the fault-back merge;
+        # the per-request evictee sidecar is a single-device surface today
+        # (the routed per-shard programs don't thread the flag — demote-
+        # on-evict on meshes is a documented follow-up, docs/tiering.md)
+        self.shadow = None
+        self._extract_idle_fn = None
         self._batch_sharding = NamedSharding(mesh, shard_spec(mesh))
         self.max_exact_passes = max_exact_passes
         self.store = store  # write-through hook (gubernator_tpu.store.Store)
@@ -762,10 +804,14 @@ class ShardedEngine:
 
     def merge_rows(
         self, fps: np.ndarray, slots: np.ndarray,
-        now_ms: Optional[int] = None, layout=None,
-    ) -> int:
+        now_ms: Optional[int] = None, layout=None, collect: bool = False,
+    ):
         n = fps.shape[0]
         if n == 0:
+            if collect:
+                return 0, np.zeros(0, dtype=bool), np.empty(
+                    0, dtype=np.int64
+                ), np.empty((0, 16), dtype=np.int32)
             return 0
         from gubernator_tpu.ops.engine import _occurrence_rank
         from gubernator_tpu.ops.table2 import FLAGS
@@ -773,6 +819,10 @@ class ShardedEngine:
         slots = self._slots_to_full(slots, layout)
         rank = _occurrence_rank(fps)
         if rank.max() > 0:  # unique-fp contract (cf. LocalEngine.merge_rows)
+            if collect:
+                raise ValueError(
+                    "merge_rows(collect=True) requires unique fingerprints"
+                )
             return sum(
                 self.merge_rows(fps[rank == r], slots[rank == r], now_ms)
                 for r in range(int(rank.max()) + 1)
@@ -790,6 +840,24 @@ class ShardedEngine:
         slots_g = np.zeros((D, b_local, slots.shape[1]), dtype=np.int32)
         slots_g[rs, offset] = slots[order]
         put = lambda x: jax.device_put(x, self._batch_sharding)
+        if collect:
+            fn = getattr(self, "_merge_ev_fn", None)
+            if fn is None:
+                fn = self._merge_ev_fn = make_sharded_merge(
+                    self.mesh, write=self.write_mode, evictees=True
+                )
+            self.table, merged, ev = fn(
+                self.table, put(fp_g), put(slots_g), put(now_g), put(act_g)
+            )
+            self.stats.dispatches += 1
+            merged_h = np.asarray(merged)
+            mask = np.zeros(n, dtype=bool)
+            mask[order] = merged_h[rs, offset]
+            ev_h = np.asarray(ev).reshape(-1, 16)
+            ev_lo = ev_h[:, 0].astype(np.int64) & 0xFFFFFFFF
+            ev_fp = (ev_h[:, 1].astype(np.int64) << 32) | ev_lo
+            keep = ev_fp != 0
+            return int(mask.sum()), mask, ev_fp[keep], ev_h[keep].copy()
         if self._merge_fn is None:
             self._merge_fn = make_sharded_merge(self.mesh, write=self.write_mode)
         self.table, merged = self._merge_fn(
@@ -906,6 +974,55 @@ class ShardedEngine:
             return (
                 np.empty(0, dtype=np.int64),
                 np.empty((0, F), dtype=np.int32),
+            )
+        return np.concatenate(fps_l), np.concatenate(slots_l)
+
+    # ------------------------------------------------------------- tiering
+
+    def extract_idle(self, now_ms: int, idle_ms: int,
+                     max_rows: int = 1 << 16):
+        """Live rows idle past `idle_ms` across every shard: (fps (N,)
+        i64, slots (N, F_layout) i32), N ≤ max_rows. The filter + pack
+        runs PER SHARD under shard_map (make_sharded_extract_idle — no
+        slot row crosses a device boundary); the host fetches only
+        per-shard idle prefixes, the checkpoint_finish fetch rule. The
+        cap slices shard-major — the remainder stays for the next
+        sweep."""
+        fn = self._extract_idle_fn
+        if fn is None or getattr(self, "_extract_idle_layout", None) is not (
+            self.table.layout
+        ):
+            fn = self._extract_idle_fn = make_sharded_extract_idle(
+                self.mesh, layout=self.table.layout
+            )
+            self._extract_idle_layout = self.table.layout
+        D = self.n_shards
+        put = lambda x: jax.device_put(x, self._batch_sharding)
+        slots_g, fp_g, cnt_g = fn(
+            self.table.rows,
+            put(np.full(D, now_ms, dtype=np.int64)),
+            put(np.full(D, idle_ms, dtype=np.int64)),
+        )
+        counts = np.asarray(cnt_g)
+        width = int(fp_g.shape[1])
+        F_l = self.table.layout.F
+        fps_l, slots_l = [], []
+        left = int(max_rows)
+        for d in range(D):
+            n = min(int(counts[d]), left)
+            if n <= 0:
+                continue
+            pad = 256
+            while pad < n:
+                pad *= 2
+            pad = min(pad, width)
+            fps_l.append(np.asarray(fp_g[d, :pad])[:n].copy())
+            slots_l.append(np.asarray(slots_g[d, :pad])[:n].copy())
+            left -= n
+        if not fps_l:
+            return (
+                np.empty(0, dtype=np.int64),
+                np.empty((0, F_l), dtype=np.int32),
             )
         return np.concatenate(fps_l), np.concatenate(slots_l)
 

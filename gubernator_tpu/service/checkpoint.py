@@ -152,13 +152,31 @@ class CheckpointManager:
                     "the next compaction may not survive another unclean "
                     "death", exc,
                 )
-        from gubernator_tpu.store import fps_from_slots
+        from gubernator_tpu.store import TOMBSTONE, fps_from_slots
 
         t0 = time.perf_counter()
         for epoch, _now_ms, slots, frame_layout in scan.frames:
             if epoch <= self.base_epoch:
                 continue  # already compacted into the base
             if slots.shape[0] == 0:
+                self.last_epoch = max(self.last_epoch, epoch)
+                continue
+            if frame_layout is TOMBSTONE:
+                # demote-on-idle removal record (hot-set tiering): applied
+                # in file order so a row demoted AFTER its last state
+                # frame does not resurrect — it faults back from the
+                # shadow spill instead (docs/tiering.md)
+                try:
+                    engine.tombstone_fps(fps_from_slots(slots))
+                except Exception as exc:
+                    log.warning(
+                        "tombstone frame (epoch %d) replay failed (%s)",
+                        epoch, exc,
+                    )
+                    daemon.metrics.checkpoint_errors.labels(
+                        stage="restore"
+                    ).inc()
+                    break
                 self.last_epoch = max(self.last_epoch, epoch)
                 continue
             try:
@@ -265,6 +283,35 @@ class CheckpointManager:
         if self.frames_since_compaction >= self.compact_frames:
             await self.compact()
         return out
+
+    async def append_tombstones(self, fps) -> int:
+        """Record demote-on-idle removals in the delta log (hot-set
+        tiering): one tombstone frame stamped with the UPCOMING epoch
+        (tracker.epoch + 1 — always past the base even right after a
+        compaction; the log reset at compaction discards it once the base
+        itself no longer holds the rows). Failure is non-fatal: the row
+        merely resurrects on a warm restart, which the fault-back merge
+        renders harmless (docs/tiering.md)."""
+        if not self.enabled or fps.shape[0] == 0:
+            return 0
+        daemon = self.daemon
+        tracker = getattr(daemon.engine, "ckpt", None)
+        epoch = (tracker.epoch + 1) if tracker is not None else (
+            self.last_epoch + 1
+        )
+        now_ms = daemon.now_ms()
+        loop = asyncio.get_running_loop()
+        async with self._lock:
+            try:
+                return await loop.run_in_executor(
+                    None,
+                    lambda: self._log.append_tombstones(epoch, now_ms, fps),
+                )
+            except Exception as exc:
+                self.last_error = f"tombstone append: {exc}"
+                daemon.metrics.checkpoint_errors.labels(stage="delta").inc()
+                log.warning("tombstone frame append failed: %s", exc)
+                return 0
 
     async def compact(self) -> None:
         """Fold the delta log into a fresh base: full snapshot (engine

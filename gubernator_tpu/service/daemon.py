@@ -196,6 +196,14 @@ class Daemon:
         from gubernator_tpu.service.checkpoint import CheckpointManager
 
         self.checkpointer = CheckpointManager(self)
+        # hot-set tiering plane (gubernator_tpu/tier/; docs/tiering.md):
+        # inert unless GUBER_TIER_ENABLED — then evicted/idle rows demote
+        # to a host-RAM shadow instead of vanishing, and host staging
+        # faults them back through the conservative merge
+        from gubernator_tpu.tier.manager import TierManager
+
+        self.tier = TierManager(self)
+        self._tier_task = None
         self._checkpoint_task = None
         self._maintenance_task = None
         self._global_sync_task = None  # mesh-global collective sync tick
@@ -266,6 +274,10 @@ class Daemon:
             # epoch tracker attaches BEFORE the listeners open: every
             # serving mutation from the first request onward is marked
             d.checkpointer.attach()
+        if d.tier.enabled:
+            # AFTER the checkpoint restore (delta replay — including
+            # tombstone frames — settles HBM first), before serving
+            d.tier.attach()
         from gubernator_tpu.service.server import start_servers
 
         await start_servers(d)
@@ -291,6 +303,13 @@ class Daemon:
             # checkpointing overlaps serving like the telemetry scan does
             d._checkpoint_task = asyncio.create_task(
                 d.checkpointer.loop(), name="checkpoint"
+            )
+        if d.tier.enabled:
+            # demote-on-idle sweep on the telemetry cadence: extract +
+            # tombstone in one engine job, shadow append + spill flush +
+            # tombstone frame off it (docs/tiering.md)
+            d._tier_task = asyncio.create_task(
+                d.tier.loop(), name="tier-sweep"
             )
         if d._client_creds is not None and conf.tls_cert_file:
             # rotation watcher: the gRPC server hot-reloads per handshake,
@@ -1524,11 +1543,27 @@ class Daemon:
 
     async def debug_table(self) -> dict:
         """Latest table-telemetry snapshot; scans on demand when the
-        background cadence is disabled or has not ticked yet."""
+        background cadence is disabled or has not ticked yet. Grows the
+        cumulative live-eviction count (the state-loss signal tiering
+        turns into demotions — gubernator_tpu_evicted_live_total) and a
+        tiering summary when the plane is armed."""
         snap = self._table_telemetry
         if snap is None:
             snap = await self.collect_telemetry()
-        return snap.to_dict()
+        out = snap.to_dict()
+        out["evicted_live_total"] = self.engine.stats.evicted_unexpired
+        if self.tier.enabled:
+            out["tiering"] = {
+                "shadow_rows": self.tier.shadow.ram_rows,
+                "tracked_rows": self.tier.shadow.tracked_rows,
+            }
+        return out
+
+    def debug_tier(self) -> dict:
+        """Hot-set tiering plane: shadow occupancy/bounds, demote/promote
+        counters, spill state — what an operator checks when capacity or
+        fault-back behavior is in question (docs/tiering.md)."""
+        return self.tier.debug()
 
     def debug_pipeline(self) -> dict:
         """Front-door + engine pipeline state: ring depth, worker liveness,
@@ -1790,7 +1825,7 @@ class Daemon:
         for t in (
             self._cert_watch_task, self._maintenance_task,
             self._global_sync_task, self._telemetry_task,
-            self._checkpoint_task, *self._handoff_tasks,
+            self._checkpoint_task, self._tier_task, *self._handoff_tasks,
         ):
             if t is not None:
                 t.cancel()
@@ -1866,6 +1901,12 @@ class Daemon:
                 await self._checkpoint_task
             except asyncio.CancelledError:
                 pass
+        if self._tier_task is not None:
+            self._tier_task.cancel()
+            try:
+                await self._tier_task
+            except asyncio.CancelledError:
+                pass
         if self._pool is not None:
             await self._pool.close()
         # in-flight rebalance handoffs yield to the final drain pass (or to
@@ -1896,6 +1937,16 @@ class Daemon:
             # final collective flush so queued GLOBAL hits reach their owner
             # shards before the checkpoint (global_manager.close analog)
             await self.runner.sync_global()
+        if self.tier.enabled:
+            # persist unspilled shadow rows so a graceful restart faults
+            # them back from disk (no-op without a spill file). Guarded:
+            # shutdown always completes.
+            try:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: self.tier.close(self.now_ms())
+                )
+            except Exception:
+                log.exception("tier shadow flush failed")
         if self.checkpointer.enabled:
             # incremental plane: one last compaction folds the delta log
             # into the base so a restart replays nothing. Guarded like

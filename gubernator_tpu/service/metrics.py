@@ -144,6 +144,64 @@ class DaemonMetrics:
             "Live (unexpired) items evicted for new keys",
             registry=r,
         )
+        # the same kernel stat under the TPU-native name the tiering plane
+        # documents (renders gubernator_tpu_evicted_live_total): each
+        # increment is LIVE state displaced by the claim — silent loss
+        # with tiering off, a demotion with it on (docs/tiering.md)
+        self.evicted_live = Counter(
+            "gubernator_tpu_evicted_live",
+            "Live (unexpired) rows the decision kernel's claim displaced "
+            "(kernel2 evicted_unexpired stat) — state loss when tiering "
+            "is off, demote-on-evict events when it is on",
+            registry=r,
+        )
+        # --- hot-set tiering (gubernator_tpu/tier/; docs/tiering.md)
+        self.tier_demoted = Counter(
+            # renders gubernator_tier_demoted_rows_total
+            "gubernator_tier_demoted_rows",
+            "Rows demoted from HBM to the host-RAM shadow, by trigger "
+            "(evict = displaced by the claim, idle = background sweep)",
+            ["reason"],  # evict | idle
+            registry=r,
+        )
+        self.tier_promoted = Counter(
+            "gubernator_tier_promoted_rows",
+            "Shadow rows faulted back into HBM through the conservative "
+            "merge ahead of a decide dispatch",
+            registry=r,
+        )
+        self.tier_shed = Counter(
+            "gubernator_tier_shed_rows",
+            "Shadow rows dropped at the RAM byte bound with no spill "
+            "file configured — counted state loss, identical to the "
+            "pre-tiering eviction behavior",
+            registry=r,
+        )
+        self.tier_promote_returned = Counter(
+            "gubernator_tier_promote_returned_rows",
+            "Promote rows returned to the shadow after their claim "
+            "dropped (> K same-bucket promotes in one batch) — their "
+            "decide that batch may have fresh-granted (docs/tiering.md "
+            "bound)",
+            registry=r,
+        )
+        self.tier_shadow_rows = Gauge(
+            "gubernator_tier_shadow_rows",
+            "Shadow rows resident in host RAM",
+            registry=r,
+        )
+        self.tier_shadow_bytes = Gauge(
+            "gubernator_tier_shadow_bytes",
+            "Nominal bytes (64 B/row) of the RAM-resident shadow — "
+            "bounded by GUBER_TIER_SHADOW_BYTES",
+            registry=r,
+        )
+        self.tier_spilled_rows = Gauge(
+            "gubernator_tier_spilled_rows",
+            "Rows indexed in the shadow spill file (fault back with one "
+            "seek+read)",
+            registry=r,
+        )
         # --- TPU dispatch plane (no reference analog; the kernel is ours)
         self.dispatch_count = Counter(
             "gubernator_tpu_dispatch_count",
@@ -626,6 +684,7 @@ class DaemonMetrics:
             self.over_limit_counter.inc(d_over)
         if d_evic > 0:
             self.unexpired_evictions.inc(d_evic)
+            self.evicted_live.inc(d_evic)
         if d_drop > 0:
             self.dropped_rows.inc(d_drop)
         if d_disp > 0:

@@ -356,6 +356,39 @@ class EngineRunner:
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(self._exec, self.engine.snapshot)
 
+    # ------------------------------------------------------------- tiering
+
+    async def tier_demote_idle(
+        self, idle_ms: int, max_rows: int = 1 << 16, now_ms=None
+    ):
+        """One demote-on-idle sweep (gubernator_tpu/tier/): extract rows
+        idle past the horizon AND tombstone them out of HBM in ONE
+        engine-thread job — no decide can interleave between the read and
+        the removal, so the demoted copy is exactly the state that left
+        the table. Returns (now_ms, fps, canonical full rows); the caller
+        (TierManager) appends them to the shadow. Crash ordering: a death
+        after the tombstone but before the shadow append loses nothing
+        the delta log doesn't still hold — restart replays the row back
+        (no tombstone frame was written yet), which is the conservative
+        direction."""
+        loop = asyncio.get_running_loop()
+
+        def run():
+            from gubernator_tpu.ops.engine import ms_now
+
+            eng = self.engine
+            now = now_ms if now_ms is not None else ms_now()
+            fps, slots = eng.extract_idle(now, idle_ms, max_rows)
+            if fps.shape[0] == 0:
+                return now, fps, np.empty((0, 16), dtype=np.int32)
+            eng.tombstone_fps(fps)
+            # canonical rows at the shadow boundary (the one cross-layout
+            # conversion point, ops/layout.py)
+            full = np.asarray(eng.table.layout.unpack(slots))
+            return now, fps, full
+
+        return await loop.run_in_executor(self._exec, run)
+
     # ------------------------------------------------- incremental checkpoint
     # (service/checkpoint.py) — split like telemetry: take+launch atomically
     # on the engine thread, fetch on a dedicated lazy thread so the extract

@@ -270,6 +270,8 @@ def build_http_app(daemon, status_only: bool = False) -> web.Application:
                 return web.json_response(daemon.debug_durability())
             if kind == "leases":
                 return web.json_response(daemon.debug_leases())
+            if kind == "tier":
+                return web.json_response(daemon.debug_tier())
         except Exception as exc:  # pragma: no cover - defensive
             return web.json_response(
                 {"code": 13, "message": f"debug snapshot failed: {exc}"},
@@ -277,7 +279,8 @@ def build_http_app(daemon, status_only: bool = False) -> web.Application:
             )
         return web.json_response(
             {"code": 5, "message": f"unknown debug plane {kind!r}; one of: "
-             "table, pipeline, peers, global, regions, durability, leases"},
+             "table, pipeline, peers, global, regions, durability, leases, "
+             "tier"},
             status=404,
         )
 

@@ -113,10 +113,41 @@ _FRAME_HEADER = struct.Struct("<IIIqqI")
 _SLOT_FIELDS = 16  # full-layout fields/row (VERSION 1); packed versions
 # derive theirs from the layout registry
 
+# TOMBSTONE frames (hot-set tiering, docs/tiering.md): a demote-on-idle
+# removes a live row from HBM after shadowing it — without a removal
+# record, warm-restart replay of an OLDER state frame would resurrect the
+# row (harmless for admission — the resurrected bytes equal the shadowed
+# copy and the fault-back merge is idempotent — but it silently undoes the
+# demotion's capacity win and double-homes the state). A tombstone frame
+# carries just the removed fingerprints ((N, 2) int32 lo/hi rows) and
+# replays as tombstone_fps IN FILE ORDER, so state-frame → tombstone →
+# later-state sequences resolve exactly. The version byte lives in its own
+# range (0x40) — a pre-tiering reader stops its scan at the unknown
+# version (the conservative prefix rule) instead of misparsing 8 B rows as
+# 64 B slots.
+TOMBSTONE_FRAME_VERSION = 0x40
+_TOMBSTONE_FIELDS = 2
+
+
+class _TombstoneKind:
+    """Sentinel standing in the DeltaScan frame tuple's layout position
+    for tombstone frames (the 4-tuple shape every consumer already
+    unpacks stays intact; replay branches on identity)."""
+
+    name = "tombstone"
+
+    def __repr__(self):  # pragma: no cover - debugging nicety
+        return "<tombstone-frame>"
+
+
+TOMBSTONE = _TombstoneKind()
+
 
 def _frame_layout(version: int):
     from gubernator_tpu.ops.layout import layout_by_code
 
+    if version == TOMBSTONE_FRAME_VERSION:
+        return TOMBSTONE
     return layout_by_code(version - 1)
 
 
@@ -158,6 +189,26 @@ def encode_delta_frame(epoch: int, now_ms: int, slots: np.ndarray,
     return header + payload
 
 
+def encode_tombstone_frame(epoch: int, now_ms: int,
+                           fps: np.ndarray) -> bytes:
+    """One CRC-framed tombstone record: removed fingerprints as (N, 2)
+    int32 lo/hi rows under the dedicated version byte (see
+    TOMBSTONE_FRAME_VERSION)."""
+    fps = np.asarray(fps, dtype=np.int64)
+    rows = np.empty((fps.shape[0], _TOMBSTONE_FIELDS), dtype=np.int32)
+    lo = fps & 0xFFFFFFFF
+    rows[:, 0] = np.where(lo >= (1 << 31), lo - (1 << 32), lo).astype(
+        np.int32
+    )
+    rows[:, 1] = (fps >> 32).astype(np.int32)
+    payload = np.ascontiguousarray(rows, dtype="<i4").tobytes()
+    header = _FRAME_HEADER.pack(
+        FRAME_MAGIC, TOMBSTONE_FRAME_VERSION, rows.shape[0], epoch, now_ms,
+        zlib.crc32(payload),
+    )
+    return header + payload
+
+
 class DeltaScan:
     """Result of reading a delta log: the valid frame prefix plus what (if
     anything) was skipped. A torn tail (crash mid-append) or a corrupt
@@ -165,7 +216,9 @@ class DeltaScan:
     semantics, while resynchronizing past a corrupt length field is not."""
 
     def __init__(self):
-        # (epoch, now_ms, slots, layout) — slots in the frame's own layout
+        # (epoch, now_ms, slots, layout) — slots in the frame's own
+        # layout; tombstone frames carry (N, 2) fp rows with the
+        # TOMBSTONE sentinel in the layout position
         self.frames: List[Tuple[int, int, np.ndarray, object]] = []
         self.skipped_bytes = 0
         self.clean_bytes = 0  # file prefix (log header + clean frames)
@@ -210,7 +263,9 @@ def read_delta_frames(path: str) -> DeltaScan:
                 scan.error = f"unknown frame version {version} at offset {pos}"
                 scan.skipped_bytes = os.path.getsize(path) - pos
                 break
-            fields = layout.F
+            fields = (
+                _TOMBSTONE_FIELDS if layout is TOMBSTONE else layout.F
+            )
             payload = f.read(n_rows * fields * 4)
             if len(payload) < n_rows * fields * 4:
                 scan.error = "truncated frame payload"
@@ -246,6 +301,24 @@ class DeltaLog:
         `layout` tags the slot rows' layout (full inferred for 16-field
         rows)."""
         frame = encode_delta_frame(epoch, now_ms, slots, layout=layout)
+        fresh = not os.path.exists(self.path) or (
+            os.path.getsize(self.path) == 0
+        )
+        d = os.path.dirname(os.path.abspath(self.path)) or "."
+        os.makedirs(d, exist_ok=True)
+        with open(self.path, "ab") as f:
+            if fresh:
+                f.write(DELTA_LOG_MAGIC)
+            f.write(frame)
+            f.flush()
+            os.fsync(f.fileno())
+        return len(frame) + (len(DELTA_LOG_MAGIC) if fresh else 0)
+
+    def append_tombstones(self, epoch: int, now_ms: int,
+                          fps: np.ndarray) -> int:
+        """Append one tombstone frame (demote-on-idle removals — see
+        TOMBSTONE_FRAME_VERSION). Returns bytes written."""
+        frame = encode_tombstone_frame(epoch, now_ms, fps)
         fresh = not os.path.exists(self.path) or (
             os.path.getsize(self.path) == 0
         )
