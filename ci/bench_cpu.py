@@ -472,6 +472,134 @@ def serving_smoke() -> dict:
     return out
 
 
+def ring_smoke() -> dict:
+    """Device-resident request-ring regression gate (always-on-chip PR,
+    loopback daemon, CPU backend — the functional emulation of the
+    persistent-kernel ring protocol):
+
+    (a) **byte-identity** — a ring-fed daemon must serve byte-identical
+        responses to a direct-dispatch daemon over the same distinct-key
+        corpus under 4-worker concurrency (the ring drives the exact
+        runner surface the direct path drives, so any divergence is a
+        protocol bug: misordered slot consumption, crossed futures,
+        stale staging);
+    (b) **bounded backpressure, zero loss** — with a deliberately tiny
+        ring (GUBER_RING_SLOTS=2) and per-request chunks, submits must
+        WAIT rather than drop: every published ticket launches exactly
+        once, in ticket order, and every response comes back;
+    (c) **zero-loss drain** — daemon close retires every published slot
+        before parking the loop (published == consumed, occupancy 0);
+    (d) **bounded host overhead** — the ring protocol's per-dispatch host
+        cost (claim/stage/fence/poll) must stay within 2.5× the direct
+        path's dispatch wall at small batches. (On CPU the emulation can
+        only ADD overhead — the round-trip it deletes is priced by
+        bench.py's `dispatch` phase on a real TPU, where the persistent
+        kernel skips the launch entirely.)
+    """
+    import asyncio
+
+    from gubernator_tpu.config import BehaviorConfig, DaemonConfig
+    from gubernator_tpu.service.daemon import Daemon
+
+    os.environ["GUBER_WIRE_COMPACT"] = "1"  # fused path needs compact wire
+    now = int(time.time() * 1000)  # honored by created_at tolerance →
+    # reset_time is corpus-determined, so responses are byte-comparable
+    # across daemons serving seconds apart
+
+    def corpus(reqs: int, rows: int, tag: str):
+        from gubernator_tpu.proto import gubernator_pb2 as pb
+
+        return [
+            pb.GetRateLimitsReq(
+                requests=[
+                    pb.RateLimitReq(
+                        name="ring", unique_key=f"{tag}r{r}i{i}", hits=1,
+                        limit=1 << 20, duration=3_600_000, created_at=now,
+                    )
+                    for i in range(rows)
+                ]
+            ).SerializeToString()
+            for r in range(reqs)
+        ]
+
+    def conf(**beh) -> DaemonConfig:
+        beh.setdefault("batch_wait_ms", 1.0)
+        beh.setdefault("front_workers", 4)
+        # per-request chunks: 64-row requests + a 64-row coalesce cap mean
+        # every request is its own ring ticket — the protocol stress shape
+        beh.setdefault("coalesce_limit", 64)
+        return DaemonConfig(
+            grpc_address="127.0.0.1:0", http_address="",
+            cache_size=1 << 15, behaviors=BehaviorConfig(**beh),
+        )
+
+    async def drive(d: Daemon, datas):
+        t0 = time.perf_counter()
+        rs = await asyncio.gather(*(d.get_rate_limits_raw(x) for x in datas))
+        return time.perf_counter() - t0, rs
+
+    async def run():
+        out: dict = {}
+        dr = await Daemon.spawn(conf(ring_enable=True, ring_slots=2))
+        dd = await Daemon.spawn(conf())
+        await drive(dr, corpus(8, 64, "w"))  # shape warm
+        await drive(dd, corpus(8, 64, "w"))
+        datas = corpus(64, 64, "x")
+        t_ring, r1 = await drive(dr, datas)
+        t_direct, r2 = await drive(dd, datas)
+        dbg = dr.ring.debug()
+        out["identical"] = r1 == r2
+        out["ring_dispatches"] = dr.batcher.ring_dispatches
+        out["ring_launches"] = dbg["launches"]
+        out["ring_published"] = dbg["published"]
+        out["backpressure_waits"] = dbg["backpressure_waits"]
+        out["max_occupancy"] = dbg["max_occupancy"]
+        out["fallbacks"] = dbg["fallbacks"]
+        out["serve_s_ring"] = round(t_ring, 4)
+        out["serve_s_direct"] = round(t_direct, 4)
+        out["ring_overhead_ratio"] = round(t_ring / max(t_direct, 1e-9), 3)
+        await dr.close()
+        await dd.close()
+        post = dr.ring.debug()
+        out["drained_clean"] = (
+            post["closed"] and post["occupancy"] == 0
+            and post["published"] == post["consumed"]
+        )
+        return out
+
+    out = asyncio.run(run())
+    if not out["identical"]:
+        print(json.dumps({"error": "ring smoke: ring-fed responses diverge "
+                          "from the direct dispatch path", **out}))
+        sys.exit(1)
+    if out["ring_dispatches"] == 0 or out["ring_launches"] == 0:
+        print(json.dumps({"error": "ring smoke: ring plane never engaged",
+                          **out}))
+        sys.exit(1)
+    if out["ring_launches"] != out["ring_published"]:
+        print(json.dumps({"error": "ring smoke: published tickets were "
+                          "dropped (launch/publish mismatch)", **out}))
+        sys.exit(1)
+    if out["max_occupancy"] > 2:
+        print(json.dumps({"error": "ring smoke: occupancy exceeded the "
+                          "slot bound", **out}))
+        sys.exit(1)
+    if out["backpressure_waits"] == 0:
+        print(json.dumps({"error": "ring smoke: 64 per-request tickets "
+                          "through a 2-slot ring never hit backpressure — "
+                          "the bound is not being exercised", **out}))
+        sys.exit(1)
+    if not out["drained_clean"]:
+        print(json.dumps({"error": "ring smoke: drain left unconsumed "
+                          "slots", **out}))
+        sys.exit(1)
+    if out["ring_overhead_ratio"] > 2.5:
+        print(json.dumps({"error": "ring smoke: ring protocol host "
+                          "overhead exceeds 2.5x the direct path", **out}))
+        sys.exit(1)
+    return out
+
+
 def telemetry_smoke() -> dict:
     """Table-telemetry regression gate (observability PR) at a 1M-key
     population:
@@ -2061,6 +2189,7 @@ def main() -> None:
         "region_smoke": region_smoke(),
         "lease_smoke": lease_smoke(),
         "tier_smoke": tier_smoke(),
+        "ring_smoke": ring_smoke(),
     }))
 
 

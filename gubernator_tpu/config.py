@@ -148,6 +148,17 @@ class BehaviorConfig:
     # for drain progress (backpressure) instead of growing the queue
     # without limit (0 = 8 × coalesce_limit)
     batch_queue_rows: int = 0
+    # device-resident request ring (service/ring.py; docs/latency.md
+    # "Dispatch budget"): all-wire flushes are staged into a fixed ring of
+    # compact wire-grid slots and consumed in ticket order by a persistent
+    # serving loop — on TPU this kills the per-flush dispatch round-trip;
+    # the CPU build runs a functional emulation of the same protocol. Off
+    # (default) = the direct per-flush dispatch every PR before this one
+    # shipped.
+    ring_enable: bool = False
+    # ring depth in slots: submits past this many published-but-unconsumed
+    # batches wait (bounded backpressure, no drops, FIFO order)
+    ring_slots: int = 64
     # warm-up breadth: "" compiles only the 1-row shapes (fast spawn);
     # "pow2" additionally compiles every pow2 coalesce shape up to
     # coalesce_limit (token graph), "pow2-mixed" both math graphs — without
@@ -286,6 +297,15 @@ class DaemonConfig:
     # double-buffered probe→decide→write megakernel, ops/pallas_probe.py —
     # interpret-mode on CPU backends)
     probe_kernel: str = "auto"
+    # table-walk kernel for the NON-decide walks — GLOBAL installs,
+    # region/handoff merges, tiering promotes (ops/plan.default_walk_kernel;
+    # GUBER_WALK_KERNEL): "auto" (= xla until the device bench's fused-vs-
+    # two-pass wall flips it) | "xla" (two-pass gather + sweep/sparse
+    # write) | "pallas" (the fused probe→install/merge→write walk,
+    # ops/pallas_probe.walk2_pallas_impl). Independent of probe_kernel so
+    # the latency-critical decide path and the throughput walks can flip
+    # separately.
+    walk_kernel: str = "auto"
     workers: int = 0  # 0 = auto; host-side executor width
 
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
@@ -510,6 +530,11 @@ class DaemonConfig:
                 f"GUBER_PROBE_KERNEL: must be auto, xla or pallas, got "
                 f"{self.probe_kernel!r}"
             )
+        if self.walk_kernel not in ("auto", "xla", "pallas"):
+            raise ConfigError(
+                f"GUBER_WALK_KERNEL: must be auto, xla or pallas, got "
+                f"{self.walk_kernel!r}"
+            )
         if self.cache_size <= 0:
             raise ConfigError("GUBER_CACHE_SIZE must be positive")
         if self.behaviors.batch_limit <= 0 or self.behaviors.batch_limit > 1000:
@@ -534,6 +559,11 @@ class DaemonConfig:
             raise ConfigError("GUBER_BATCH_CLOSE_BYTES must be positive")
         if self.behaviors.batch_queue_rows < 0:
             raise ConfigError("GUBER_BATCH_QUEUE_ROWS must be >= 0 (0 = auto)")
+        if self.behaviors.ring_slots < 2:
+            raise ConfigError(
+                "GUBER_RING_SLOTS must be >= 2 (a 1-slot ring serializes "
+                "staging against consumption — no overlap to buy)"
+            )
         if self.behaviors.peer_breaker_errors <= 0:
             raise ConfigError("GUBER_PEER_BREAKER_ERRORS must be >= 1")
         if self.behaviors.peer_breaker_probes <= 0:
@@ -666,6 +696,7 @@ def setup_daemon_config(
         a2a_impl=_get(env, "GUBER_A2A_IMPL", "auto"),
         mesh_hosts=_get_int(env, "GUBER_MESH_HOSTS", 0),
         probe_kernel=_get(env, "GUBER_PROBE_KERNEL", "auto"),
+        walk_kernel=_get(env, "GUBER_WALK_KERNEL", "auto"),
         workers=_get_int(env, "GUBER_WORKER_COUNT", 0),
         behaviors=BehaviorConfig(
             batch_timeout_ms=_get_float_ms(env, "GUBER_BATCH_TIMEOUT", 500.0),
@@ -680,6 +711,8 @@ def setup_daemon_config(
                 env, "GUBER_BATCH_CLOSE_BYTES", 1 << 20
             ),
             batch_queue_rows=_get_int(env, "GUBER_BATCH_QUEUE_ROWS", 0),
+            ring_enable=_get_bool(env, "GUBER_RING_ENABLE", False),
+            ring_slots=_get_int(env, "GUBER_RING_SLOTS", 64),
             warm_shapes=_get(env, "GUBER_WARM_SHAPES", ""),
             global_timeout_ms=_get_float_ms(env, "GUBER_GLOBAL_TIMEOUT", 500.0),
             global_sync_wait_ms=_get_float_ms(env, "GUBER_GLOBAL_SYNC_WAIT", 100.0),

@@ -922,9 +922,13 @@ class LocalEngine:
         wire: Optional[str] = None,
         layout: Optional[str] = None,
         probe: Optional[str] = None,
+        walk: Optional[str] = None,
     ):
         from gubernator_tpu.ops.layout import resolve_layout
-        from gubernator_tpu.ops.plan import default_probe_kernel
+        from gubernator_tpu.ops.plan import (
+            default_probe_kernel,
+            default_walk_kernel,
+        )
         from gubernator_tpu.ops.wire import default_wire_mode
 
         # slot layout (ops/layout.py): "full" (bit-compatible default),
@@ -969,6 +973,16 @@ class LocalEngine:
         if probe is not None and probe not in ("xla", "pallas"):
             raise ValueError(f"probe must be 'xla' or 'pallas', got {probe!r}")
         self.probe_mode = probe or default_probe_kernel()
+        # table-walk kernel for the NON-decide walks — GLOBAL installs,
+        # region/handoff merges, tiering promotes (GUBER_WALK_KERNEL /
+        # walk=): "xla" two-pass gather + write, or "pallas" — the fused
+        # probe→install/merge→write megakernel sharing the decide
+        # kernel's claim/carry/write machinery (ops/pallas_probe.py).
+        # Independent of probe_mode: serving latency and sync throughput
+        # flip separately.
+        if walk is not None and walk not in ("xla", "pallas"):
+            raise ValueError(f"walk must be 'xla' or 'pallas', got {walk!r}")
+        self.walk_mode = walk or default_walk_kernel()
         self._decide_fn = decide_fn
         # oracle engines return unpacked outputs; the begin/finish split
         # assumes the packed single-fetch layout
@@ -1417,7 +1431,9 @@ class LocalEngine:
                 else jnp.asarray(pad(rem_store, np.int64))
             ),
         )
-        self.table, installed = install2(self.table, inst, write=self.write_mode)
+        self.table, installed = install2(
+            self.table, inst, write=self.write_mode, probe=self.walk_mode
+        )
         self.stats.dispatches += 1
         return int(np.asarray(installed).sum())
 
@@ -1524,7 +1540,8 @@ class LocalEngine:
         )
         if collect:
             self.table, merged, ev = merge2(
-                *args, write=self.write_mode, evictees=True
+                *args, write=self.write_mode, evictees=True,
+                probe=self.walk_mode,
             )
             self.stats.dispatches += 1
             mask = np.asarray(merged)[:n].copy()
@@ -1535,7 +1552,9 @@ class LocalEngine:
             return (
                 int(mask.sum()), mask, ev_fp[keep], ev_h[keep].copy()
             )
-        self.table, merged = merge2(*args, write=self.write_mode)
+        self.table, merged = merge2(
+            *args, write=self.write_mode, probe=self.walk_mode
+        )
         self.stats.dispatches += 1
         return int(np.asarray(merged).sum())
 

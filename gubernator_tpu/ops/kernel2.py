@@ -1238,25 +1238,18 @@ def decide2_packed_dedup_impl(
 # -------------------------------------------------------------------- install
 
 
-def install2_impl(
-    table: Table2, inst, *, write: str = "xla"
-) -> Tuple[Table2, jnp.ndarray]:
-    """v2 analog of kernel.install_impl — install owner-authoritative GLOBAL
-    statuses as fresh items (reference UpdatePeerGlobals, gubernator.go:434-474).
-    Returns (table', installed_mask)."""
+def install_payload16(inst) -> jnp.ndarray:
+    """The per-row INSTALL payload stage: InstallBatch columns → canonical
+    (B, 16) i32 slot rows. A pure function of the incoming batch — it never
+    reads table state — shared VERBATIM by the two-pass XLA path
+    (install2_impl below) and the fused Pallas walk
+    (ops/pallas_probe.walk2_pallas_impl, which precomputes these rows in
+    its prologue and DMAs them through the megakernel). Factoring it out is
+    what makes the two install paths bit-identical by construction, the
+    same contract decide_payload discharges for the probe kernels."""
     from gubernator_tpu.types import Algorithm
 
-    layout = table.layout
     B = inst.fp.shape[0]
-    NB = table.rows.shape[0]
-    write = resolve_write(write, NB, B, layout)
-    if write == "sparse":
-        blk, u, g = sparse_geometry(NB, B)
-    else:
-        blk, u = sweep_geometry(NB, B)
-    c = _probe_claim2(table.rows, inst.fp, inst.now, inst.active, blk, u,
-                      layout)
-
     is_token = inst.algo == int(Algorithm.TOKEN_BUCKET)
     is_leaky = inst.algo == int(Algorithm.LEAKY_BUCKET)
     is_gcra = inst.algo == int(Algorithm.GCRA)
@@ -1337,6 +1330,40 @@ def install2_impl(
         ],
         axis=1,
     )
+    return new16
+
+
+def install2_impl(
+    table: Table2, inst, *, write: str = "xla", probe: str = "xla"
+) -> Tuple[Table2, jnp.ndarray]:
+    """v2 analog of kernel.install_impl — install owner-authoritative GLOBAL
+    statuses as fresh items (reference UpdatePeerGlobals, gubernator.go:434-474).
+    Returns (table', installed_mask).
+
+    `probe` (static) selects the table walk, mirroring decide2_impl:
+    "xla" = the two-pass gather + sweep/sparse write below, "pallas" = the
+    fused probe→install→write megakernel (ops/pallas_probe), which
+    consumes the same install_payload16 rows and skips the `write` plan
+    entirely (one coalesced DMA per distinct bucket per block)."""
+    if probe == "pallas":
+        from gubernator_tpu.ops.pallas_probe import walk2_pallas_impl
+
+        return walk2_pallas_impl(
+            table, inst.fp, install_payload16(inst), inst.now, inst.active,
+            stage="install",
+        )
+
+    layout = table.layout
+    B = inst.fp.shape[0]
+    NB = table.rows.shape[0]
+    write = resolve_write(write, NB, B, layout)
+    if write == "sparse":
+        blk, u, g = sparse_geometry(NB, B)
+    else:
+        blk, u = sweep_geometry(NB, B)
+    c = _probe_claim2(table.rows, inst.fp, inst.now, inst.active, blk, u,
+                      layout)
+    new16 = install_payload16(inst)
     if write == "sweep":
         rows_out = _write_sweep(table.rows, new16, c, blk, u, layout)
     elif write == "sparse":
@@ -1347,67 +1374,27 @@ def install2_impl(
 
 
 install2 = functools.partial(
-    jax.jit, donate_argnums=(0,), static_argnames=("write",)
+    jax.jit, donate_argnums=(0,), static_argnames=("write", "probe")
 )(install2_impl)
 
 
 # ------------------------------------------------------- conservative merge
 
 
-def merge2_impl(
-    table: Table2, fp, slots, now, active, *, write: str = "xla",
-    evictees: bool = False,
-):
-    """Conservative merge of transferred table slots (the TransferState
-    receive path, docs/robustness.md "Topology change & drain").
-
-    Incoming rows arrive in the CANONICAL full-width slot layout ((B, 16)
-    i32): extract wires carry the sender's own layout, and the receiving
-    host unpacks them through ops/layout before this kernel — the one
-    full-width round-trip that keeps the conservatism rules below
-    layout-independent. Against an existing live entry the merge can only
-    ever TIGHTEN admission — the invariant that makes a retried,
-    duplicated, or crossed transfer unable to grant extra capacity:
-
-      * remaining  = min(stored, incoming)   (integer and leaky-float lanes;
-        REM_I is remaining-style for every integer algorithm, so min
-        uniformly tightens)
-      * raw aux lane (GCRA TAT / sliding-window prev count) = max — a later
-        TAT or larger previous count can only deny more
-      * expiry     = max(stored, incoming)   (state lives at least as long)
-      * OVER_LIMIT sticks (status = max)
-      * config (limit/burst/duration/algo) — newest stamp wins
-
-    Absent keys install the incoming slot verbatim (claim/evict machinery
-    shared with install2). Incoming rows already expired at the receiver's
-    clock are dropped — stale state must not resurrect. Returns
-    (table', merged_mask).
-
-    `evictees=True` (static — the tiering promote path) additionally
-    returns the (B, 16) i32 canonical rows of LIVE entries this merge's
-    installs displaced, so a shadow fault-back that lands in a full
-    bucket demotes the victim instead of silently destroying it — the
-    invariant that makes HBM + shadow a closed state set."""
-    layout = table.layout
-    B = fp.shape[0]
-    NB = table.rows.shape[0]
-    write = resolve_write(write, NB, B, layout)
-    if write == "sparse":
-        blk, u, gsteps = sparse_geometry(NB, B)
-    else:
-        blk, u = sweep_geometry(NB, B)
-
+def merge_payload16(fp, slots, lane16, owns, now):
+    """The per-row MERGE payload stage: (incoming canonical slot, chosen
+    stored lane, ownership mask, receiver clock) → (exists_mask, merged
+    (B, 16) i32 slot rows). Implements every conservatism rule documented
+    on merge2_impl — remaining=min, raw aux=max, expiry=max, OVER sticks,
+    newest-stamp config — and is shared VERBATIM by the two-pass XLA path
+    and the fused Pallas walk (ops/pallas_probe.walk2_pallas_impl calls it
+    in-kernel against the VMEM-resident lane). Factoring it out is what
+    makes the two merge paths bit-identical by construction."""
     g_i = lambda f: slots[:, f]
-    i_exp = _join64(g_i(EXP_LO), g_i(EXP_HI))
-    active = active & (i_exp >= now)
-
-    c = _probe_claim2(table.rows, fp, now, active, blk, u, layout)
-    lane16 = jnp.take_along_axis(c.slots, c.chosen[:, None, None], axis=1)[
-        :, 0, :
-    ]
     g_s = lambda f: lane16[:, f]
+    i_exp = _join64(g_i(EXP_LO), g_i(EXP_HI))
     s_exp = _join64(g_s(EXP_LO), g_s(EXP_HI))
-    exists = c.owns & (s_exp >= now)
+    exists = owns & (s_exp >= now)
 
     i_stamp = _join64(g_i(STAMP_LO), g_i(STAMP_HI))
     s_stamp = _join64(g_s(STAMP_LO), g_s(STAMP_HI))
@@ -1468,7 +1455,7 @@ def merge2_impl(
     remf_lo = jnp.where(
         aux_algo, _lo32(aux), jax.lax.bitcast_convert_type(remf_lo_f, i32)
     )
-    zero = jnp.zeros((B,), dtype=i32)
+    zero = jnp.zeros(fp.shape, dtype=i32)
     new16 = jnp.stack(
         [
             _lo32(fp),
@@ -1490,6 +1477,74 @@ def merge2_impl(
         ],
         axis=1,
     )
+    return exists, new16
+
+
+def merge2_impl(
+    table: Table2, fp, slots, now, active, *, write: str = "xla",
+    evictees: bool = False, probe: str = "xla",
+):
+    """Conservative merge of transferred table slots (the TransferState
+    receive path, docs/robustness.md "Topology change & drain").
+
+    Incoming rows arrive in the CANONICAL full-width slot layout ((B, 16)
+    i32): extract wires carry the sender's own layout, and the receiving
+    host unpacks them through ops/layout before this kernel — the one
+    full-width round-trip that keeps the conservatism rules below
+    layout-independent. Against an existing live entry the merge can only
+    ever TIGHTEN admission — the invariant that makes a retried,
+    duplicated, or crossed transfer unable to grant extra capacity:
+
+      * remaining  = min(stored, incoming)   (integer and leaky-float lanes;
+        REM_I is remaining-style for every integer algorithm, so min
+        uniformly tightens)
+      * raw aux lane (GCRA TAT / sliding-window prev count) = max — a later
+        TAT or larger previous count can only deny more
+      * expiry     = max(stored, incoming)   (state lives at least as long)
+      * OVER_LIMIT sticks (status = max)
+      * config (limit/burst/duration/algo) — newest stamp wins
+
+    Absent keys install the incoming slot verbatim (claim/evict machinery
+    shared with install2). Incoming rows already expired at the receiver's
+    clock are dropped — stale state must not resurrect. Returns
+    (table', merged_mask).
+
+    `evictees=True` (static — the tiering promote path) additionally
+    returns the (B, 16) i32 canonical rows of LIVE entries this merge's
+    installs displaced, so a shadow fault-back that lands in a full
+    bucket demotes the victim instead of silently destroying it — the
+    invariant that makes HBM + shadow a closed state set.
+
+    `probe` (static) selects the table walk, mirroring decide2_impl:
+    "xla" = the two-pass gather + sweep/sparse write below, "pallas" = the
+    fused probe→merge→write megakernel (ops/pallas_probe), which calls
+    merge_payload16 in-kernel against the VMEM-resident lane and skips the
+    `write` plan entirely."""
+    g_i = lambda f: slots[:, f]
+    i_exp = _join64(g_i(EXP_LO), g_i(EXP_HI))
+    active = active & (i_exp >= now)
+
+    if probe == "pallas":
+        from gubernator_tpu.ops.pallas_probe import walk2_pallas_impl
+
+        return walk2_pallas_impl(
+            table, fp, slots, now, active, stage="merge", evictees=evictees,
+        )
+
+    layout = table.layout
+    B = fp.shape[0]
+    NB = table.rows.shape[0]
+    write = resolve_write(write, NB, B, layout)
+    if write == "sparse":
+        blk, u, gsteps = sparse_geometry(NB, B)
+    else:
+        blk, u = sweep_geometry(NB, B)
+
+    c = _probe_claim2(table.rows, fp, now, active, blk, u, layout)
+    lane16 = jnp.take_along_axis(c.slots, c.chosen[:, None, None], axis=1)[
+        :, 0, :
+    ]
+    exists, new16 = merge_payload16(fp, slots, lane16, c.owns, now)
     if write == "sweep":
         rows_out = _write_sweep(table.rows, new16, c, blk, u, layout)
     elif write == "sparse":
@@ -1503,5 +1558,5 @@ def merge2_impl(
 
 
 merge2 = functools.partial(
-    jax.jit, donate_argnums=(0,), static_argnames=("write", "evictees")
+    jax.jit, donate_argnums=(0,), static_argnames=("write", "evictees", "probe")
 )(merge2_impl)

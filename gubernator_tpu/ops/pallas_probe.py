@@ -46,6 +46,24 @@ claim sort and the 64-bit decide lanes are the known lowering-risk spots,
 which is why `GUBER_PROBE_KERNEL` defaults to ``xla`` and the bench
 `probe` phase records the Pallas path per kernel × layout on the next
 device run before any default flips.
+
+Beyond decide, the same probe→payload→write structure serves the OTHER
+two table walks (`walk2_pallas_impl`): GLOBAL installs (`install2`) and
+conservative merges (`merge2` — region sync, handoff, tiering promotes)
+run as fused probe→install/merge→write walks, sharing the claim/carry/
+write machinery verbatim. Their payload stages are the factored
+`kernel2.install_payload16` / `kernel2.merge_payload16` — the same
+shared-stage contract that makes decide bit-identical. Selection rides
+`GUBER_WALK_KERNEL` (ops/plan.py), independent of the decide knob.
+
+Write-side overlap: dirty-row write-backs no longer serialize against
+the next block. Block *g* only STARTS its write DMAs; block *g+1* waits
+them (`wdirty` parity scratch) just before reusing the buffer half —
+in the HBM-bound steady state stores fly concurrently with the next
+block's compute and fetch-waits instead of stalling the inner loop.
+The data-movement layer is its own knob (`GUBER_PROBE_MOVEMENT`) so the
+deferred-wait DMA protocol is testable on CPU through the interpret
+emulation, not just on device.
 """
 
 from __future__ import annotations
@@ -70,6 +88,7 @@ from gubernator_tpu.ops.kernel2 import (
     _sweep_x64_ctx,
     assemble_resp,
     decide_payload,
+    merge_payload16,
     resolve_write,
     sparse_geometry,
 )
@@ -125,6 +144,32 @@ def probe_blk(batch: int) -> int:
     return blk
 
 
+def probe_movement(interpret: bool) -> str:
+    """GUBER_PROBE_MOVEMENT: auto | interp | dma — which data-movement
+    layer the megakernel traces (_make_probe_kernel docstring). "auto" is
+    the measured-best pairing: the vectorized-gather + epilogue-scatter
+    variant on CPU interpret backends, real async-DMA descriptors on
+    device. "dma" on a CPU backend forces the DMA protocol through the
+    interpret emulation — ~12× slower per dispatch, but it is the only
+    host-side way to exercise the deferred write-back waits and semaphore
+    accounting, which is what the movement-parity tests pin. "interp" on
+    a real device is meaningless (the gather variant's epilogue scatter
+    defeats the fusion) and rejected."""
+    v = os.environ.get("GUBER_PROBE_MOVEMENT", "auto")
+    if v not in ("auto", "interp", "dma"):
+        raise ValueError(
+            f"GUBER_PROBE_MOVEMENT must be auto, interp or dma, got {v!r}"
+        )
+    if v == "auto":
+        return "interp" if interpret else "dma"
+    if v == "interp" and not interpret:
+        raise ValueError(
+            "GUBER_PROBE_MOVEMENT=interp is CPU-interpret-only; device "
+            "backends must run the DMA movement"
+        )
+    return v
+
+
 def hbm_bytes_per_decision(
     layout, batch: int, n_buckets: int, write: str, probe: str = "xla"
 ) -> float:
@@ -161,14 +206,39 @@ def hbm_bytes_per_decision(
 # --------------------------------------------------------------- prologue
 
 
-def _sorted_schedule(req: ReqBatch, NB: int, rblk: int):
+def _req_lanes(req: ReqBatch) -> jnp.ndarray:
+    """The decide stage's (12, B) i64 kernel ingress (req_from_arr
+    layout); ONE gather in _sorted_schedule permutes every column at
+    once."""
+    return jnp.stack(
+        [
+            req.fp,
+            req.algo.astype(i64),
+            req.behavior.astype(i64),
+            req.hits,
+            req.limit,
+            req.burst,
+            req.duration,
+            req.created_at,
+            req.expire_new,
+            req.greg_interval,
+            req.duration_eff,
+            req.active.astype(i64),
+        ]
+    )
+
+
+def _sorted_schedule(fp, active, arrN, NB: int, rblk: int):
     """Bucket-sort the batch and derive the megakernel's block schedule.
 
-    Returns (idx_s, arr12_s, meta, sb, bkf):
+    `arrN` is the stage's (N, B) i64 ingress lane stack — the 12 decide
+    request columns (_req_lanes) or the 11 walk lanes (fp, now, active,
+    8 payload pairs; walk2_pallas_impl). Returns (idx_s, arr_s, meta, sb,
+    bkf, G):
       * idx_s    — (B,) i32 original index at each sorted position (the
                    epilogue's un-sort key);
-      * arr12_s  — (12, B) i64 sorted request columns (req_from_arr
-                   layout, the kernel's blocked ingress);
+      * arr_s    — (N, B) i64 sorted ingress lanes (the kernel's blocked
+                   ingress);
       * meta     — (3, B) i32 [sort key, VMEM row slot, fetch bucket];
       * sb       — (G·rblk,) i32 per-(block, slot) bucket to fetch,
                    sentinel NB for unused slots (the DMA index vector);
@@ -187,32 +257,15 @@ def _sorted_schedule(req: ReqBatch, NB: int, rblk: int):
     an inactive row whose bucket another row already fetches — maps to
     one VMEM slot, so it costs one DMA descriptor each way and the
     write-back scatter never carries duplicate row indices."""
-    B = req.fp.shape[0]
+    B = fp.shape[0]
     G = B // rblk
-    bucket = (req.fp % NB).astype(i32)
-    bkey = jnp.where(req.active, bucket, i32(NB))
+    bucket = (fp % NB).astype(i32)
+    bkey = jnp.where(active, bucket, i32(NB))
     idx = jnp.arange(B, dtype=i32)
     bkey_s, idx_s = jax.lax.sort((bkey, idx), num_keys=1)
     fbucket_s = bucket[idx_s]
 
-    # ONE (12, B) gather permutes every request column at once
-    arr12 = jnp.stack(
-        [
-            req.fp,
-            req.algo.astype(i64),
-            req.behavior.astype(i64),
-            req.hits,
-            req.limit,
-            req.burst,
-            req.duration,
-            req.created_at,
-            req.expire_new,
-            req.greg_interval,
-            req.duration_eff,
-            req.active.astype(i64),
-        ]
-    )
-    arr12_s = arr12[:, idx_s]
+    arr_s = arrN[:, idx_s]
 
     pos = jnp.arange(B, dtype=i32)
     blk_id = pos // i32(rblk)
@@ -237,18 +290,34 @@ def _sorted_schedule(req: ReqBatch, NB: int, rblk: int):
     )
     bkf = bkey_s[:: rblk]
     meta = jnp.stack([bkey_s, rs, fbucket_s])
-    return idx_s, arr12_s, meta, sb, bkf, G
+    return idx_s, arr_s, meta, sb, bkf, G
 
 
 # --------------------------------------------------------------- kernel
 
 
 def _make_probe_kernel(layout, rblk: int, NB: int, G: int, math: str,
-                       interp: bool, evictees: bool = False):
+                       interp: bool, evictees: bool = False,
+                       stage: str = "decide"):
     """Kernel factory (closes over static geometry + layout + math mode).
+
+    `stage` (static) picks the payload computed between probe and write —
+    "decide" (kernel2.decide_payload, the request path), "install"
+    (prologue-precomputed install_payload16 rows ride the ingress lanes;
+    the kernel just unjoins them) or "merge" (kernel2.merge_payload16
+    against the VMEM-resident claimed lane). Claim, carry, compose and
+    write machinery are IDENTICAL across stages — that is the point: the
+    fused walk inherits the decide kernel's proven coalescing and
+    carry-correctness wholesale.
 
     Scratch protocol (persists across grid steps):
       fbuf  (2, rblk, rowl)  double-buffered fetched bucket rows
+      wdirty (2, rblk)       per-parity dirty masks of IN-FLIGHT write-
+                             backs: block g only STARTS its dirty-row
+                             copies; the step that reuses that buffer
+                             half (g+1, before refilling it) waits them —
+                             write-backs overlap the next block's compute
+                             instead of stalling the inner loop
       obuf  (rblk, _OUTW)    per-block response staging (DMA'd per step)
       cstage (1, rowl)       carry-flush row staging
       pstage (K, _OUTW)      deferred-response patch staging
@@ -295,13 +364,13 @@ def _make_probe_kernel(layout, rblk: int, NB: int, G: int, math: str,
             # slot-payload staging outputs + the epilogue-scatter protocol
             # (factory docstring); the table is a read-only input here
             (ptgt_out, pay_out, ctgt_out, crows_out, resp_out) = rest[:5]
-            (fbuf, obuf, cstage, pstage, crow, cop, cip, cmask, cdo,
-             cdmeta, cscal, fsem, wsem, osem, psem) = rest[5:]
+            (fbuf, wdirty, obuf, cstage, pstage, crow, cop, cip, cmask,
+             cdo, cdmeta, cscal, fsem, wsem, osem, psem) = rest[5:]
             rows_out = None
         else:
             rows_out, resp_out = rest[:2]
-            (fbuf, obuf, cstage, pstage, crow, cop, cip, cmask, cdo,
-             cdmeta, cscal, fsem, wsem, osem, psem) = rest[2:]
+            (fbuf, wdirty, obuf, cstage, pstage, crow, cop, cip, cmask,
+             cdo, cdmeta, cscal, fsem, wsem, osem, psem) = rest[2:]
         NBc = i32(NB)
         lane_iota_k = jax.lax.broadcasted_iota(i32, (rblk, K), 1)
         g = pl.program_id(0)
@@ -338,6 +407,35 @@ def _make_probe_kernel(layout, rblk: int, NB: int, G: int, math: str,
                 return c
             jax.lax.fori_loop(0, rblk, wait_cur, 0)
 
+            # retire block g-1's still-in-flight write-backs BEFORE the
+            # next fetch refills their source buffer half (fbuf[1-p]) —
+            # the only ordering the deferred-write protocol needs.
+            # Bucket-sorted runs guarantee no later block FETCHES a row
+            # an earlier block writes (the carry owns straddlers), so
+            # the stores can fly concurrently with this block's fetch
+            # waits and compute.
+            def write_copy(blk_i32, parity, n):
+                return pltpu.make_async_copy(
+                    fbuf.at[parity, n],
+                    rows_out.at[sb_ref[blk_i32 * i32(rblk) + n]],
+                    wsem,
+                )
+
+            @pl.when(g > i32(0))
+            def _():
+                def wait_prev(n, c):
+                    dn = jax.lax.dynamic_index_in_dim(
+                        wdirty[i32(1) - p], n, keepdims=False
+                    )
+                    @pl.when(
+                        (sb_ref[(g - i32(1)) * i32(rblk) + n] < NBc)
+                        & (dn != 0)
+                    )
+                    def _():
+                        write_copy(g - i32(1), i32(1) - p, n).wait()
+                    return c
+                jax.lax.fori_loop(0, rblk, wait_prev, 0)
+
             @pl.when(g + i32(1) < i32(G))
             def _():
                 def issue_next(n, c):
@@ -349,25 +447,39 @@ def _make_probe_kernel(layout, rblk: int, NB: int, G: int, math: str,
             fb = fbuf[p]
 
         # ---------------- probe + claim (block-local `_probe_claim2`) ----
-        arr = arr_ref[...]  # (12, rblk) i64 sorted request columns
-        reqb = ReqBatch(
-            fp=arr[0],
-            algo=arr[1].astype(i32),
-            behavior=arr[2].astype(i32),
-            hits=arr[3],
-            limit=arr[4],
-            burst=arr[5],
-            duration=arr[6],
-            created_at=arr[7],
-            expire_new=arr[8],
-            greg_interval=arr[9],
-            duration_eff=arr[10],
-            active=arr[11] != 0,
-        )
+        arr = arr_ref[...]  # (NL, rblk) i64 sorted ingress lanes
+        if stage == "decide":
+            reqb = ReqBatch(
+                fp=arr[0],
+                algo=arr[1].astype(i32),
+                behavior=arr[2].astype(i32),
+                hits=arr[3],
+                limit=arr[4],
+                burst=arr[5],
+                duration=arr[6],
+                created_at=arr[7],
+                expire_new=arr[8],
+                greg_interval=arr[9],
+                duration_eff=arr[10],
+                active=arr[11] != 0,
+            )
+            fpv = reqb.fp
+            active = reqb.active
+            now = reqb.created_at
+            in16 = None
+        else:
+            # walk ingress (walk2_pallas_impl): [fp, now, active,
+            # 8 × (hi<<32)|lo payload pairs] — the incoming canonical
+            # (rblk, 16) i32 rows, unjoined losslessly in-register
+            fpv = arr[0]
+            now = arr[1]
+            active = arr[2] != 0
+            pairs_t = arr[3:11].T  # (rblk, 8)
+            in16 = jnp.stack(
+                [_lo32(pairs_t), _hi32(pairs_t)], axis=-1
+            ).reshape(rblk, 16)
         bk = meta_ref[0, :]  # (rblk,) sort keys
         rs = meta_ref[1, :]  # VMEM row slot per request
-        active = reqb.active
-        now = reqb.created_at
 
         # rows_r: (rblk, rowl) each request's bucket row — pre-dispatch
         # bytes in both movement variants (no block ever reads a row
@@ -380,8 +492,8 @@ def _make_probe_kernel(layout, rblk: int, NB: int, G: int, math: str,
             rows_r = jnp.take(fb, rs, axis=0)
         slots = layout.unpack(rows_r.reshape(rblk, K, Fl))  # (rblk, K, 16)
 
-        my_lo = _lo32(reqb.fp)
-        my_hi = _hi32(reqb.fp)
+        my_lo = _lo32(fpv)
+        my_hi = _hi32(fpv)
         s_fp_lo = slots[:, :, FP_LO]
         s_fp_hi = slots[:, :, FP_HI]
         empty = (s_fp_lo == 0) & (s_fp_hi == 0)
@@ -472,11 +584,23 @@ def _make_probe_kernel(layout, rblk: int, NB: int, G: int, math: str,
         )[:, 0]
         written = got & ~killed_ins & ~owner_killed
 
-        # ---------------- decide (shared stage, bit-identical) -----------
+        # ---------------- payload (shared stage, bit-identical) ----------
         lane16 = jnp.take_along_axis(
             slots, chosen[:, None, None], axis=1
         )[:, 0, :]
-        exists, d, new16 = decide_payload(lane16, reqb, owns, math=math)
+        if stage == "decide":
+            exists, d, new16 = decide_payload(lane16, reqb, owns, math=math)
+        elif stage == "install":
+            # install rows are a pure function of the batch — precomputed
+            # by the entry's install_payload16 prologue, they ride the
+            # ingress lanes; owners overwrite their lane unconditionally
+            # (install2's own rule), so exists is bookkeeping only
+            d = None
+            exists = owns
+            new16 = in16
+        else:  # merge
+            d = None
+            exists, new16 = merge_payload16(fpv, in16, lane16, owns, now)
         pay = layout.pack(new16)  # (rblk, Fl)
 
         # ---------------- segment classification -------------------------
@@ -515,47 +639,65 @@ def _make_probe_kernel(layout, rblk: int, NB: int, G: int, math: str,
                 .any(axis=1)
             )
             fbuf[p] = fb_new
-            dirty_i = dirty.astype(i32)
+            wdirty[p] = dirty.astype(i32)
 
+            # START the dirty-row copies only — the step that next reuses
+            # this buffer half waits them (wait_prev above), overlapping
+            # the stores with block g+1's compute; the final grid step
+            # retires its own writes before the kernel exits
             def write_row(n, c):
-                dn = jax.lax.dynamic_index_in_dim(dirty_i, n, keepdims=False)
+                dn = jax.lax.dynamic_index_in_dim(
+                    wdirty[p], n, keepdims=False
+                )
                 @pl.when((sb_ref[g * i32(rblk) + n] < NBc) & (dn != 0))
                 def _():
-                    pltpu.make_async_copy(
-                        fbuf.at[p, n],
-                        rows_out.at[sb_ref[g * i32(rblk) + n]],
-                        wsem,
-                    ).start()
+                    write_copy(g, p, n).start()
                 return c
             jax.lax.fori_loop(0, rblk, write_row, 0)
 
-            def wait_row(n, c):
-                dn = jax.lax.dynamic_index_in_dim(dirty_i, n, keepdims=False)
-                @pl.when((sb_ref[g * i32(rblk) + n] < NBc) & (dn != 0))
-                def _():
-                    pltpu.make_async_copy(
-                        fbuf.at[p, n],
-                        rows_out.at[sb_ref[g * i32(rblk) + n]],
-                        wsem,
-                    ).wait()
-                return c
-            jax.lax.fori_loop(0, rblk, wait_row, 0)
+            @pl.when(g == i32(G - 1))
+            def _():
+                def wait_last(n, c):
+                    dn = jax.lax.dynamic_index_in_dim(
+                        wdirty[p], n, keepdims=False
+                    )
+                    @pl.when((sb_ref[g * i32(rblk) + n] < NBc) & (dn != 0))
+                    def _():
+                        write_copy(g, p, n).wait()
+                    return c
+                jax.lax.fori_loop(0, rblk, wait_last, 0)
 
         # ---------------- per-block responses -----------------------------
         evict = claim_ok & lane_live & written
-        outb = jnp.stack(
-            [
-                d.resp_status.astype(i64),
-                d.resp_rem,
-                d.resp_reset,
-                exists.astype(i64),
-                written.astype(i64),
-                evict.astype(i64),
-                d.aux_out,
-                d.rem_i_out,
-            ],
-            axis=1,
-        )  # (rblk, _OUTW)
+        if stage == "decide":
+            outb = jnp.stack(
+                [
+                    d.resp_status.astype(i64),
+                    d.resp_rem,
+                    d.resp_reset,
+                    exists.astype(i64),
+                    written.astype(i64),
+                    evict.astype(i64),
+                    d.aux_out,
+                    d.rem_i_out,
+                ],
+                axis=1,
+            )  # (rblk, _OUTW)
+        else:
+            # walks answer only the masks; the response columns keep the
+            # decide width so the carry patch machinery (cdo rows, the
+            # _OC_WRITTEN/_OC_EVICT flips) is shared untouched
+            z = jnp.zeros((rblk,), dtype=i64)
+            outb = jnp.stack(
+                [
+                    z, z, z,
+                    exists.astype(i64),
+                    written.astype(i64),
+                    evict.astype(i64),
+                    z, z,
+                ],
+                axis=1,
+            )  # (rblk, _OUTW)
         if evictees:
             # candidate victim row (pre-dispatch claimed-lane state); the
             # FINAL verdict is the patched _OC_EVICT — epilogue masks
@@ -708,26 +850,22 @@ def _make_probe_kernel(layout, rblk: int, NB: int, G: int, math: str,
 # --------------------------------------------------------------- entry
 
 
-def decide2_pallas_impl(
-    table: Table2, req: ReqBatch, *, math: str = "mixed",
-    evictees: bool = False,
-):
-    """Fused-megakernel twin of `kernel2.decide2_impl` (reached through its
-    ``probe="pallas"`` switch — call sites never import this directly).
-    Same signature contract: (table', RespBatch, BatchStats), decision-
-    bit-identical modulo the sweep-window divergence documented above.
-    ``evictees=True`` (static) widens the out rows by the candidate-victim
-    lanes (_OUTW_EV) and returns a 4th element: the (B, 16) i32 evictee
-    sidecar, victim rows where the final evict verdict holds."""
+def _launch_walk(table: Table2, arr_s, meta, sb, bkf, G: int, rblk: int, *,
+                 math: str, evictees: bool, stage: str):
+    """Shared pallas_call scaffolding for every stage (decide + the
+    install/merge walks): block the sorted ingress lanes, wire the
+    scratch protocol, run the kernel, and — interp movement — apply the
+    staged slot/carry writes to the DONATED table in one epilogue
+    scatter. Returns (rows_out, resp_s), responses still in sorted
+    order."""
     layout = table.layout
     NB = table.rows.shape[0]
-    B = req.fp.shape[0]
-    rblk = probe_blk(B)
-    idx_s, arr12_s, meta, sb, bkf, G = _sorted_schedule(req, NB, rblk)
+    nl, B = arr_s.shape
     outw = _OUTW_EV if evictees else _OUTW
 
     interpret = jax.default_backend() == "cpu"
-    if interpret:
+    interp = probe_movement(interpret) == "interp"
+    if interp:
         # slot-payload staging outputs; the table stays a read-only input
         # and the donated-scatter epilogue below applies the writes in
         # place (_make_probe_kernel docstring: an in-kernel ref scatter on
@@ -752,7 +890,7 @@ def decide2_pallas_impl(
         num_scalar_prefetch=2,
         grid=(G,),
         in_specs=[
-            pl.BlockSpec((12, rblk), lambda g, sb, bkf: (0, g)),
+            pl.BlockSpec((nl, rblk), lambda g, sb, bkf: (0, g)),
             pl.BlockSpec((3, rblk), lambda g, sb, bkf: (0, g)),
             pl.BlockSpec((1, rblk), lambda g, sb, bkf: (0, g)),
             pl.BlockSpec(memory_space=_ANY),
@@ -760,6 +898,7 @@ def decide2_pallas_impl(
         out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((2, rblk, layout.row), jnp.int32),  # fbuf
+            pltpu.VMEM((2, rblk), jnp.int32),  # wdirty
             pltpu.VMEM((rblk, outw), jnp.int64),  # obuf
             pltpu.VMEM((1, layout.row), jnp.int32),  # cstage
             pltpu.VMEM((K, outw), jnp.int64),  # pstage
@@ -778,14 +917,14 @@ def decide2_pallas_impl(
     )
     with _sweep_x64_ctx(interpret):
         outs = pl.pallas_call(
-            _make_probe_kernel(layout, rblk, NB, G, math, interpret,
-                               evictees),
+            _make_probe_kernel(layout, rblk, NB, G, math, interp,
+                               evictees, stage),
             interpret=interpret,
             out_shape=out_shape,
             grid_spec=grid_spec,
             input_output_aliases=aliases,
-        )(sb, bkf, arr12_s, meta, sb.reshape(1, G * rblk), table.rows)
-    if interpret:
+        )(sb, bkf, arr_s, meta, sb.reshape(1, G * rblk), table.rows)
+    if interp:
         ptgt, pay_s, ctgt, crows, resp_s = outs
         # the table write: one slot-granular scatter of the written rows'
         # payloads (`_write_xla`'s own pattern), then the carried buckets'
@@ -800,6 +939,32 @@ def decide2_pallas_impl(
             rows_out = rows_out.at[ctgt[0]].set(crows, mode="drop")
     else:
         rows_out, resp_s = outs
+    return rows_out, resp_s
+
+
+def decide2_pallas_impl(
+    table: Table2, req: ReqBatch, *, math: str = "mixed",
+    evictees: bool = False,
+):
+    """Fused-megakernel twin of `kernel2.decide2_impl` (reached through its
+    ``probe="pallas"`` switch — call sites never import this directly).
+    Same signature contract: (table', RespBatch, BatchStats), decision-
+    bit-identical modulo the sweep-window divergence documented above.
+    ``evictees=True`` (static) widens the out rows by the candidate-victim
+    lanes (_OUTW_EV) and returns a 4th element: the (B, 16) i32 evictee
+    sidecar, victim rows where the final evict verdict holds."""
+    layout = table.layout
+    NB = table.rows.shape[0]
+    B = req.fp.shape[0]
+    rblk = probe_blk(B)
+    idx_s, arr_s, meta, sb, bkf, G = _sorted_schedule(
+        req.fp, req.active, _req_lanes(req), NB, rblk
+    )
+    rows_out, resp_s = _launch_walk(
+        table, arr_s, meta, sb, bkf, G, rblk,
+        math=math, evictees=evictees, stage="decide",
+    )
+    outw = _OUTW_EV if evictees else _OUTW
 
     # un-sort the response rows back to batch order
     out = jnp.zeros((B, outw), dtype=i64).at[idx_s].set(resp_s)
@@ -829,9 +994,68 @@ decide2_pallas = functools.partial(
 )(decide2_pallas_impl)
 
 
+def walk2_pallas_impl(
+    table: Table2, fp, pay16, now, active, *, stage: str,
+    evictees: bool = False,
+):
+    """Fused-megakernel twin of `install2` / `merge2`: the probe→
+    install/merge→write walk, reached through their ``probe="pallas"``
+    switches — call sites never import this directly.
+
+    `pay16` is the (B, 16) i32 canonical ingress: for ``stage="install"``
+    the precomputed `kernel2.install_payload16` rows (the install payload
+    never reads table state, so it rides the ingress lanes and the kernel
+    just unjoins it), for ``stage="merge"`` the raw incoming slot rows
+    (`kernel2.merge_payload16` runs in-kernel against the claimed VMEM
+    lane). `now` broadcasts to per-row like the XLA path's (B,) clock.
+    The caller applies merge's expired-incoming filter to `active` BEFORE
+    this entry (merge2_impl does) — the walk itself treats `active` as
+    the claim mask, exactly like `_probe_claim2`.
+
+    Returns ``(table', active & written_mask)``, plus the (B, 16) i32
+    evictee sidecar when ``evictees=True`` — the install2/merge2 return
+    contracts exactly, bit-identical modulo the documented sweep-window
+    divergence (the walk can only drop FEWER rows)."""
+    if stage not in ("install", "merge"):
+        raise ValueError(f"stage must be install or merge, got {stage!r}")
+    layout = table.layout
+    NB = table.rows.shape[0]
+    B = fp.shape[0]
+    rblk = probe_blk(B)
+    now = jnp.broadcast_to(jnp.asarray(now, dtype=i64), fp.shape)
+    pay16 = jnp.asarray(pay16, dtype=i32)
+    pairs = _join64(pay16[:, 0::2], pay16[:, 1::2])  # (B, 8) lossless
+    arr11 = jnp.concatenate(
+        [fp[None, :], now[None, :], active.astype(i64)[None, :], pairs.T],
+        axis=0,
+    )
+    idx_s, arr_s, meta, sb, bkf, G = _sorted_schedule(
+        fp, active, arr11, NB, rblk
+    )
+    rows_out, resp_s = _launch_walk(
+        table, arr_s, meta, sb, bkf, G, rblk,
+        math="mixed", evictees=evictees, stage=stage,
+    )
+    outw = _OUTW_EV if evictees else _OUTW
+    out = jnp.zeros((B, outw), dtype=i64).at[idx_s].set(resp_s)
+    written = out[:, _OC_WRITTEN] != 0
+    tbl = Table2(rows=rows_out, layout=layout)
+    if evictees:
+        evict_live = out[:, _OC_EVICT] != 0
+        evcols = out[:, _OUTW:]  # (B, 8) i64 candidate victim pairs
+        ev16 = jnp.stack(
+            [_lo32(evcols), _hi32(evcols)], axis=-1
+        ).reshape(B, 16)
+        ev16 = jnp.where(evict_live[:, None], ev16, 0)
+        return tbl, active & written, ev16
+    return tbl, active & written
+
+
 __all__ = [
     "decide2_pallas",
     "decide2_pallas_impl",
     "hbm_bytes_per_decision",
     "probe_blk",
+    "probe_movement",
+    "walk2_pallas_impl",
 ]

@@ -58,6 +58,34 @@ def default_probe_kernel() -> str:
     return "xla" if v == "auto" else v
 
 
+def walk_kernel_env() -> str:
+    """The GUBER_WALK_KERNEL knob: auto | xla | pallas — which kernel the
+    NON-decide table walks (GLOBAL installs, region/handoff merges,
+    tiering promotes) compile: the two-pass gather + sweep/sparse write,
+    or the fused probe→install/merge→write megakernel
+    (ops/pallas_probe.walk2_pallas_impl). Deliberately independent of
+    GUBER_PROBE_KERNEL: the decide path is latency-critical per request
+    while the walks are throughput paths on the sync/maintenance planes,
+    so a deployment can flip either without the other. Read per engine
+    construction, like the probe knob."""
+    v = os.environ.get("GUBER_WALK_KERNEL", "auto")
+    if v not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"GUBER_WALK_KERNEL must be auto, xla or pallas, got {v!r}"
+        )
+    return v
+
+
+def default_walk_kernel() -> str:
+    """Resolve the walk-kernel plan: "xla" unless GUBER_WALK_KERNEL=pallas
+    opts the install/merge walks into the fused megakernel — same
+    conservative default-flip policy as default_probe_kernel (the bench
+    `dispatch` phase's fused-vs-two-pass wall on a real device gates any
+    auto flip)."""
+    v = walk_kernel_env()
+    return "xla" if v == "auto" else v
+
+
 @dataclass
 class Pass:
     rows: np.ndarray  # original row indices whose response comes from this pass

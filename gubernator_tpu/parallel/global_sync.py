@@ -433,6 +433,7 @@ class GlobalShardedEngine(ShardedEngine):
         a2a: Optional[str] = None,
         layout: Optional[str] = None,
         probe: Optional[str] = None,
+        walk: Optional[str] = None,
     ):
         super().__init__(
             mesh,
@@ -447,6 +448,7 @@ class GlobalShardedEngine(ShardedEngine):
             a2a=a2a,
             layout=layout,
             probe=probe,
+            walk=walk,
         )
         # the replica table + collective step materialize on first GLOBAL
         # use: clustered daemons route GLOBAL over the host peer plane and

@@ -188,15 +188,21 @@ def make_sharded_decide(
     return jax.jit(fn, donate_argnums=_staging_donate(), keep_unused=True)
 
 
-def make_sharded_install(mesh: Mesh, write: Optional[str] = None):
+def make_sharded_install(mesh: Mesh, write: Optional[str] = None,
+                         probe: str = "xla"):
     """All-shards install step for owner-authoritative GLOBAL statuses —
-    the UpdatePeerGlobals receive path on a sharded daemon."""
+    the UpdatePeerGlobals receive path on a sharded daemon. `probe`
+    (static) selects the per-shard table walk — the two-pass gather +
+    write or the fused Pallas walk (GUBER_WALK_KERNEL); like decide, the
+    megakernel composes with shard_map for free because it runs per
+    device shard."""
     write = write or default_write_mode()
 
     def per_device(table: Table2, inst: InstallBatch):
         table = jax.tree.map(lambda x: x[0], table)
         inst = jax.tree.map(lambda x: x[0], inst)
-        table, installed = install2_impl(table, inst, write=write)
+        table, installed = install2_impl(table, inst, write=write,
+                                         probe=probe)
         expand = lambda t: jax.tree.map(lambda x: x[None], t)
         return expand(table), expand(installed)
 
@@ -211,7 +217,7 @@ def make_sharded_install(mesh: Mesh, write: Optional[str] = None):
 
 
 def make_sharded_merge(mesh: Mesh, write: Optional[str] = None,
-                       evictees: bool = False):
+                       evictees: bool = False, probe: str = "xla"):
     """All-shards conservative-merge step (kernel2.merge2_impl) — the
     TransferState receive path on a sharded daemon: transferred slot rows
     are routed to their owning shard and merged with remaining=min /
@@ -228,11 +234,12 @@ def make_sharded_merge(mesh: Mesh, write: Optional[str] = None,
         if evictees:
             table, merged, ev = merge2_impl(
                 table, fp[0], slots[0], now[0], active[0], write=write,
-                evictees=True,
+                evictees=True, probe=probe,
             )
             return expand(table), expand(merged), expand(ev)
         table, merged = merge2_impl(
-            table, fp[0], slots[0], now[0], active[0], write=write
+            table, fp[0], slots[0], now[0], active[0], write=write,
+            probe=probe,
         )
         return expand(table), expand(merged)
 
@@ -400,9 +407,13 @@ class ShardedEngine:
         a2a: Optional[str] = None,
         layout: Optional[str] = None,
         probe: Optional[str] = None,
+        walk: Optional[str] = None,
     ):
         from gubernator_tpu.ops.layout import resolve_layout
-        from gubernator_tpu.ops.plan import default_probe_kernel
+        from gubernator_tpu.ops.plan import (
+            default_probe_kernel,
+            default_walk_kernel,
+        )
         from gubernator_tpu.ops.wire import default_wire_mode
         from gubernator_tpu.parallel.ring import a2a_impl
 
@@ -456,6 +467,13 @@ class ShardedEngine:
         if probe is not None and probe not in ("xla", "pallas"):
             raise ValueError(f"probe must be 'xla' or 'pallas', got {probe!r}")
         self.probe_mode = probe or default_probe_kernel()
+        # table-walk kernel for the install/merge walks (GUBER_WALK_KERNEL):
+        # threaded into the per-shard install/merge programs exactly like
+        # probe_mode into decide — the walks run per device shard inside
+        # shard_map, so the fused megakernel composes for free
+        if walk is not None and walk not in ("xla", "pallas"):
+            raise ValueError(f"walk must be 'xla' or 'pallas', got {walk!r}")
+        self.walk_mode = walk or default_walk_kernel()
         # host↔device wire format for decide dispatches and the GLOBAL sync
         # outbox: "compact" ships 5-lane int32 ingress grids + int32 egress
         # (ops/wire.py — the TPU default, GUBER_WIRE_COMPACT), "full" the
@@ -463,7 +481,9 @@ class ShardedEngine:
         # encodability still falls compact batches back to full-width.
         self.wire = wire or default_wire_mode()
         self._decide_fns = {}  # (kind, …, math) → jitted mesh step (lazy)
-        self._install = make_sharded_install(mesh, write=self.write_mode)
+        self._install = make_sharded_install(
+            mesh, write=self.write_mode, probe=self.walk_mode
+        )
         # handoff mesh steps, built lazily (most engines never rebalance)
         self._merge_fn = None
         self._tombstone_fn = None
@@ -844,7 +864,8 @@ class ShardedEngine:
             fn = getattr(self, "_merge_ev_fn", None)
             if fn is None:
                 fn = self._merge_ev_fn = make_sharded_merge(
-                    self.mesh, write=self.write_mode, evictees=True
+                    self.mesh, write=self.write_mode, evictees=True,
+                    probe=self.walk_mode,
                 )
             self.table, merged, ev = fn(
                 self.table, put(fp_g), put(slots_g), put(now_g), put(act_g)
@@ -859,7 +880,9 @@ class ShardedEngine:
             keep = ev_fp != 0
             return int(mask.sum()), mask, ev_fp[keep], ev_h[keep].copy()
         if self._merge_fn is None:
-            self._merge_fn = make_sharded_merge(self.mesh, write=self.write_mode)
+            self._merge_fn = make_sharded_merge(
+                self.mesh, write=self.write_mode, probe=self.walk_mode
+            )
         self.table, merged = self._merge_fn(
             self.table, put(fp_g), put(slots_g), put(now_g), put(act_g)
         )

@@ -139,6 +139,10 @@ class Daemon:
                 # until the device bench record flips the default)
                 probe=None if conf.probe_kernel == "auto"
                 else conf.probe_kernel,
+                # install/merge walk kernel (fused vs two-pass; same
+                # default-flip policy, independent knob)
+                walk=None if conf.walk_kernel == "auto"
+                else conf.walk_kernel,
             )
         else:
             self.engine = LocalEngine(
@@ -147,12 +151,28 @@ class Daemon:
                 store=store,
                 probe=None if conf.probe_kernel == "auto"
                 else conf.probe_kernel,
+                walk=None if conf.walk_kernel == "auto"
+                else conf.walk_kernel,
             )
         self.runner = EngineRunner(
             self.engine,
             metrics=self.metrics,
             fetch_workers=conf.behaviors.pipeline_inflight,
         )
+        # device-resident request ring (service/ring.py; docs/latency.md
+        # "Dispatch budget"): when armed, all-wire flushes stage into ring
+        # slots and the persistent serving loop consumes them in ticket
+        # order — the CPU build runs the functional emulation of the
+        # device ring protocol over the same runner surface
+        self.ring = None
+        if conf.behaviors.ring_enable:
+            from gubernator_tpu.service.ring import RequestRing
+
+            self.ring = RequestRing(
+                self.runner,
+                slots=conf.behaviors.ring_slots,
+                metrics=self.metrics,
+            )
         self.batcher = Batcher(
             self.runner,
             batch_wait_ms=conf.behaviors.batch_wait_ms,
@@ -164,6 +184,7 @@ class Daemon:
             close_rows=conf.behaviors.batch_close_rows,
             close_bytes=conf.behaviors.batch_close_bytes,
             max_queue_rows=conf.behaviors.batch_queue_rows,
+            ring=self.ring,
         )
         # front-door parse/encode pool: the native parser and response
         # encoder drop the GIL, so offloading big request buffers here lets
@@ -523,7 +544,15 @@ class Daemon:
             duration=np.ones(1, dtype=np.int64),
             now_ms=1,
         )
-        if self.conf.data_center:
+        # the install warm above already traced the install walk under the
+        # engine's resolved walk_mode (GUBER_WALK_KERNEL threads through
+        # install2/merge2 transparently). The merge walk is warmed for
+        # region daemons (the replication receive path) AND whenever the
+        # fused Pallas walks are armed — tiering promotes and handoff
+        # merges ride merge2 too, and a fused-walk graph compiling on the
+        # first promote would stall the engine thread mid-serving.
+        fused_walks = getattr(self.engine, "walk_mode", "xla") == "pallas"
+        if self.conf.data_center or fused_walks:
             # region plane (docs/robustness.md "Multi-region active-
             # active"): pre-trace the stored-state read (the sender's
             # staging gather) and the conservative merge (the receiver's
@@ -531,11 +560,12 @@ class Daemon:
             # compile inside a peer's RPC deadline — a timed-out first
             # sync would requeue and re-apply as a duplicate (under-
             # granting, but needlessly). DC-less daemons never replicate,
-            # so they skip the two compiles.
+            # so they skip the staging-read compile.
             from gubernator_tpu.ops.table2 import F as F_FULL
 
             fp1 = np.asarray([1], dtype=np.int64)
-            await self.runner.read_state_raw(fp1)
+            if self.conf.data_center:
+                await self.runner.read_state_raw(fp1)
             # an all-zero incoming row is expired at every clock: the
             # merge kernel compiles, the table keeps its bytes
             await self.runner.merge_rows(
@@ -1918,6 +1948,11 @@ class Daemon:
         await self.global_manager.close()  # flushes pending GLOBAL queues
         await self.region_manager.close()
         await self.batcher.drain()
+        if self.ring is not None:
+            # after the batcher: its drain flushes pending chunks THROUGH
+            # the ring; only then can the ring retire every published
+            # ticket and park the serving loop (zero-loss ordering)
+            await self.ring.drain()
         if drain and self.conf.behaviors.handoff_enabled:
             # hand owned live rows to ring successors under the deadline;
             # whatever stays unacked is snapshotted by maybe_checkpoint below

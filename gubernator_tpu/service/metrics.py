@@ -208,6 +208,23 @@ class DaemonMetrics:
             "Decision-kernel dispatches",
             registry=r,
         )
+        self.dispatch_launches = Counter(
+            # renders as gubernator_tpu_dispatch_launches_total
+            "gubernator_tpu_dispatch_launches",
+            "Decision-kernel launches by feed path: ring = fed from the "
+            "device-resident request ring's persistent serving loop "
+            "(service/ring.py), xla = the direct per-flush dispatch "
+            "round-trip (docs/latency.md 'Dispatch budget')",
+            ["path"],  # ring | xla
+            registry=r,
+        )
+        self.ring_occupancy = Gauge(
+            "gubernator_tpu_ring_occupancy",
+            "Request-ring slots published but not yet consumed — bounded "
+            "by GUBER_RING_SLOTS; sustained saturation means submitters "
+            "are in backpressure and the serving loop is the bottleneck",
+            registry=r,
+        )
         self.dispatch_duration = Histogram(
             "gubernator_tpu_dispatch_duration",
             "Seconds per decision-kernel dispatch (host-observed)",
@@ -224,6 +241,10 @@ class DaemonMetrics:
             # and the compact-wire codec stages wire_pack | wire_decode
             # (host encode of the 5-lane ingress grid / decode of the int32
             # egress; docs/latency.md "wire budget").
+            # The request-ring plane adds ring_put (submit-side slot claim
+            # + payload staging + ingress-fence publish) and ring_poll
+            # (the egress-fence wait for the coalesced response) —
+            # service/ring.py, docs/latency.md "Dispatch budget".
             # A HISTOGRAM (was a Summary) so per-stage TAILS are scrapeable:
             # _sum/_count keep the same series names the e2e bench means
             # used, and the buckets let BENCH_r06+ report per-stage p99 —

@@ -1,0 +1,268 @@
+"""Device-resident request ring — the always-on serving loop's front end.
+
+The dispatch round-trip is the last fixed cost the serving plane pays per
+flush: every coalesced batch walks the full host dispatch machinery
+(executor hop → XLA launch → fetch) even when the device is idle and the
+next batch is already parsed. On a real TPU the fix is a PERSISTENT serving
+kernel fed by a fixed ring of compact wire-grid slots in device memory: the
+host DMAs a packed (5, B+1) ingress grid into slot `t % S`, publishes a
+sequence word, and the always-running kernel picks the slot up without any
+launch round-trip; results come back through a per-slot egress fence the
+host polls. This module is the FUNCTIONAL EMULATION of that protocol on
+the CPU build — it drives the exact same runner surface
+(`EngineRunner.check_wire`) the direct path drives, so responses are
+byte-identical by construction, while exercising the full ring protocol:
+
+* **slot claim / publish ordering** — a submitter claims ticket `t`
+  (slot `t % S`) under the submit lock, stages the payload into the slot,
+  and only THEN publishes `seq_in[slot] = t + 1` — the store fence that
+  makes a published slot's payload visible before its sequence word, the
+  ordering a device ring needs for the kernel's poll to be race-free;
+* **sequence-number fencing** — the consumer checks `seq_in[slot] == t+1`
+  before touching a slot and publishes `seq_out[slot] = t + 1` only after
+  the result is materialized; a submitter's result wait is exactly the
+  egress-fence poll;
+* **bounded backpressure** — when all S slots hold published-but-unconsumed
+  batches, submit WAITS (no drops, FIFO ticket order preserved) until the
+  consumer retires the oldest slot;
+* **drain on shutdown** — `drain()` stops intake, lets every published
+  ticket complete in order, and only then parks the serving loop (zero
+  loss, the contract ci/bench_cpu.py's ring_smoke gate pins).
+
+Consumption is strictly in ticket order (the persistent kernel walks slots
+in sequence), but the finish half of each dispatch overlaps the next
+ticket's issue through the runner's own prepare/issue/finish pipeline —
+the ring serializes LAUNCH ORDER, not completion latency.
+
+Knobs: GUBER_RING_ENABLE turns the plane on (service/daemon.py routes
+all-wire flushes here), GUBER_RING_SLOTS sizes the ring. Metrics:
+gubernator_tpu_dispatch_launches_total{path="ring"|"xla"} splits launch
+counts by feed path, gubernator_tpu_ring_occupancy gauges published-but-
+unconsumed slots, and the ring_put / ring_poll stage_duration labels time
+the submit-side staging and the egress-fence wait (docs/latency.md
+"Dispatch budget").
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from gubernator_tpu.ops.batch import ResponseColumns
+from gubernator_tpu.service.wire import concat_columns
+
+
+class RingClosed(RuntimeError):
+    """Raised to a submitter racing drain(): the caller (Batcher._dispatch)
+    falls back to the direct dispatch path — no request is lost."""
+
+
+class RequestRing:
+    """Fixed ring of S request slots with sequence-number fencing.
+
+    `seq_in` / `seq_out` are the ingress/egress fence words — int64 arrays
+    indexed by slot, exactly the layout the device ring keeps resident in
+    HBM (docs/latency.md "Dispatch budget"). Slot `t % S` carries ticket
+    `t`; fence value `t + 1` (never 0, so an unused slot is unambiguous).
+    """
+
+    def __init__(self, runner, slots: int = 64, metrics=None):
+        if slots < 2:
+            raise ValueError("RequestRing needs at least 2 slots")
+        self.runner = runner
+        self.slots = int(slots)
+        self.metrics = metrics
+        self.seq_in = np.zeros(self.slots, dtype=np.int64)
+        self.seq_out = np.zeros(self.slots, dtype=np.int64)
+        # slot payload staging (the emulation's stand-in for the DMA'd
+        # wire grids): (parts, span) per slot, cleared on consume
+        self._staged: List[Optional[Tuple[list, object]]] = (
+            [None] * self.slots
+        )
+        self._head = 0  # next ticket to claim (== tickets published)
+        self._consumed = 0  # tickets fully retired (seq_out published)
+        self._done = {}  # ticket -> result future (the egress poll)
+        self._lock: Optional[asyncio.Lock] = None
+        self._published: Optional[asyncio.Event] = None
+        self._space: Optional[asyncio.Event] = None
+        self._drained: Optional[asyncio.Event] = None
+        self._issue_task: Optional[asyncio.Task] = None
+        self._finish_task: Optional[asyncio.Task] = None
+        self._inorder: Optional[asyncio.Queue] = None
+        self._closed = False
+        # introspection counters (ring_smoke + /v1/debug/pipeline)
+        self.launches = 0  # dispatches fed from the ring
+        self.fallbacks = 0  # non-fusable slots that rode the columns path
+        self.backpressure_waits = 0  # submits that found the ring full
+        self.max_occupancy = 0
+
+    # ------------------------------------------------------------ lifecycle
+    def _ensure_started(self) -> None:
+        if self._lock is not None:
+            return
+        loop = asyncio.get_running_loop()
+        self._lock = asyncio.Lock()
+        self._published = asyncio.Event()
+        self._space = asyncio.Event()
+        self._drained = asyncio.Event()
+        self._inorder = asyncio.Queue()
+        self._issue_task = loop.create_task(self._issue_loop(),
+                                            name="ring-issue")
+        self._finish_task = loop.create_task(self._finish_loop(),
+                                             name="ring-finish")
+
+    def _set_occupancy(self) -> None:
+        occ = self._head - self._consumed
+        if occ > self.max_occupancy:
+            self.max_occupancy = occ
+        if self.metrics is not None:
+            self.metrics.ring_occupancy.set(occ)
+
+    # -------------------------------------------------------------- submit
+    async def submit(self, parts, span=None) -> ResponseColumns:
+        """Claim a ticket, stage the payload, publish the ingress fence,
+        and poll the egress fence for the coalesced response. `parts` is
+        the all-WireBatch chunk the batcher formed — the same value the
+        direct path hands `runner.check_wire`, which is what makes the two
+        paths byte-identical."""
+        self._ensure_started()
+        if self._closed:
+            raise RingClosed("request ring is draining")
+        t0 = time.perf_counter()
+        async with self._lock:
+            ticket = self._head
+            # bounded backpressure: every slot published-but-unconsumed →
+            # wait for the serving loop to retire the oldest (FIFO under
+            # the lock: later submitters queue behind this one)
+            while not self._closed and (
+                ticket - self._consumed >= self.slots
+            ):
+                self.backpressure_waits += 1
+                self._space.clear()
+                await self._space.wait()
+            if self._closed:
+                raise RingClosed("request ring is draining")
+            self._head = ticket + 1
+            slot = ticket % self.slots
+            fut = asyncio.get_running_loop().create_future()
+            self._done[ticket] = fut
+            # STAGE before PUBLISH — the store-fence ordering: the payload
+            # must be slot-resident before seq_in makes it claimable
+            self._staged[slot] = (parts, span)
+            self.seq_in[slot] = ticket + 1
+            self._published.set()
+        self._set_occupancy()
+        self.runner._observe_stage("ring_put", t0, span)
+        # egress-fence poll: resolve when the serving loop publishes
+        # seq_out[slot] == ticket + 1
+        t1 = time.perf_counter()
+        try:
+            rc = await fut
+        finally:
+            self._done.pop(ticket, None)
+        self.runner._observe_stage("ring_poll", t1, span)
+        return rc
+
+    # ------------------------------------------------------- serving loop
+    async def _dispatch(self, parts, span):
+        """One slot's dispatch: the exact runner surface the direct path
+        drives. Non-fusable chunks (duplicate keys, non-encodable rows)
+        fall back to the columns path, same as Batcher._dispatch."""
+        rc = await self.runner.check_wire(parts, span=span,
+                                          launch_path="ring")
+        if rc is None:
+            self.fallbacks += 1
+            cat = concat_columns([p.cols for p in parts])
+            rc = await self.runner.check(cat, span=span, launch_path="ring")
+        return rc
+
+    async def _issue_loop(self) -> None:
+        """Walk tickets strictly in order (the persistent kernel's slot
+        walk): check the ingress fence, lift the payload, and start its
+        dispatch. Completion ordering is the finish loop's job."""
+        t = 0
+        loop = asyncio.get_running_loop()
+        while True:
+            while t >= self._head:
+                if self._closed:
+                    await self._inorder.put(None)  # finish-loop sentinel
+                    return
+                self._published.clear()
+                if t < self._head:  # raced a publish
+                    break
+                await self._published.wait()
+            slot = t % self.slots
+            # ingress fence: the slot must carry exactly this ticket
+            assert int(self.seq_in[slot]) == t + 1, (
+                f"ring fence violation: slot {slot} has seq "
+                f"{int(self.seq_in[slot])}, expected {t + 1}"
+            )
+            parts, span = self._staged[slot]
+            self._staged[slot] = None
+            await self._inorder.put(
+                (t, loop.create_task(self._dispatch(parts, span)))
+            )
+            t += 1
+
+    async def _finish_loop(self) -> None:
+        """Retire tickets in order: await each dispatch, publish the egress
+        fence, resolve the submitter's poll, free the slot."""
+        while True:
+            item = await self._inorder.get()
+            if item is None:
+                self._drained.set()
+                return
+            t, task = item
+            slot = t % self.slots
+            fut = self._done.get(t)
+            try:
+                rc = await task
+            except Exception as exc:  # pragma: no cover - defensive
+                if fut is not None and not fut.done():
+                    fut.set_exception(exc)
+            else:
+                if fut is not None and not fut.done():
+                    fut.set_result(rc)
+            self.launches += 1
+            # egress fence AFTER the result is materialized — the order the
+            # submitter's poll relies on
+            self.seq_out[slot] = t + 1
+            self._consumed = t + 1
+            self._set_occupancy()
+            self._space.set()
+
+    # --------------------------------------------------------------- drain
+    async def drain(self) -> None:
+        """Stop intake and retire every published ticket in order before
+        parking the serving loop — zero-loss shutdown (the ring_smoke
+        drain gate). Safe to call with nothing ever submitted."""
+        self._closed = True
+        if self._lock is None:
+            return  # never started
+        self._published.set()  # wake the issue loop to emit its sentinel
+        self._space.set()  # release submitters blocked on backpressure
+        await self._drained.wait()
+        for task in (self._issue_task, self._finish_task):
+            if task is not None and not task.done():
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+
+    def debug(self) -> dict:
+        """Ring-plane state for /v1/debug/pipeline."""
+        return {
+            "slots": self.slots,
+            "occupancy": self._head - self._consumed,
+            "published": self._head,
+            "consumed": self._consumed,
+            "launches": self.launches,
+            "fallbacks": self.fallbacks,
+            "backpressure_waits": self.backpressure_waits,
+            "max_occupancy": self.max_occupancy,
+            "closed": self._closed,
+        }

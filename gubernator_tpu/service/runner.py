@@ -94,7 +94,8 @@ class EngineRunner:
                     ).inc(int(c))
 
     async def check(
-        self, cols: RequestColumns, now_ms: Optional[int] = None, span=None
+        self, cols: RequestColumns, now_ms: Optional[int] = None, span=None,
+        launch_path: str = "xla",
     ) -> ResponseColumns:
         """Pipelined check when the engine supports the prepare/issue/finish
         split, else the serial path. Store-configured engines stay serial:
@@ -116,7 +117,9 @@ class EngineRunner:
             or getattr(self.engine, "store", None) is not None
             or (can is not None and not can(cols))
         ):
-            return await self.check_columns(cols, now_ms=now_ms)
+            return await self.check_columns(
+                cols, now_ms=now_ms, launch_path=launch_path
+            )
         self._count_decisions(cols.algo)
         from gubernator_tpu.ops.engine import prepare_check_columns
 
@@ -131,10 +134,12 @@ class EngineRunner:
             return prepared
 
         prepared = await loop.run_in_executor(self._prep, prepare)
-        return await self._issue_and_finish(prepared, span=span)
+        return await self._issue_and_finish(
+            prepared, span=span, launch_path=launch_path
+        )
 
     async def check_wire(
-        self, parts, now_ms=None, span=None
+        self, parts, now_ms=None, span=None, launch_path: str = "xla"
     ) -> Optional[ResponseColumns]:
         """Fused front-door check: pre-parsed WireBatch pieces
         (service/wire.py — native-parser lanes) staged straight into ONE
@@ -165,7 +170,9 @@ class EngineRunner:
             return None
         for p in parts:
             self._count_decisions(p.cols.algo)
-        return await self._issue_and_finish(prepared, span=span)
+        return await self._issue_and_finish(
+            prepared, span=span, launch_path=launch_path
+        )
 
     def _observe_stage(self, stage: str, t0: float, span) -> None:
         """One pipeline-stage observation: histogram sample (with the
@@ -184,7 +191,9 @@ class EngineRunner:
                 end_ns - int(dt * 1e9), end_ns,
             )
 
-    async def _issue_and_finish(self, prepared, span=None) -> ResponseColumns:
+    async def _issue_and_finish(
+        self, prepared, span=None, launch_path: str = "xla"
+    ) -> ResponseColumns:
         """Shared issue/finish halves of the pipelined dispatch: ISSUE on
         the engine thread (enqueue kernel launches, no fetch), FINISH on a
         fetch worker (materialize outputs, rare fixups back on the engine
@@ -200,6 +209,11 @@ class EngineRunner:
             t0 = time.perf_counter()
             pending = issue_check_columns(self.engine, prepared)
             self._observe_stage("issue", t0, span)
+            if self.metrics is not None:
+                # feed-path accounting (docs/latency.md "Dispatch budget"):
+                # ring = launched from the device-resident request ring's
+                # serving loop, xla = the direct per-flush round-trip
+                self.metrics.dispatch_launches.labels(path=launch_path).inc()
             return pending
 
         def fixup(fn):
@@ -274,7 +288,8 @@ class EngineRunner:
             self.metrics.table_hbm_bytes_per_decision.set(est())
 
     async def check_columns(
-        self, cols: RequestColumns, now_ms: Optional[int] = None
+        self, cols: RequestColumns, now_ms: Optional[int] = None,
+        launch_path: str = "xla",
     ) -> ResponseColumns:
         self._count_decisions(cols.algo)
         loop = asyncio.get_running_loop()
@@ -283,6 +298,7 @@ class EngineRunner:
             t0 = time.perf_counter()
             rc = self.engine.check_columns(cols, now_ms=now_ms)
             if self.metrics is not None:
+                self.metrics.dispatch_launches.labels(path=launch_path).inc()
                 self.metrics.dispatch_duration.observe(time.perf_counter() - t0)
                 self._observe_shard_stages()
                 self.metrics.observe_engine(self.engine.stats)

@@ -1,0 +1,590 @@
+"""Fused install/merge walk suite (ops/pallas_probe.walk2_pallas_impl,
+stage="install"/"merge").
+
+The acceptance surface of the always-on-chip tentpole's walk half:
+
+* `GUBER_WALK_KERNEL=pallas` is BIT-IDENTICAL to the two-pass XLA
+  gather+write paths (`install2_impl`/`merge2_impl`, the oracles) across
+  every slot layout a table can run (defaulted, full, gcra32, token32) —
+  installed/merged masks AND raw table bytes, through collision pressure,
+  bucket-full eviction and multi-step aging;
+* the conservative-merge rules survive the fusion on BOTH walks because
+  `merge_payload16` is shared verbatim: remaining=min, OVER sticks,
+  expiry=max, newest-stamp config — asserted behaviorally, not just by
+  parity;
+* duplicate fingerprints inside one merge batch resolve as sequential
+  passes (the engine.merge_rows unique-fp contract) identically on both
+  walks;
+* the knob threads through LocalEngine, the 8-device shard_map mesh
+  (ShardedEngine route/dedup="device"), the region-sync receive path
+  (ops/reconcile.apply_region_sync) and the handoff
+  extract→merge→tombstone cycle unchanged;
+* `GUBER_PROBE_MOVEMENT=dma` (the DMA-protocol emulation lowering) stays
+  bit-identical on the fused walks, same as decide.
+
+Everything runs the interpret-mode lowering (CPU CI), the same execution
+CI's ring_smoke gates.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+from gubernator_tpu.ops.batch import InstallBatch, RequestColumns
+from gubernator_tpu.ops.engine import LocalEngine
+from gubernator_tpu.ops.kernel2 import install2_impl, merge2_impl
+from gubernator_tpu.ops.layout import FULL, GCRA32, TOKEN32
+from gubernator_tpu.ops.table2 import (
+    EXP_HI,
+    EXP_LO,
+    FLAGS,
+    REM_I,
+    new_table2,
+)
+
+NOW = 1_700_000_000_000
+
+# "all four" table configurations a walk can hit: the defaulted layout and
+# the three named ones (packed layouts constrain the algorithm family).
+LAYOUT_CASES = [
+    pytest.param(None, (0, 1, 2, 3, 4), id="default"),
+    pytest.param(FULL, (0, 1, 2, 3, 4), id="full"),
+    pytest.param(GCRA32, (2,), id="gcra32"),
+    pytest.param(TOKEN32, (0,), id="token32"),
+]
+
+
+def mkfp(rng, n, bucket_pool=None, pool_nb=64):
+    """Unique fingerprints; `bucket_pool` concentrates them into that many
+    hash buckets of a pool_nb-bucket table (collision pressure)."""
+    if bucket_pool:
+        base = rng.integers(1, pool_nb, size=bucket_pool, dtype=np.int64)
+        fp = base[rng.integers(0, bucket_pool, size=2 * n)] + pool_nb * \
+            rng.integers(1, 1 << 40, size=2 * n, dtype=np.int64)
+    else:
+        fp = rng.integers(1, 1 << 62, size=2 * n, dtype=np.int64)
+    fp = np.unique(fp)
+    while fp.shape[0] < n:
+        fp = np.unique(np.concatenate(
+            [fp, rng.integers(1, 1 << 62, size=n, dtype=np.int64)]
+        ))
+    fp = fp[:n]
+    rng.shuffle(fp)
+    return fp
+
+
+def mkinst(rng, n, algos=(0,), n_active=None, limit=100, dur=60_000,
+           now=NOW, bucket_pool=None, pool_nb=64, fidelity=False):
+    """InstallBatch of unique-fp owner-authoritative statuses (the
+    UpdatePeerGlobals receive shape). `fidelity` attaches the PR-11
+    sliding-window aux/rem_store broadcast lanes."""
+    n_active = n if n_active is None else n_active
+    fp = mkfp(rng, n, bucket_pool, pool_nb)
+    algo = np.array([algos[i % len(algos)] for i in range(n)], dtype=np.int32)
+    remaining = rng.integers(0, limit + 1, size=n).astype(np.int64)
+    status = (rng.integers(0, 4, size=n) == 0).astype(np.int32)  # ~25% OVER
+    stamp = now - rng.integers(0, 5_000, size=n).astype(np.int64)
+    active = np.arange(n) < n_active
+    j = jnp.asarray
+    return InstallBatch(
+        fp=j(fp),
+        algo=j(algo),
+        status=j(status),
+        limit=j(np.full(n, limit, dtype=np.int64)),
+        remaining=j(remaining),
+        reset_time=j(np.full(n, now + dur, dtype=np.int64)),
+        duration=j(np.full(n, dur, dtype=np.int64)),
+        now=j(np.full(n, now, dtype=np.int64)),
+        active=j(active),
+        burst=j(np.full(n, limit, dtype=np.int64)),
+        stamp=j(stamp),
+        aux=j(rng.integers(0, limit, size=n).astype(np.int64))
+        if fidelity else None,
+        rem_store=j(remaining.copy()) if fidelity else None,
+    )
+
+
+def assert_install_parity(cap, mk, layout=None, steps=3, step_ms=20_000):
+    """Drive both install walks over the same broadcast stream and assert
+    installed-mask and raw-table-byte identity at every step."""
+    tx = new_table2(cap, layout=layout)
+    tp = new_table2(cap, layout=layout)
+    for s in range(steps):
+        inst = mk(s * step_ms)
+        tx, mx = install2_impl(tx, inst, write="xla")
+        tp, mp = install2_impl(tp, inst, write="xla", probe="pallas")
+        np.testing.assert_array_equal(
+            np.asarray(mx), np.asarray(mp),
+            err_msg=f"step {s}: installed mask",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(tx.rows), np.asarray(tp.rows),
+            err_msg=f"step {s}: table bytes",
+        )
+
+
+# ------------------------------------------------------ install walk parity
+
+
+@pytest.mark.parametrize("lay,algos", LAYOUT_CASES)
+def test_install_parity_per_layout(lay, algos):
+    rng = np.random.default_rng(21)
+    assert_install_parity(
+        512,
+        lambda dt: mkinst(rng, 128, algos=algos, now=NOW + dt),
+        layout=lay, steps=4,
+    )
+
+
+@pytest.mark.parametrize("lay,algos", LAYOUT_CASES)
+def test_install_parity_collision_pressure(lay, algos):
+    """More unique keys per bucket than K=8 lanes: the install walk evicts
+    soonest-expiring LIVE lanes and drops rank overflow, identically."""
+    rng = np.random.default_rng(22)
+    assert_install_parity(
+        64,
+        lambda dt: mkinst(rng, 192, algos=algos, now=NOW + dt,
+                          bucket_pool=4, pool_nb=8),
+        layout=lay, steps=4,
+    )
+
+
+def test_install_parity_block_boundary_carries(monkeypatch):
+    """Bucket runs straddling grid blocks on the install walk: tiny blocks
+    force multi-block carries and carry flushes at every shape."""
+    rng = np.random.default_rng(23)
+    for blk in ("8", "16", "64"):
+        monkeypatch.setenv("GUBER_PROBE_BLK", blk)
+        assert_install_parity(
+            256,
+            lambda dt: mkinst(rng, 96, n_active=77, algos=(0, 2, 4),
+                              now=NOW + dt, bucket_pool=9, pool_nb=32),
+            steps=3,
+        )
+
+
+def test_install_parity_fidelity_and_padding():
+    """Sliding-window broadcast fidelity lanes (aux/rem_store) and inactive
+    padding rows ride the fused walk bit-identically."""
+    rng = np.random.default_rng(24)
+    assert_install_parity(
+        512,
+        lambda dt: mkinst(rng, 128, algos=(3,), now=NOW + dt, fidelity=True),
+        steps=3,
+    )
+    assert_install_parity(
+        512,
+        lambda dt: mkinst(rng, 96, n_active=50, algos=(0, 1, 2, 3, 4),
+                          now=NOW + dt),
+        steps=3,
+    )
+    # all-padding warm batch (the warm_up shape)
+    assert_install_parity(
+        256, lambda dt: mkinst(rng, 32, n_active=0, now=NOW + dt), steps=2,
+    )
+
+
+def test_install_parity_expired_slot_reclaim():
+    """Steps larger than the duration: every slot expires between steps and
+    the install walk reclaims through the vacant-first candidate order."""
+    rng = np.random.default_rng(25)
+    assert_install_parity(
+        128,
+        lambda dt: mkinst(rng, 128, algos=(0, 2, 3), now=NOW + dt,
+                          dur=5_000, bucket_pool=8, pool_nb=16),
+        steps=4, step_ms=30_000,
+    )
+
+
+# -------------------------------------------------------- merge walk parity
+
+
+def cols(fp, algo, hits=1, limit=64, now=NOW, dur=8_000):
+    n = fp.shape[0]
+    h = (np.asarray(hits, dtype=np.int64) if np.ndim(hits)
+         else np.full(n, hits, dtype=np.int64))
+    return RequestColumns(
+        fp=fp.astype(np.int64),
+        algo=np.full(n, algo, dtype=np.int32),
+        behavior=np.zeros(n, dtype=np.int32),
+        hits=h,
+        limit=np.full(n, limit, dtype=np.int64),
+        burst=np.zeros(n, dtype=np.int64),
+        duration=np.full(n, dur, dtype=np.int64),
+        created_at=np.full(n, now, dtype=np.int64),
+        err=np.zeros(n, dtype=np.int8),
+    )
+
+
+def donor_rows(rng, n, algo, now=NOW, dur=8_000, cap=1 << 11):
+    """Realistic live slot rows: drive serving traffic through a donor
+    engine, then extract — the handoff sender's exact staging form."""
+    eng = LocalEngine(capacity=cap, write_mode="xla")
+    fp = mkfp(rng, n)
+    eng.check_columns(
+        cols(fp, algo, hits=rng.integers(0, 3, size=n), now=now, dur=dur),
+        now_ms=now,
+    )
+    fps, slots = eng.extract_live(now_ms=now)
+    assert fps.shape[0] > 0
+    return fps, slots
+
+
+def assert_merge_parity(cap, fps, slots, layout=None, now=NOW, steps=2,
+                        step_ms=3_000, evictees=False, seed=0):
+    """Merge the same transferred rows into two same-seeded tables through
+    both walks; assert merged-mask, evictee and raw-table-byte identity.
+    Tables are pre-seeded with half the keys (via the XLA install walk, so
+    both start bit-identical) to exercise the live-lane conservatism
+    branch, not just fresh installs."""
+    rng = np.random.default_rng(seed + 77)
+    tx = new_table2(cap, layout=layout)
+    tp = new_table2(cap, layout=layout)
+    n = fps.shape[0]
+    j = jnp.asarray
+    for s in range(steps):
+        t = now + s * step_ms
+        fp_p = j(fps)
+        slots_p = j(slots)
+        act = j(np.ones(n, dtype=bool))
+        nowv = j(np.full(n, t, dtype=np.int64))
+        if evictees:
+            tx, mx, ex = merge2_impl(
+                tx, fp_p, slots_p, nowv, act, write="xla", evictees=True,
+            )
+            tp, mp, ep = merge2_impl(
+                tp, fp_p, slots_p, nowv, act, write="xla", evictees=True,
+                probe="pallas",
+            )
+            np.testing.assert_array_equal(
+                np.asarray(ex), np.asarray(ep),
+                err_msg=f"step {s}: evictee rows",
+            )
+        else:
+            tx, mx = merge2_impl(tx, fp_p, slots_p, nowv, act, write="xla")
+            tp, mp = merge2_impl(
+                tp, fp_p, slots_p, nowv, act, write="xla", probe="pallas",
+            )
+        np.testing.assert_array_equal(
+            np.asarray(mx), np.asarray(mp), err_msg=f"step {s}: merged mask",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(tx.rows), np.asarray(tp.rows),
+            err_msg=f"step {s}: table bytes",
+        )
+        # next step: perturb the incoming rows so the repeated merge hits
+        # the live-lane tighten branch with different winners
+        pert = slots.copy()
+        pert[:, REM_I] = np.maximum(
+            pert[:, REM_I] - rng.integers(0, 5, size=n).astype(np.int32), 0
+        )
+        slots = pert
+
+
+@pytest.mark.parametrize("algo", [0, 2, 3])
+def test_merge_parity_per_algorithm(algo):
+    rng = np.random.default_rng(31 + algo)
+    fps, slots = donor_rows(rng, 256, algo)
+    assert_merge_parity(1 << 11, fps, slots, steps=3, seed=algo)
+
+
+@pytest.mark.parametrize("lay,algo", [
+    pytest.param(GCRA32, 2, id="gcra32"), pytest.param(TOKEN32, 0,
+                                                       id="token32"),
+])
+def test_merge_parity_packed_receiver(lay, algo):
+    """A packed receiver merging full-width transferred rows (the
+    cross-layout handoff): the fused walk packs through the same canonical
+    conversion."""
+    rng = np.random.default_rng(35)
+    fps, slots = donor_rows(rng, 192, algo)
+    assert_merge_parity(512, fps, slots, layout=lay, steps=3)
+
+
+def test_merge_parity_collision_and_evictees(monkeypatch):
+    """Merge under bucket-full pressure with evictee collection: displaced
+    LIVE rows ride home identically (the tiering promote contract)."""
+    monkeypatch.setenv("GUBER_PROBE_BLK", "16")
+    rng = np.random.default_rng(36)
+    fp = mkfp(rng, 192, bucket_pool=4, pool_nb=8)
+    eng = LocalEngine(capacity=1 << 11, write_mode="xla")
+    eng.check_columns(cols(fp, 0, hits=1), now_ms=NOW)
+    fps, slots = eng.extract_live(now_ms=NOW)
+    assert_merge_parity(64, fps, slots, steps=3, evictees=True)
+
+
+def test_merge_parity_expired_incoming_rows():
+    """Incoming rows whose expiry predates the receiver clock are inert on
+    both walks (the merge2_impl active-gate, applied before routing)."""
+    rng = np.random.default_rng(37)
+    fps, slots = donor_rows(rng, 128, 0, dur=2_000)
+    assert_merge_parity(512, fps, slots, now=NOW + 10_000, steps=2)
+
+
+# --------------------------------------------- conservatism, behaviorally
+
+
+def _engines(cap=256, **kw):
+    return (LocalEngine(capacity=cap, write_mode="xla", walk="xla", **kw),
+            LocalEngine(capacity=cap, write_mode="xla", walk="pallas", **kw))
+
+
+def _install_one(e, fp, status, remaining, stamp, dur=60_000, algo=0,
+                 now=NOW, limit=100):
+    one = lambda v, dt: np.array([v], dtype=dt)
+    e.install_columns(
+        one(fp, np.int64), one(algo, np.int32), one(status, np.int32),
+        one(limit, np.int64), one(remaining, np.int64),
+        one(now + dur, np.int64), one(dur, np.int64), now_ms=now,
+        stamp=one(stamp, np.int64),
+    )
+
+
+def test_merge_conservatism_over_sticks_min_remaining():
+    """remaining=min and OVER-sticks survive the fusion: a generous
+    incoming row can never re-grant capacity a stored OVER denied."""
+    fp = 0x5EED_F00D
+    donor = LocalEngine(capacity=256, write_mode="xla")
+    _install_one(donor, fp, status=0, remaining=80, stamp=NOW + 5)
+    dfps, drows = donor.extract_live(now_ms=NOW)
+    outs = []
+    for e in _engines():
+        _install_one(e, fp, status=1, remaining=20, stamp=NOW)
+        assert e.merge_rows(dfps, drows, now_ms=NOW + 10) == 1
+        found, rows = e.read_state(np.array([fp], dtype=np.int64))
+        assert found[0]
+        outs.append(rows[0])
+    xla_row, pal_row = outs
+    np.testing.assert_array_equal(xla_row, pal_row)
+    assert int(xla_row[REM_I]) == 20  # min(stored 20, incoming 80)
+    assert (int(xla_row[FLAGS]) >> 8) & 0xFF == 1  # OVER sticks
+
+
+def test_merge_conservatism_expiry_max_and_newest_config():
+    """expiry=max (state lives at least as long) and newest-stamp config
+    (the later limit wins) — identical on both walks."""
+    fp = 0xC0FF_EE11
+    donor = LocalEngine(capacity=256, write_mode="xla")
+    _install_one(donor, fp, status=0, remaining=150, stamp=NOW + 9,
+                 dur=120_000, limit=200)
+    dfps, drows = donor.extract_live(now_ms=NOW)
+    outs = []
+    for e in _engines():
+        _install_one(e, fp, status=0, remaining=50, stamp=NOW, dur=60_000)
+        assert e.merge_rows(dfps, drows, now_ms=NOW + 10) == 1
+        found, rows = e.read_state(np.array([fp], dtype=np.int64))
+        assert found[0]
+        outs.append(rows[0])
+    np.testing.assert_array_equal(outs[0], outs[1])
+    exp = (int(outs[0][EXP_HI]) << 32) | (int(outs[0][EXP_LO]) & 0xFFFFFFFF)
+    assert exp == NOW + 120_000  # max of the two expiries
+    assert int(outs[0][REM_I]) == 50  # min still tightens
+    from gubernator_tpu.ops.table2 import LIMIT
+
+    assert int(outs[0][LIMIT]) == 200  # newest stamp's config won
+
+
+def test_merge_duplicate_fps_sequential_passes():
+    """Duplicate fingerprints inside one merge batch resolve as sequential
+    passes (the unique-fp contract): both walks land the same final state
+    and the same merged count."""
+    rng = np.random.default_rng(41)
+    fps, slots = donor_rows(rng, 96, 0)
+    # duplicate every key, second copy strictly tighter (smaller remaining)
+    dup_rows = slots.copy()
+    dup_rows[:, REM_I] = np.maximum(dup_rows[:, REM_I] - 7, 0)
+    all_fps = np.concatenate([fps, fps])
+    all_rows = np.concatenate([slots, dup_rows])
+    counts, snaps = [], []
+    for e in _engines(cap=1 << 11):
+        counts.append(e.merge_rows(all_fps, all_rows, now_ms=NOW + 5))
+        snaps.append(e.snapshot())
+    assert counts[0] == counts[1]
+    np.testing.assert_array_equal(snaps[0], snaps[1])
+    # and the tighter duplicate won: stored remaining is the min copy
+    e = _engines(cap=1 << 11)[0]
+    e.merge_rows(all_fps, all_rows, now_ms=NOW + 5)
+    found, rows = e.read_state(fps)
+    np.testing.assert_array_equal(
+        rows[found, REM_I], dup_rows[found, REM_I]
+    )
+
+
+# ----------------------------------------------------------- engine layer
+
+
+def test_local_engine_walk_parity():
+    """GUBER_WALK_KERNEL threads through the serving engine: identical
+    install counts, merge counts and raw table bytes."""
+    rng = np.random.default_rng(51)
+    ex, ep = _engines(cap=1 << 12)
+    assert ep.walk_mode == "pallas"
+    n = 256
+    fp = mkfp(rng, n)
+    algo = np.array([(0, 2, 3)[i % 3] for i in range(n)], dtype=np.int32)
+    kw = dict(
+        limit=np.full(n, 100, dtype=np.int64),
+        remaining=rng.integers(0, 101, size=n).astype(np.int64),
+        reset_time=np.full(n, NOW + 60_000, dtype=np.int64),
+        duration=np.full(n, 60_000, dtype=np.int64),
+        now_ms=NOW,
+    )
+    status = (rng.integers(0, 3, size=n) == 0).astype(np.int32)
+    cx = ex.install_columns(fp, algo, status, **kw)
+    cp = ep.install_columns(fp, algo, status, **kw)
+    assert cx == cp == n
+    np.testing.assert_array_equal(ex.snapshot(), ep.snapshot())
+    # a follow-up merge of perturbed extracted rows stays identical
+    fps, slots = ex.extract_live(now_ms=NOW)
+    slots = ex._slots_to_full(slots)
+    slots[:, REM_I] = np.maximum(slots[:, REM_I] - 3, 0)
+    assert ex.merge_rows(fps, slots, now_ms=NOW + 50) == \
+        ep.merge_rows(fps, slots, now_ms=NOW + 50)
+    np.testing.assert_array_equal(ex.snapshot(), ep.snapshot())
+
+
+def test_handoff_cycle_walk_parity():
+    """The topology-change cycle — extract → merge (receiver) → tombstone
+    (source) → re-merge a duplicated transfer — lands bit-identically, and
+    the duplicate grants nothing extra (docs/robustness.md)."""
+    rng = np.random.default_rng(52)
+    src = LocalEngine(capacity=1 << 11, write_mode="xla")
+    fp = mkfp(rng, 128)
+    src.check_columns(cols(fp, 2, hits=2), now_ms=NOW)
+    fps, slots = src.extract_live(now_ms=NOW)
+    ex, ep = _engines(cap=1 << 11)
+    for e in (ex, ep):
+        assert e.merge_rows(fps, slots, now_ms=NOW + 5) == fps.shape[0]
+    np.testing.assert_array_equal(ex.snapshot(), ep.snapshot())
+    assert src.tombstone_fps(fps) == fps.shape[0]
+    # crossed/duplicated transfer: re-merge the SAME rows later
+    snap = ex.snapshot()
+    for e in (ex, ep):
+        e.merge_rows(fps, slots, now_ms=NOW + 500)
+    np.testing.assert_array_equal(ex.snapshot(), ep.snapshot())
+    np.testing.assert_array_equal(ex.snapshot(), snap)  # nothing re-granted
+
+
+def test_region_sync_walk_parity():
+    """The cross-region receive path (ops/reconcile.apply_region_sync →
+    read_state + merge_rows) rides the fused merge walk unchanged — full
+    and packed sender layouts both."""
+    from gubernator_tpu.ops.reconcile import apply_region_sync
+
+    rng = np.random.default_rng(53)
+    n = 128
+    sender = LocalEngine(capacity=1 << 11, write_mode="xla")
+    fp = mkfp(rng, n)
+    sender.check_columns(cols(fp, 2, hits=1, limit=32), now_ms=NOW)
+    sfps, sslots = sender.extract_live(now_ms=NOW)
+    m = sfps.shape[0]
+    cfg = {
+        "limit": np.full(m, 32, dtype=np.int64),
+        "duration": np.full(m, 8_000, dtype=np.int64),
+        "algo": np.full(m, 2, dtype=np.int64),
+        "created_at": np.full(m, NOW, dtype=np.int64),
+    }
+    deltas = rng.integers(1, 5, size=m).astype(np.int64)
+    ex, ep = _engines(cap=1 << 11)
+    for e in (ex, ep):  # receivers hold live state for half the keys
+        e.check_columns(cols(sfps[: m // 2], 2, hits=1, limit=32),
+                        now_ms=NOW)
+        applied = apply_region_sync(
+            e, sfps, deltas, cfg, sslots, sender_layout=None,
+            now_ms=NOW + 20,
+        )
+        assert applied == m
+    np.testing.assert_array_equal(ex.snapshot(), ep.snapshot())
+
+
+def test_sharded_mesh_walk_parity():
+    """The PR-8 shard_map mesh path composes unchanged: the fused walks run
+    per device shard inside the routed install/merge programs (8-device
+    CPU mesh — the TPU serving defaults)."""
+    from gubernator_tpu.parallel import make_mesh
+    from gubernator_tpu.parallel.sharded import ShardedEngine
+
+    mesh = make_mesh(8)
+    kw = dict(capacity_per_shard=1 << 10, write_mode="xla",
+              route="device", dedup="device")
+    ex = ShardedEngine(mesh, walk="xla", **kw)
+    ep = ShardedEngine(mesh, walk="pallas", **kw)
+    assert ep.walk_mode == "pallas"
+    rng = np.random.default_rng(54)
+    n = 512
+    fp = mkfp(rng, n)
+    algo = np.full(n, 2, dtype=np.int32)
+    kw2 = dict(
+        limit=np.full(n, 64, dtype=np.int64),
+        remaining=rng.integers(0, 65, size=n).astype(np.int64),
+        reset_time=np.full(n, NOW + 60_000, dtype=np.int64),
+        duration=np.full(n, 60_000, dtype=np.int64),
+        now_ms=NOW,
+    )
+    status = np.zeros(n, dtype=np.int32)
+    assert ex.install_columns(fp, algo, status, **kw2) == \
+        ep.install_columns(fp, algo, status, **kw2)
+    np.testing.assert_array_equal(ex.snapshot(), ep.snapshot())
+    fps, slots = ex.extract_live(now_ms=NOW)
+    slots = ex._slots_to_full(slots)
+    slots[:, REM_I] = np.maximum(slots[:, REM_I] - 2, 0)
+    # duplicated fps exercise the sequential-pass path on the mesh too
+    all_fps = np.concatenate([fps, fps[: 64]])
+    all_rows = np.concatenate([slots, slots[: 64]])
+    assert ex.merge_rows(all_fps, all_rows, now_ms=NOW + 9) == \
+        ep.merge_rows(all_fps, all_rows, now_ms=NOW + 9)
+    np.testing.assert_array_equal(ex.snapshot(), ep.snapshot())
+
+
+# ------------------------------------------------- movement & knob plumbing
+
+
+def test_fused_walk_dma_movement_parity(monkeypatch):
+    """GUBER_PROBE_MOVEMENT=dma (the DMA-protocol emulation lowering) stays
+    bit-identical on both fused walks, same as the decide kernel."""
+    monkeypatch.setenv("GUBER_PROBE_MOVEMENT", "dma")
+    rng = np.random.default_rng(61)
+    assert_install_parity(
+        256,
+        lambda dt: mkinst(rng, 96, algos=(0, 2), now=NOW + dt,
+                          bucket_pool=6, pool_nb=16),
+        steps=2,
+    )
+    fps, slots = donor_rows(rng, 96, 0)
+    assert_merge_parity(256, fps, slots, steps=2)
+
+
+def test_walk_env_resolution(monkeypatch):
+    from gubernator_tpu.ops.plan import default_walk_kernel
+
+    monkeypatch.delenv("GUBER_WALK_KERNEL", raising=False)
+    assert default_walk_kernel() == "xla"  # auto = today's kernel
+    monkeypatch.setenv("GUBER_WALK_KERNEL", "pallas")
+    assert default_walk_kernel() == "pallas"
+    assert LocalEngine(capacity=1 << 10).walk_mode == "pallas"
+    monkeypatch.setenv("GUBER_WALK_KERNEL", "bogus")
+    with pytest.raises(ValueError):
+        default_walk_kernel()
+    with pytest.raises(ValueError):
+        LocalEngine(capacity=1 << 10, walk="bogus")
+
+
+def test_config_walk_kernel_and_ring_validation():
+    from gubernator_tpu.config import (
+        BehaviorConfig,
+        ConfigError,
+        DaemonConfig,
+    )
+
+    DaemonConfig(walk_kernel="pallas").validate()
+    DaemonConfig(
+        behaviors=BehaviorConfig(ring_enable=True, ring_slots=2)
+    ).validate()
+    with pytest.raises(ConfigError):
+        DaemonConfig(walk_kernel="nope").validate()
+    with pytest.raises(ConfigError):
+        DaemonConfig(behaviors=BehaviorConfig(ring_slots=1)).validate()
