@@ -2306,6 +2306,276 @@ def e2e_serving_case() -> dict:
     return out
 
 
+# --------------------------------------------------------------- overload
+# Replayable load-scenario harness (docs/robustness.md "Overload & QoS").
+# A scenario is a FIXED schedule of steps — (label, worker-count
+# multiplier, corpus kind) — driven through a loopback daemon with the
+# overload plane armed. The corpus is seeded and pre-serialized, the
+# schedule is data, and the daemon knobs are pinned by the caller, so a
+# run is replayable bit-for-bit on the request side; what moves between
+# runs is only machine weather. Each step emits one record — offered
+# rows/s, goodput rows/s (rows answered without a shed/error), shed
+# split, per-tier request p99 — and the records across a scenario ARE
+# its goodput-vs-offered-load curve. ci/bench_cpu.py drives the same
+# function for the overload_smoke CI gate.
+
+OVERLOAD_SHED_MARK = "shed under overload"
+
+# tier mix for "mixed" corpora: mostly best-effort, a thin critical band —
+# the shape that makes priority inversions visible if they exist
+_TIER_CYCLE = (0, 0, 0, 1, 0, 1, 2, 0, 0, 1, 2, 3)
+
+_OVERLOAD_SCENARIOS = {
+    # slow ramp up and back down — the daily curve; nothing should shed
+    # at the trough, the peak probes the admission boundary
+    "diurnal": [("t025", 1, "mixed"), ("t05", 2, "mixed"),
+                ("peak", 4, "mixed"), ("t05b", 2, "mixed"),
+                ("t025b", 1, "mixed")],
+    # 10x step overload: the headline robustness scenario — the door must
+    # keep top-tier p99 bounded and shed the excess instead of queueing
+    "flash_crowd": [("pre", 1, "mixed"), ("flash", 10, "mixed"),
+                    ("post", 1, "mixed")],
+    # every worker hammers ONE key: pass-planner pressure + queue growth
+    "hotkey_storm": [("pre", 1, "mixed"), ("storm", 6, "hot"),
+                     ("post", 1, "mixed")],
+    # one tenant (single fingerprint bucket) offers far beyond its fair
+    # share while the victims stay steady — fairness must cap the abuser
+    "abusive_tenant": [("pre", 2, "mixed"), ("abuse", 2, "abuse"),
+                       ("post", 2, "mixed")],
+    # wide mixed traffic over a >=1M-key corpus at moderate overload
+    "mixed_1m": [("steady", 3, "mixed")],
+}
+
+
+def _overload_corpus(kind: str, *, keys: int, rows: int, workers: int,
+                     seed: int, per_worker: int = 16) -> "list[list[bytes]]":
+    """Pre-serialized request bytes per worker: `per_worker` distinct
+    GetRateLimitsReq payloads each worker cycles through. Deterministic in
+    (kind, keys, rows, workers, seed) — the replayable half of the
+    harness. Tier rides behavior bits 6-7 (types.with_priority)."""
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.types import with_priority
+
+    out = []
+    for w in range(workers):
+        tier = _TIER_CYCLE[w % len(_TIER_CYCLE)]
+        if kind == "abuse":
+            # half the workers are the abuser: ONE tenant keyspace whose
+            # payloads all lead with the same key (= one fingerprint
+            # bucket at the batcher), offered at full tilt, lowest tier;
+            # the other half are steady distinct-tenant victims
+            abuser = w % 2 == 1
+            tier = 0 if abuser else _TIER_CYCLE[w % len(_TIER_CYCLE)]
+        reqs = []
+        for r in range(per_worker):
+            items = []
+            for i in range(rows):
+                if kind == "hot":
+                    key = "storm-key"
+                elif kind == "abuse" and w % 2 == 1:
+                    # abuser: tiny keyset, stable leading key → one bucket
+                    key = f"abuser-k{i % 8}"
+                else:
+                    key = f"w{w}r{r}i{i}-{(w * per_worker * rows + r * rows + i) % keys}"
+                items.append(pb.RateLimitReq(
+                    name="ovl", unique_key=key, hits=1,
+                    limit=1 << 30, duration=60_000,
+                    behavior=with_priority(0, tier),
+                ))
+            reqs.append(pb.GetRateLimitsReq(requests=items).SerializeToString())
+        out.append(reqs)
+    return out
+
+
+def drive_overload_scenario(
+    scenario: str,
+    *,
+    seconds_per_step: float = 2.0,
+    base_workers: int = 6,
+    rows_per_req: int = 256,
+    keys: int = 1 << 17,
+    overload_deadline_ms: float = 75.0,
+    batch_queue_rows: int = 4096,
+    coalesce_limit: int = 2048,
+    batch_wait_ms: float = 1.0,
+    tenant_share: float = 0.5,
+    seed: int = 0,
+) -> dict:
+    """Run one named scenario through a fresh loopback daemon with the
+    overload plane armed; returns the per-step goodput-vs-offered-load
+    curve plus the daemon's own shed/inversion accounting."""
+    import asyncio
+
+    from gubernator_tpu.config import BehaviorConfig, DaemonConfig
+    from gubernator_tpu.proto import gubernator_pb2 as pb
+    from gubernator_tpu.service.daemon import Daemon
+
+    steps = _OVERLOAD_SCENARIOS[scenario]
+    max_workers = max(m for _l, m, _k in steps) * base_workers
+
+    async def run() -> dict:
+        conf = DaemonConfig(
+            grpc_address="127.0.0.1:0", http_address="",
+            cache_size=1 << 21 if scenario == "mixed_1m" else 1 << 18,
+            max_batch_size=max(1000, rows_per_req),
+            behaviors=BehaviorConfig(
+                batch_wait_ms=batch_wait_ms,
+                coalesce_limit=coalesce_limit,
+                batch_queue_rows=batch_queue_rows,
+                # spawn UNARMED: the warm waves below must all dispatch
+                # (an armed door sheds them, leaving chunk shapes
+                # uncompiled); armed right before the timed windows
+                overload_deadline_ms=0.0,
+                overload_tenant_share=tenant_share,
+            ),
+        )
+        d = await Daemon.spawn(conf)
+        n_keys = max(keys, 1 << 20) if scenario == "mixed_1m" else keys
+        corpus = {
+            kind: _overload_corpus(
+                kind, keys=n_keys, rows=rows_per_req,
+                workers=max_workers, seed=seed,
+            )
+            for kind in {k for _l, _m, k in steps}
+        }
+        # shape warm, through the UNARMED door (backpressure, no sheds —
+        # every wave dispatches): ramp the wave width so each pow2 coalesce
+        # chunk the schedule can produce compiles BEFORE a timed window —
+        # an XLA compile landing inside the flash step would masquerade as
+        # queueing latency. A wave that ran slow probably just compiled
+        # something; repeat it until a pass comes back fast (compile-free)
+        warm = corpus[steps[0][2]]
+        n_w = 1
+        ramp = []
+        while n_w < max_workers:
+            ramp.append(n_w)
+            n_w *= 2
+        ramp.append(max_workers)
+        for r, n_w in enumerate(ramp + [max_workers]):
+            for _attempt in range(5):
+                t0 = time.perf_counter()
+                await asyncio.gather(*(
+                    d.get_rate_limits_raw(warm[w][r % len(warm[w])])
+                    for w in range(n_w)
+                ))
+                if time.perf_counter() - t0 < 0.25:
+                    break
+        d.batcher.arm_overload(overload_deadline_ms)
+
+        async def worker(w: int, tier: int, reqs, stop: list, rec: dict):
+            i = 0
+            while not stop[0]:
+                data = reqs[i % len(reqs)]
+                i += 1
+                t0 = time.perf_counter()
+                try:
+                    raw = await d.get_rate_limits_raw(data)
+                except Exception:
+                    rec["errors"] += rows_per_req
+                    continue
+                dt = time.perf_counter() - t0
+                resp = pb.GetRateLimitsResp.FromString(raw)
+                served = shed = errs = 0
+                for r in resp.responses:
+                    if not r.error:
+                        served += 1
+                    elif OVERLOAD_SHED_MARK in r.error:
+                        shed += 1
+                    else:
+                        errs += 1
+                rec["offered"] += len(resp.responses)
+                rec["served"] += served
+                rec["shed"] += shed
+                rec["errors"] += errs
+                rec["lat_by_tier"].setdefault(tier, []).append(dt)
+
+        curve = []
+        for label, mult, kind in steps:
+            n_w = mult * base_workers
+            rec = {"offered": 0, "served": 0, "shed": 0, "errors": 0,
+                   "lat_by_tier": {}}
+            stop = [False]
+            dbg0 = d.batcher.debug()
+            tasks = [
+                asyncio.ensure_future(worker(
+                    w,
+                    # the corpus's own tier assignment (abusers ride tier 0)
+                    0 if kind == "abuse" and w % 2 == 1
+                    else _TIER_CYCLE[w % len(_TIER_CYCLE)],
+                    corpus[kind][w], stop, rec,
+                ))
+                for w in range(n_w)
+            ]
+            t0 = time.perf_counter()
+            await asyncio.sleep(seconds_per_step)
+            stop[0] = True
+            await asyncio.gather(*tasks)
+            elapsed = time.perf_counter() - t0
+            dbg1 = d.batcher.debug()
+            p99 = {
+                str(t): round(
+                    float(np.percentile(np.asarray(v) * 1e3, 99)), 2
+                )
+                for t, v in sorted(rec["lat_by_tier"].items())
+            }
+            curve.append({
+                "step": label,
+                "workers": n_w,
+                "offered_rows_per_s": round(rec["offered"] / elapsed, 1),
+                "goodput_rows_per_s": round(rec["served"] / elapsed, 1),
+                "shed_rows_per_s": round(rec["shed"] / elapsed, 1),
+                "error_rows": rec["errors"],
+                "request_p99_ms_by_tier": p99,
+                "sheds": {
+                    k: dbg1["shed_rows"][k] - dbg0["shed_rows"][k]
+                    for k in dbg1["shed_rows"]
+                },
+            })
+        dbg = d.batcher.debug()
+        await d.close()
+        return {
+            "scenario": scenario,
+            "curve": curve,
+            "priority_inversions": dbg["priority_inversions"],
+            "shed_rows": dbg["shed_rows"],
+            "shed_by_tier": dbg["shed_by_tier"],
+            "admitted_by_tier": dbg["admitted_by_tier"],
+            "knobs": {
+                "overload_deadline_ms": overload_deadline_ms,
+                "batch_queue_rows": batch_queue_rows,
+                "tenant_share": tenant_share,
+                "rows_per_req": rows_per_req,
+                "seconds_per_step": seconds_per_step,
+            },
+        }
+
+    return asyncio.run(run())
+
+
+def overload_case() -> dict:
+    """Bench-matrix overload phase: all five scenarios, each its own
+    loopback daemon, the per-step records forming the
+    goodput-vs-offered-load curves the robustness doc points at."""
+    import os
+
+    out: dict = {}
+    secs = float(os.environ.get("OVL_SECONDS", 2.0))
+    for name in _OVERLOAD_SCENARIOS:
+        res = drive_overload_scenario(name, seconds_per_step=secs)
+        out[name] = res
+        peak = max(res["curve"], key=lambda s: s["offered_rows_per_s"])
+        log(
+            f"[overload:{name}] peak offered "
+            f"{peak['offered_rows_per_s']/1e3:.1f}K rows/s, goodput "
+            f"{peak['goodput_rows_per_s']/1e3:.1f}K, shed "
+            f"{peak['shed_rows_per_s']/1e3:.1f}K; inversions="
+            f"{res['priority_inversions']}"
+        )
+        if res["priority_inversions"]:
+            out["error"] = f"{name}: priority inversions observed"
+    return out
+
+
 def algorithms_case(rng, now) -> dict:
     """ISSUE-10 scenario-breadth phase: per-algorithm device throughput at
     the headline geometry (10M live keys on TPU / 1M on CPU, 128K batch).
@@ -2687,6 +2957,12 @@ def main() -> None:
         "leases",
         lambda: leases_case(np.random.default_rng(57), now),
     )
+
+    # overload phase (ISSUE 19): the replayable scenario harness — diurnal
+    # / 10× flash crowd / hot-key storm / abusive tenant / mixed ≥1M keys
+    # through an armed loopback door, each step one point on the
+    # goodput-vs-offered-load curve — docs/robustness.md "Overload & QoS"
+    matrix["overload"] = _attempt("overload", overload_case)
 
     # hot-set tiering phase (ISSUE 15): tracked-keys-vs-capacity curve on
     # a shadow-armed engine + hot-set rate vs the no-tiering baseline

@@ -2158,6 +2158,68 @@ def tier_smoke() -> dict:
     return out
 
 
+def overload_smoke() -> dict:
+    """Overload-plane regression gate (docs/robustness.md "Overload &
+    QoS"): a 10× flash crowd through a loopback daemon with the overload
+    plane armed (bounded ring, 75 ms enqueue deadline, tier-major
+    dispatch). Gated:
+
+    (a) **zero priority inversions** — a request must never be shed for
+        capacity while strictly-lower-tier rows sit admitted (the
+        preempt-before-shed rule's runtime proof, counted in the batcher);
+    (b) **the plane engages** — the flash step must actually shed (an
+        overload gate that never sheds is gating nothing);
+    (c) **goodput floor** — during the 10× step the door must keep serving:
+        goodput ≥ 25% of the offered flood AND ≥ 80% of the pre-flash
+        goodput (the anti-collapse bound — shedding is for the excess, not
+        the base load);
+    (d) **bounded top-tier p99** — tier-3 requests must clear the flash
+        step under a fixed wall (generous for CI weather; the disarmed
+        door's queue grows without bound here, so ANY fixed bound
+        separates armed from unarmed).
+    """
+    from bench import drive_overload_scenario
+
+    res = drive_overload_scenario(
+        "flash_crowd", seconds_per_step=1.5, base_workers=4,
+        rows_per_req=128, keys=1 << 14, coalesce_limit=1024,
+        batch_queue_rows=2048, overload_deadline_ms=75.0,
+    )
+    steps = {s["step"]: s for s in res["curve"]}
+    pre, flash = steps["pre"], steps["flash"]
+    shed_total = sum(flash["sheds"].values())
+    tier3_p99 = flash["request_p99_ms_by_tier"].get("3", 0.0)
+    out = {
+        "offered_flash_rows_per_s": flash["offered_rows_per_s"],
+        "goodput_flash_rows_per_s": flash["goodput_rows_per_s"],
+        "goodput_pre_rows_per_s": pre["goodput_rows_per_s"],
+        "flash_sheds": flash["sheds"],
+        "tier3_flash_p99_ms": tier3_p99,
+        "priority_inversions": res["priority_inversions"],
+        "shed_by_tier": res["shed_by_tier"],
+    }
+    if res["priority_inversions"]:
+        print(json.dumps({"error": "overload smoke: priority inversions "
+                          "under the saturated ring", **out}))
+        sys.exit(1)
+    if shed_total == 0:
+        print(json.dumps({"error": "overload smoke: the 10x flash crowd "
+                          "never shed — the overload plane did not engage",
+                          **out}))
+        sys.exit(1)
+    if (flash["goodput_rows_per_s"] < 0.25 * flash["offered_rows_per_s"]
+            or flash["goodput_rows_per_s"]
+            < 0.8 * pre["goodput_rows_per_s"]):
+        print(json.dumps({"error": "overload smoke: goodput collapsed "
+                          "under the flash crowd", **out}))
+        sys.exit(1)
+    if tier3_p99 > 2_000.0:
+        print(json.dumps({"error": "overload smoke: top-tier p99 unbounded "
+                          "under the flash crowd", **out}))
+        sys.exit(1)
+    return out
+
+
 def main() -> None:
     eng = LocalEngine(capacity=1 << 15, write_mode="xla")
     rng = np.random.default_rng(0)
@@ -2190,6 +2252,7 @@ def main() -> None:
         "lease_smoke": lease_smoke(),
         "tier_smoke": tier_smoke(),
         "ring_smoke": ring_smoke(),
+        "overload_smoke": overload_smoke(),
     }))
 
 

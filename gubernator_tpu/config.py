@@ -148,6 +148,22 @@ class BehaviorConfig:
     # for drain progress (backpressure) instead of growing the queue
     # without limit (0 = 8 × coalesce_limit)
     batch_queue_rows: int = 0
+    # --- overload plane (docs/robustness.md "Overload & QoS") -------------
+    # per-item enqueue deadline in ms: arms the front-door overload plane —
+    # queue waits are bounded by min(this, the caller's remaining gRPC
+    # deadline), a full ring or an infeasible wait sheds lowest-tier-first
+    # with a fast per-item overload error instead of blocking, and the
+    # dispatch order becomes tier-major. 0 (default) = disarmed: the legacy
+    # unbounded-backpressure door
+    overload_deadline_ms: float = 0.0
+    # fair admission: one tenant (key-fingerprint bucket) may hold at most
+    # this fraction of the bounded ring once the queue is ≥ half full;
+    # excess rows from that tenant shed with reason="fairness"
+    overload_tenant_share: float = 0.5
+    # fingerprint buckets for tenant accounting (rounded up to a pow2)
+    overload_tenant_buckets: int = 64
+    # reset_time hint stamped on shed responses (client retry backoff)
+    overload_retry_ms: int = 25
     # device-resident request ring (service/ring.py; docs/latency.md
     # "Dispatch budget"): all-wire flushes are staged into a fixed ring of
     # compact wire-grid slots and consumed in ticket order by a persistent
@@ -399,6 +415,13 @@ class DaemonConfig:
     # reclaim crashed clients' tokens faster at more renew RPCs
     lease_min_ttl_ms: float = 100.0
     lease_max_ttl_ms: float = 30_000.0
+    # tier-aware lease sizing (docs/robustness.md "Overload & QoS"): scale
+    # lease grants by the requester's priority tier — tier 3 keeps the full
+    # computed grant, each tier below loses 25% (tier 0 gets 25%), and under
+    # key pressure the response carries a shrink_to hint sized the same
+    # way so edges release quota before their TTL. Off (default) preserves
+    # tier-blind grants
+    lease_priority_scaling: bool = False
     # absolute per-key cap on Σ outstanding leased tokens (0 = only the
     # fraction cap applies) — for huge limits where even a small fraction
     # delegates more than an edge fleet should hold
@@ -559,6 +582,22 @@ class DaemonConfig:
             raise ConfigError("GUBER_BATCH_CLOSE_BYTES must be positive")
         if self.behaviors.batch_queue_rows < 0:
             raise ConfigError("GUBER_BATCH_QUEUE_ROWS must be >= 0 (0 = auto)")
+        if self.behaviors.overload_deadline_ms < 0:
+            raise ConfigError(
+                "GUBER_OVERLOAD_DEADLINE_MS must be >= 0 (0 = overload "
+                "plane disarmed)"
+            )
+        if not (0.0 < self.behaviors.overload_tenant_share <= 1.0):
+            raise ConfigError(
+                "GUBER_OVERLOAD_TENANT_SHARE must be in (0, 1] (the ring "
+                "fraction one tenant bucket may hold)"
+            )
+        if self.behaviors.overload_tenant_buckets <= 0:
+            raise ConfigError(
+                "GUBER_OVERLOAD_TENANT_BUCKETS must be positive"
+            )
+        if self.behaviors.overload_retry_ms <= 0:
+            raise ConfigError("GUBER_OVERLOAD_RETRY_MS must be positive")
         if self.behaviors.ring_slots < 2:
             raise ConfigError(
                 "GUBER_RING_SLOTS must be >= 2 (a 1-slot ring serializes "
@@ -711,6 +750,16 @@ def setup_daemon_config(
                 env, "GUBER_BATCH_CLOSE_BYTES", 1 << 20
             ),
             batch_queue_rows=_get_int(env, "GUBER_BATCH_QUEUE_ROWS", 0),
+            overload_deadline_ms=_get_float_ms(
+                env, "GUBER_OVERLOAD_DEADLINE_MS", 0.0
+            ),
+            overload_tenant_share=_get_fraction(
+                env, "GUBER_OVERLOAD_TENANT_SHARE", 0.5
+            ),
+            overload_tenant_buckets=_get_int(
+                env, "GUBER_OVERLOAD_TENANT_BUCKETS", 64
+            ),
+            overload_retry_ms=_get_int(env, "GUBER_OVERLOAD_RETRY_MS", 25),
             ring_enable=_get_bool(env, "GUBER_RING_ENABLE", False),
             ring_slots=_get_int(env, "GUBER_RING_SLOTS", 64),
             warm_shapes=_get(env, "GUBER_WARM_SHAPES", ""),
@@ -805,6 +854,9 @@ def setup_daemon_config(
         ),
         lease_max_outstanding=_get_int(
             env, "GUBER_LEASE_MAX_OUTSTANDING", 0
+        ),
+        lease_priority_scaling=_get_bool(
+            env, "GUBER_PRIORITY_LEASE_SCALING", False
         ),
         created_at_tolerance_ms=_get_float_ms(
             env, "GUBER_CREATED_AT_TOLERANCE", 5 * 60 * 1000.0

@@ -59,6 +59,7 @@ class LimiterStats:
     tokens_returned: int = 0
     renew_errors: int = 0
     exhaustions: int = 0
+    shrinks: int = 0  # push-shrink hints honored (docs/robustness.md)
     grant_sizes: list = field(default_factory=list)
 
 
@@ -363,6 +364,16 @@ class LocalLimiter:
                 self._expires_at = max(
                     self._expires_at, int(resp.expires_at)
                 )
+        # push-shrink hint (LeaseQuotaResp.shrink_to): the daemon is asking
+        # this edge to run on a smaller slice — clamp the adaptive grant
+        # target BEFORE the next admission burst, so the following renewal
+        # round returns the excess (the b > _grant giveback above) instead
+        # of holding pressured quota until the TTL
+        shrink = int(getattr(resp, "shrink_to", 0))
+        if shrink > 0 and shrink < self._grant:
+            self._grant = max(self.min_grant, shrink)
+            self.stats.shrinks += 1
+            self._wake.set()  # return the excess promptly, not at the TTL
         if granted > 0:
             self.stats.grants += 1
             self.stats.tokens_granted += granted

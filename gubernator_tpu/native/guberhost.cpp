@@ -158,22 +158,24 @@ enum { ERR_OK = 0, ERR_EMPTY_KEY = 1, ERR_EMPTY_NAME = 2 };
 // HITS_BITS, behavior bit budget). The parser pre-packs each item into the
 // 5-lane int32 ingress row IN THE SAME PASS so the serving path can stage a
 // dispatch grid without ever materializing per-column int64 arrays; the
-// created_at delta (lane 4 bits 18-29) is left zero — the flush loop ORs it
-// in once the batch base is known. Lane 3 is duration[0:27] | algo << 27
+// created_at delta (lane 4 bits 18-27) is left zero — the flush loop ORs it
+// in once the batch base is known; bits 28-29 carry the priority tier. Lane 3 is duration[0:27] | algo << 27
 // (3 bits — five in-kernel algorithms) | cascade_level << 30; the parser
 // always emits level 0 (cascade requests take the pb path — see field 11
 // below).
 static const int64_t WIRE_DUR_MASK = (1LL << 27) - 1;   // ops/wire.DUR_BITS
 static const int64_t WIRE_HITS_MASK = (1LL << 18) - 1;  // ops/wire.HITS_BITS
 static const int64_t WIRE_I32_MAX = 2147483647LL;
-// RESET_REMAINING | DRAIN_OVER_LIMIT | kernel-inert bits (ops/wire.py
-// _ENCODABLE_BEHAVIOR); anything else (Gregorian, unknown) → full-width
-static const int32_t WIRE_ENC_BEHAVIOR = 8 | 32 | 1 | 2 | 16;
-// known client-facing behavior flag bits (types.Behavior values 1..32) —
-// anything above is masked at ingress: the behavior word's high bits carry
-// the INTERNAL cascade level (types.CASCADE_LEVEL_SHIFT), which clients
-// must not be able to forge
-static const int32_t BEHAVIOR_CLIENT_MASK = 63;
+// RESET_REMAINING | DRAIN_OVER_LIMIT | kernel-inert bits | the 2-bit
+// priority tier (ops/wire.py _ENCODABLE_BEHAVIOR); anything else
+// (Gregorian, unknown) → full-width
+static const int32_t WIRE_ENC_BEHAVIOR = 8 | 32 | 1 | 2 | 16 | 64 | 128;
+// known client-facing behavior bits: flag values 1..32 plus the 2-bit
+// priority tier at bits 6-7 (types.PRIORITY_SHIFT) — anything above is
+// masked at ingress: the behavior word's high bits carry the INTERNAL
+// cascade level (types.CASCADE_LEVEL_SHIFT), which clients must not be
+// able to forge
+static const int32_t BEHAVIOR_CLIENT_MASK = 255;
 // highest algorithm enum this build speaks (types.MAX_ALGORITHM); larger
 // values are per-item errors on the full path, so never fused
 static const int32_t MAX_ALGORITHM = 4;
@@ -415,6 +417,7 @@ static PyObject* parse_get_rate_limits(PyObject*, PyObject* args) {
         ((uint64_t)(it.duration & WIRE_DUR_MASK)) |
         ((uint64_t)(uint32_t)it.algorithm << 27));
     uint32_t l4 = (uint32_t)(it.hits & WIRE_HITS_MASK);
+    l4 |= (uint32_t)((it.behavior >> 6) & 3) << 28;  // priority tier
     if (it.behavior & 8) l4 |= 1u << 30;   // RESET_REMAINING
     if (it.behavior & 32) l4 |= 1u << 31;  // DRAIN_OVER_LIMIT
     lanes[4 * n + i] = (int32_t)l4;

@@ -173,6 +173,11 @@ ERR_ALGORITHM = 7
 # the daemon parameterizes the message with the configured cap
 # (service/wire.cascade_too_deep_error); this entry is the generic default
 ERR_CASCADE_DEEP = 8
+# shed by the overload plane before reaching the engine (service/batcher.py
+# deadline/priority shedding — docs/robustness.md "Overload & QoS"): the
+# answer rides a fast per-item OVER_LIMIT-style row whose reset_time is the
+# suggested retry instant, never an RPC failure
+ERR_OVERLOAD = 9
 
 # wording parity with the reference where it has fixed strings
 # (gubernator.go:215-224); ERR_DROPPED is this design's own failure mode
@@ -186,6 +191,7 @@ ERROR_STRINGS = {
     ERR_DROPPED: "rate limit state could not be persisted (contended table); retry",
     ERR_ALGORITHM: "invalid rate limit algorithm",
     ERR_CASCADE_DEEP: "cascade levels list too large",
+    ERR_OVERLOAD: "request shed under overload; retry after reset_time",
 }
 
 

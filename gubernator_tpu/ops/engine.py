@@ -619,7 +619,7 @@ def prepare_check_wire(engine, parts, now_ms=None) -> "PendingCheck | None":
     this scatter is the only further touch. Returns a PendingCheck for the
     standard issue/finish halves, or None when the batch needs the general
     columns path (engine not wire-capable, non-encodable rows, duplicate
-    fingerprints, created_at skew beyond the ±2047 ms delta budget, Store
+    fingerprints, created_at skew beyond the ±511 ms delta budget, Store
     attached) — the fallback is semantically identical, it just pays the
     full pack."""
     if not getattr(engine, "supports_wire_ingress", False):
@@ -1115,7 +1115,7 @@ class LocalEngine:
             # oracle engines return unpacked outputs; pack on device for the
             # same downstream shape
             self.table, resp, stats = self._decide_fn(self.table, to_device(hb))
-            return np.asarray(pack_outputs(resp, stats))
+            return np.asarray(pack_outputs(resp, stats, hb.behavior))
         math = self._effective_math(hb)
         if self._batch_needs_full(math, hb):
             self.migrate_layout_full()

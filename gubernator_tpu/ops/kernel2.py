@@ -834,7 +834,9 @@ decide2 = functools.partial(
 )(decide2_impl)
 
 
-def pack_outputs(resp: RespBatch, stats: BatchStats) -> jnp.ndarray:
+def pack_outputs(
+    resp: RespBatch, stats: BatchStats, behavior=None
+) -> jnp.ndarray:
     """Pack responses + stats into ONE (B+2, 4) i64 array.
 
     The serving engine reads kernel results with a single device→host
@@ -843,12 +845,21 @@ def pack_outputs(resp: RespBatch, stats: BatchStats) -> jnp.ndarray:
     Layout: row i < B = [limit, remaining, reset_time, flags] with
     flags = status | cache_hit<<1 | dropped<<2; row B = [cache_hits,
     cache_misses, over_limit, evicted_unexpired]; row B+1 = [dropped, 0, 0, 0].
+
+    `behavior` (the request batch's behavior words, optional) echoes each
+    row's priority tier (types.PRIORITY_SHIFT) into flags bits 5-6
+    (FLAG_TIER_SHIFT) — the decision's QoS tier rides the same fetched
+    array, so the batcher and the metrics plane read it without a
+    host-side side table.
     """
     flags = (
         resp.status.astype(i64)
         | (resp.cache_hit.astype(i64) << 1)
         | (resp.dropped.astype(i64) << 2)
     )
+    if behavior is not None:
+        tier = (jnp.asarray(behavior).astype(i64) >> _BEH_PRIO_SHIFT) & 3
+        flags = flags | (tier << FLAG_TIER_SHIFT)
     rows = jnp.stack([resp.limit, resp.remaining, resp.reset_time, flags], axis=1)
     z = jnp.zeros((), dtype=i64)
     srow0 = jnp.stack(
@@ -937,6 +948,22 @@ FLAG_UNPROCESSED = 8
 # accounting must skip them — exactly like the host planner's member rows,
 # which serve_columns answers from the aggregate without counting
 FLAG_MEMBER = 16
+# bits 5-6: the row's priority tier (types.PRIORITY_SHIFT field of the
+# request behavior word), echoed by pack_outputs so overload accounting
+# reads the tier straight off the fetched array
+FLAG_TIER_SHIFT = 5
+FLAG_TIER_MASK = 0x3
+# behavior-word priority field position (types.PRIORITY_SHIFT)
+_BEH_PRIO_SHIFT = 6
+
+
+def unpack_tiers(arr: np.ndarray, n: int) -> np.ndarray:
+    """Per-row priority tiers from a fetched pack_outputs array (either
+    wire format — the flags column layout is shared)."""
+    return (
+        (np.asarray(arr[:n, 3]).astype(np.int64) >> FLAG_TIER_SHIFT)
+        & FLAG_TIER_MASK
+    ).astype(np.int32)
 
 
 def unpack_outputs(arr, n: int):
@@ -970,11 +997,11 @@ def decide2_packed_impl(
         table, resp, stats, ev16 = decide2_impl(
             table, req, write=write, math=math, probe=probe, evictees=True
         )
-        return table, pack_outputs(resp, stats), ev16
+        return table, pack_outputs(resp, stats, req.behavior), ev16
     table, resp, stats = decide2_impl(
         table, req, write=write, math=math, probe=probe
     )
-    return table, pack_outputs(resp, stats)
+    return table, pack_outputs(resp, stats, req.behavior)
 
 
 def req_from_arr(arr: jnp.ndarray) -> ReqBatch:

@@ -13,7 +13,8 @@ directions with an in-trace-decoded packed layout:
                          invariant every serving path already maintains)
   lane 2  limit          full int32 (front-door validated to int32)
   lane 3  duration[0:27] | algo << 27 (3 bits) | cascade_level << 30 (2 bits)
-  lane 4  hits[0:18] | (created_delta + 2048) << 18 | RESET << 30 | DRAIN << 31
+  lane 4  hits[0:18] | (created_delta + 512) << 18 | priority << 28
+          | RESET << 30 | DRAIN << 31
 
   column B (the +1): cells [0, B], [1, B] carry the batch's created_at BASE
   (lo/hi int32) — every other per-row timestamp decodes as base-relative.
@@ -26,13 +27,19 @@ leaky and GCRA rows (the burst==0→limit defaulting both algorithms' packs
 apply), 0 otherwise (no other algorithm reads burst — ops/math.py).
 Behavior ships as exactly the two bits the decision math consumes
 (RESET_REMAINING, DRAIN_OVER_LIMIT) plus the 2-bit cascade level (levels
-above CASCADE_WIRE_MAX_LEVEL ride full-width); kernel-inert bits
-(NO_BATCHING, GLOBAL, MULTI_REGION) are dropped on the wire.
+above CASCADE_WIRE_MAX_LEVEL ride full-width) and the 2-bit priority tier
+(types.PRIORITY_SHIFT — the overload plane's QoS field, echoed back in the
+egress flags); kernel-inert bits (NO_BATCHING, GLOBAL, MULTI_REGION) are
+dropped on the wire.
 
 The algo field grew from 2 to 3 bits (the five in-kernel algorithms) and
 the cascade level took the remaining 2, paid for by narrowing the duration
 budget from 2^30 to 2^27 ms (~37 hours — daily quotas still fit; multi-day
 windows fall back to full-width, exactly like weekly ones always did).
+The priority tier was paid for the same way: the created-at delta budget
+narrowed from ±2047 to ±511 ms of the batch base — serving batches stamp
+one ingress `now` over the whole batch (delta 0), so only client-supplied
+created_at beyond half a second of skew falls back to full-width.
 
 **Egress — (B+2, 4) int32 (16 B/row), same row layout as kernel2.pack_outputs:**
 
@@ -47,7 +54,7 @@ dispatches on dtype alone). Host-side decode is vectorized numpy.
 
 **Fallback contract.** Not every batch is representable (Gregorian
 durations, hits ≥ 2^18, durations ≥ 2^30 ms, created_at skew beyond
-±2047 ms of the batch base, negative limits, explicit leaky bursts).
+±511 ms of the batch base, negative limits, explicit leaky bursts).
 `wire_encodable` checks a batch host-side in a handful of vectorized
 passes; non-encodable dispatches take the full-width path — identical
 semantics, more bytes — and `GUBER_WIRE_COMPACT=0` forces full-width
@@ -86,8 +93,9 @@ ALGO_BITS = 3  # five in-kernel algorithms (types.Algorithm)
 LEVEL_SHIFT = DUR_BITS + ALGO_BITS  # cascade level, 2 bits (30, 31)
 LEVEL_MAX = 3  # types.CASCADE_WIRE_MAX_LEVEL — deeper cascades → full-width
 HITS_BITS = 18  # hits in [0, 2^18) — covers host-aggregated 131K-row carriers
-DELTA_BITS = 12  # created_at - base in [-2048, 2047] ms
+DELTA_BITS = 10  # created_at - base in [-512, 511] ms
 DELTA_BIAS = 1 << (DELTA_BITS - 1)
+PRIO_SHIFT = HITS_BITS + DELTA_BITS  # priority tier, 2 bits (28, 29)
 _DUR_MASK = (1 << DUR_BITS) - 1
 _ALGO_MASK = (1 << ALGO_BITS) - 1
 _HITS_MASK = (1 << HITS_BITS) - 1
@@ -95,6 +103,8 @@ _DELTA_MASK = (1 << DELTA_BITS) - 1
 RESET_SENTINEL = -(2**31)  # egress reset_delta value for reset_time == 0
 # behavior-word cascade level field (types.CASCADE_LEVEL_SHIFT)
 _BEH_LEVEL_SHIFT = 8
+# behavior-word priority tier field (types.PRIORITY_SHIFT)
+_BEH_PRIO_SHIFT = 6
 _MAX_ALGO = 4  # types.MAX_ALGORITHM — wire-encodable algorithm range
 
 # Behavior bits (gubernator_tpu.types.Behavior values, frozen by the proto)
@@ -103,7 +113,8 @@ _DRAIN = 32  # DRAIN_OVER_LIMIT — consumed by the decision math
 _GREG = 4  # DURATION_IS_GREGORIAN — host-resolved; forces full-width
 # bits the kernel never reads (ops/math.py) — safe to drop on the wire
 _INERT = 1 | 2 | 16  # NO_BATCHING | GLOBAL | MULTI_REGION
-_ENCODABLE_BEHAVIOR = _RESET | _DRAIN | _INERT
+_PRIO_BEH = 0x3 << _BEH_PRIO_SHIFT  # priority tier — carried in lane 4
+_ENCODABLE_BEHAVIOR = _RESET | _DRAIN | _INERT | _PRIO_BEH
 
 I32_MAX = 2**31 - 1
 
@@ -214,9 +225,11 @@ def pack_wire_rows(
     arr[3] = np.where(act, l3, 0).astype(np.int64).astype(np.int32)
     reset = (b.behavior & _RESET) != 0
     drain = (b.behavior & _DRAIN) != 0
+    prio = (b.behavior.astype(np.int64) >> _BEH_PRIO_SHIFT) & 0x3
     l4 = (
         (b.hits & _HITS_MASK)
         | (((b.created_at - base + DELTA_BIAS) & _DELTA_MASK) << HITS_BITS)
+        | (prio << PRIO_SHIFT)
         | (reset.astype(np.int64) << 30)
         | (drain.astype(np.int64) << 31)
     )
@@ -256,7 +269,7 @@ def assemble_wire_grid(
     the staging — no RequestColumns concat, no 12-column HostBatch pack, no
     second wire pack; the request bytes were traversed exactly once, by the
     parser. `created` holds the stamped absolute created_at over the
-    concatenated rows; callers verify the delta budget (±2047 ms of `base`)
+    concatenated rows; callers verify the delta budget (±511 ms of `base`)
     before assembling."""
     grid = np.zeros((WIRE_LANES, pad + 1), dtype=np.int32)
     off = 0
@@ -326,6 +339,7 @@ def decode_wire_block(blk: jnp.ndarray):
     behavior = (
         ((l4 >> 30) & 1) * _RESET
         | ((l4 >> 31) & 1) * _DRAIN
+        | (((l4 >> PRIO_SHIFT) & 3) << _BEH_PRIO_SHIFT)
         | (level << _BEH_LEVEL_SHIFT)
     )
     created = base + delta
@@ -397,6 +411,7 @@ def decode_wire_host(lanes: np.ndarray, base: int) -> dict:
     behavior = (
         ((l4 >> 30) & 1) * _RESET
         | ((l4 >> 31) & 1) * _DRAIN
+        | (((l4 >> PRIO_SHIFT) & 3) << _BEH_PRIO_SHIFT)
         | (level << _BEH_LEVEL_SHIFT)
     )
     created = base + delta

@@ -24,6 +24,19 @@ Three serving-plane mechanics live here (docs/latency.md "Serving plane"):
   engine is idle the window closes immediately (light load pays no
   batching latency). `batch_wait_ms` remains the hard ceiling.
 
+* **Overload plane** (docs/robustness.md "Overload & QoS"). Armed by
+  `GUBER_OVERLOAD_DEADLINE_MS` (or an inbound gRPC deadline), each enqueue
+  carries a deadline and a priority tier (types.PRIORITY_SHIFT behavior
+  bits). A full ring or a hopeless queue-wait estimate sheds the LOWEST
+  tier first with a fast per-item OVER_LIMIT-style overload row
+  (ops/batch.ERR_OVERLOAD) instead of queueing work whose answer nobody
+  will wait for; a higher-tier arrival preempts queued lower-tier entries
+  rather than being shed itself, which makes priority inversions zero by
+  construction. Per-tenant fair admission (fingerprint buckets) caps any
+  one tenant at its share of the window once the queue is under pressure.
+  With the knob unset and no inbound deadline, behavior is exactly the
+  legacy unbounded backpressure.
+
 NO_BATCHING items bypass the window (reference peer_client.go:126-162's fast
 path) by calling the runner directly.
 """
@@ -33,14 +46,20 @@ from __future__ import annotations
 import asyncio
 import time
 from collections import deque
-from typing import Deque, List, Optional, Tuple
+from typing import Deque, List, Optional
 
 import numpy as np
 
 from gubernator_tpu import tracing
-from gubernator_tpu.ops.batch import RequestColumns, ResponseColumns
+from gubernator_tpu.ops.batch import (
+    ERR_OVERLOAD,
+    RequestColumns,
+    ResponseColumns,
+)
 from gubernator_tpu.ops.engine import ms_now
+from gubernator_tpu.service import deadline as deadline_mod
 from gubernator_tpu.service.wire import WireBatch, concat_columns
+from gubernator_tpu.types import PRIORITY_MASK, PRIORITY_SHIFT
 
 # device batches coalesce far beyond the reference's 1000-item RPC cap — the
 # kernel's throughput comes from large batches; this caps one dispatch.
@@ -57,6 +76,44 @@ def _payload_rows(payload) -> int:
 
 def _payload_cols(payload) -> RequestColumns:
     return payload.cols if isinstance(payload, WireBatch) else payload
+
+
+def _payload_tier(payload) -> int:
+    """The enqueue's priority tier: the MAX tier among its rows — a batch
+    carrying any high-priority row is protected as a whole (shedding is
+    per-enqueue; one RPC's batch shares one future)."""
+    beh = _payload_cols(payload).behavior
+    if beh.shape[0] == 0:
+        return 0
+    return int(((beh.astype(np.int64) >> PRIORITY_SHIFT) & PRIORITY_MASK).max())
+
+
+def _payload_bucket(payload, buckets: int) -> int:
+    """The enqueue's tenant bucket: its first row's fingerprint folded into
+    `buckets` — key fingerprints are name+key hashes, so a tenant's
+    namespace lands in a stable bucket without a host-side tenant table."""
+    fp = _payload_cols(payload).fp
+    if fp.shape[0] == 0:
+        return 0
+    return int(fp[0]) & (buckets - 1)
+
+
+class _Entry:
+    """One enqueued batch awaiting dispatch."""
+
+    __slots__ = ("payload", "fut", "t_enq", "span", "rows", "tier", "bucket",
+                 "deadline")
+
+    def __init__(self, payload, fut, t_enq, span, rows, tier, bucket,
+                 deadline):
+        self.payload = payload
+        self.fut = fut
+        self.t_enq = t_enq  # perf_counter at enqueue
+        self.span = span
+        self.rows = rows
+        self.tier = tier  # 0 (best-effort) .. 3 (shed last)
+        self.bucket = bucket  # tenant fingerprint bucket
+        self.deadline = deadline  # absolute monotonic instant, or None
 
 
 class Batcher:
@@ -83,6 +140,10 @@ class Batcher:
         close_bytes: int = 1 << 20,
         max_queue_rows: int = 0,
         ring=None,
+        overload_deadline_ms: float = 0.0,
+        tenant_share: float = 0.5,
+        tenant_buckets: int = 64,
+        shed_retry_ms: int = 25,
     ):
         self.runner = runner
         # device-resident request ring (service/ring.py): when armed,
@@ -105,15 +166,30 @@ class Batcher:
         self.max_queue_rows = (
             max_queue_rows if max_queue_rows > 0 else coalesce_limit * 8
         )
-        # deque of (payload, future, enqueue perf_counter, requester span):
-        # workers pop from the head per coalesced chunk — a list's pop(0) is
-        # O(n) per pop, O(n²) across a backlog drain. The span is the
-        # enqueueing request's trace context, linked to the dispatch span
-        # that ends up serving it (batching breaks parent-child causality;
-        # OTLP links restore it — docs/observability.md).
-        self._pending: Deque[Tuple[object, asyncio.Future, float, object]] = (
-            deque()
-        )
+        # overload plane (docs/robustness.md "Overload & QoS"): the default
+        # per-item deadline; 0 disarms everything but inbound-gRPC-deadline
+        # bounding (legacy unbounded backpressure otherwise)
+        self.overload_deadline_s = max(0.0, overload_deadline_ms) / 1e3
+        self.armed = self.overload_deadline_s > 0
+        self.tenant_share = tenant_share
+        # fairness bucket count, forced to a power of two (fp & (n-1) fold)
+        tb = max(1, tenant_buckets)
+        self.tenant_buckets = 1 << (tb - 1).bit_length()
+        self.shed_retry_ms = shed_retry_ms
+        # deque of _Entry: workers pop from the head per coalesced chunk —
+        # a list's pop(0) is O(n) per pop, O(n²) across a backlog drain.
+        # entry.span is the enqueueing request's trace context, linked to
+        # the dispatch span that ends up serving it (batching breaks
+        # parent-child causality; OTLP links restore it —
+        # docs/observability.md).
+        self._pending: Deque[_Entry] = deque()
+        self._bucket_rows: dict = {}  # tenant bucket → queued rows
+        # EWMA of the drain rate (rows/s over dispatch completions) — the
+        # queue-wait estimate `pending_rows / rate` that sheds doomed
+        # enqueues up front instead of letting them expire in the queue
+        self._drain_rate = 0.0
+        self._drain_t = 0.0
+        self._drain_rows = 0
         self._pending_rows = 0
         self._pending_bytes = 0
         self._wake: Optional[asyncio.Event] = None
@@ -132,6 +208,16 @@ class Batcher:
         # adaptive-close reason split (the /v1/debug/pipeline payload):
         # rows/bytes thresholds, idle engine, freed dispatch slot
         self.close_reasons = {"rows": 0, "bytes": 0, "idle": 0, "slot": 0}
+        # overload-plane counters (tests + /v1/debug/pipeline + CI gate)
+        self.shed_rows = {
+            "queue_full": 0, "deadline": 0, "fairness": 0, "preempted": 0
+        }
+        self.shed_by_tier = [0, 0, 0, 0]
+        self.admitted_by_tier = [0, 0, 0, 0]
+        # capacity sheds that left a strictly lower tier still queued —
+        # zero by construction (preemption runs first); the CI overload
+        # smoke gates this at exactly 0
+        self.priority_inversions = 0
 
     # ------------------------------------------------------------- enqueue
     async def check(self, payload, now_ms: Optional[int] = None) -> ResponseColumns:
@@ -160,21 +246,62 @@ class Batcher:
             self._wake = asyncio.Event()
             self._full = asyncio.Event()
             self._space = asyncio.Event()
+        tier = _payload_tier(payload)
+        bucket = _payload_bucket(payload, self.tenant_buckets)
+        deadline = self._item_deadline()
+        entry = _Entry(
+            payload, loop.create_future(), time.perf_counter(),
+            tracing.current_span(), rows, tier, bucket, deadline,
+        )
+        # per-tenant fair admission: once the queue is under pressure
+        # (≥ half full), no tenant bucket may hold more than its share of
+        # the window — one abusive tenant saturating the ring cannot starve
+        # the rest (armed mode only)
+        if (
+            self.armed
+            and self._pending_rows * 2 >= self.max_queue_rows
+            and self._bucket_rows.get(bucket, 0) + rows
+            > self.tenant_share * self.max_queue_rows
+        ):
+            return self._shed(entry, "fairness")
+        # queue-wait estimate: work that cannot be served before its
+        # deadline is answered NOW, not after expiring in the queue
+        if deadline is not None:
+            remain = deadline - time.monotonic()
+            if remain <= 0 or (
+                self._drain_rate > 0
+                and self._pending_rows / self._drain_rate > remain
+            ):
+                return self._shed(entry, "deadline")
         # bounded ring: callers past the cap wait for drain progress instead
         # of growing the queue without limit (an oversized single batch is
-        # admitted alone rather than deadlocking)
+        # admitted alone rather than deadlocking). A higher-tier arrival
+        # first PREEMPTS queued strictly-lower-tier entries (shed lowest
+        # first) — capacity pressure falls on the lowest tier by
+        # construction; an item with a deadline never waits past it.
         while (
             not self._closed
             and self._pending_rows > 0
             and self._pending_rows + rows > self.max_queue_rows
         ):
+            if self.armed and self._preempt_lower(entry):
+                break
+            if deadline is None:
+                self._space.clear()
+                await self._space.wait()
+                continue
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                return self._shed(entry, "queue_full")
             self._space.clear()
-            await self._space.wait()
-        fut: asyncio.Future = loop.create_future()
-        self._pending.append(
-            (payload, fut, time.perf_counter(), tracing.current_span())
-        )
+            try:
+                await asyncio.wait_for(self._space.wait(), remain)
+            except asyncio.TimeoutError:
+                return self._shed(entry, "queue_full")
+        self._pending.append(entry)
         self._pending_rows += rows
+        self._bucket_rows[bucket] = self._bucket_rows.get(bucket, 0) + rows
+        self.admitted_by_tier[tier] += rows
         self._pending_bytes += (
             payload.nbytes if isinstance(payload, WireBatch) else 0
         )
@@ -189,7 +316,116 @@ class Batcher:
                 or self._pending_bytes >= self.close_bytes
             ):
                 self._full.set()
-        return await fut
+        return await entry.fut
+
+    # ------------------------------------------------------ overload plane
+    def _item_deadline(self) -> Optional[float]:
+        """This enqueue's absolute monotonic deadline: the tighter of the
+        overload knob and the inbound gRPC deadline (service/deadline.py);
+        None when neither applies — the legacy unbounded contract."""
+        knob = (
+            time.monotonic() + self.overload_deadline_s
+            if self.overload_deadline_s > 0
+            else None
+        )
+        inbound = deadline_mod.inbound_deadline()
+        if knob is None:
+            return inbound
+        if inbound is None:
+            return knob
+        return min(knob, inbound)
+
+    def _shed(self, entry: _Entry, reason: str) -> ResponseColumns:
+        """Answer an entry WITHOUT dispatching it: a fast per-item
+        OVER_LIMIT-style overload row (ERR_OVERLOAD, reset_time = the
+        suggested retry instant). The caller's RPC succeeds — overload is
+        a per-item decision, like every other limit verdict."""
+        self.shed_rows[reason] += entry.rows
+        self.shed_by_tier[entry.tier] += entry.rows
+        if reason in ("queue_full", "preempted") and any(
+            e.tier < entry.tier for e in self._pending
+        ):
+            # should be unreachable (preemption sheds lowest-first); the
+            # counter existing — and being gated at 0 in CI — is the proof
+            self.priority_inversions += 1
+        if self.metrics is not None:
+            self.metrics.shed_total.labels(
+                reason=reason, tier=str(entry.tier)
+            ).inc(entry.rows)
+        rc = self._overload_columns(entry.payload)
+        if not entry.fut.done():
+            entry.fut.set_result(rc)
+        return rc
+
+    def _overload_columns(self, payload) -> ResponseColumns:
+        cols = _payload_cols(payload)
+        n = cols.fp.shape[0]
+        reset = ms_now() + self.shed_retry_ms
+        return ResponseColumns(
+            status=np.ones(n, dtype=np.int32),  # Status.OVER_LIMIT
+            limit=cols.limit.astype(np.int64, copy=True),
+            remaining=np.zeros(n, dtype=np.int64),
+            reset_time=np.full(n, reset, dtype=np.int64),
+            err=np.full(n, ERR_OVERLOAD, dtype=np.int8),
+        )
+
+    def _preempt_lower(self, entry: _Entry) -> bool:
+        """Make room for a higher-tier arrival by evicting queued entries of
+        STRICTLY lower tiers, lowest tier first then oldest first. Only
+        evicts when the freed rows actually admit the newcomer (no pointless
+        victims); returns True when space was made."""
+        need = self._pending_rows + entry.rows - self.max_queue_rows
+        victims = sorted(
+            (e for e in self._pending if e.tier < entry.tier),
+            key=lambda e: (e.tier, e.t_enq),
+        )
+        avail = sum(e.rows for e in victims)
+        if avail < need:
+            return False
+        freed = 0
+        chosen = []
+        for v in victims:
+            chosen.append(v)
+            freed += v.rows
+            if freed >= need:
+                break
+        for v in chosen:
+            self._pending.remove(v)
+            self._pending_rows -= v.rows
+            self._drop_bucket_rows(v)
+            self._shed(v, "preempted")
+        self._pending_bytes = sum(
+            e.payload.nbytes
+            for e in self._pending
+            if isinstance(e.payload, WireBatch)
+        )
+        return True
+
+    def _drop_bucket_rows(self, entry: _Entry) -> None:
+        left = self._bucket_rows.get(entry.bucket, 0) - entry.rows
+        if left > 0:
+            self._bucket_rows[entry.bucket] = left
+        else:
+            self._bucket_rows.pop(entry.bucket, None)
+
+    def _note_drained(self, rows: int) -> None:
+        """Fold one dispatch completion into the drain-rate EWMA."""
+        now = time.monotonic()
+        if self._drain_t == 0.0:
+            self._drain_t = now
+            self._drain_rows = rows
+            return
+        self._drain_rows += rows
+        dt = now - self._drain_t
+        if dt < 1e-4:
+            return
+        inst = self._drain_rows / dt
+        self._drain_rate = (
+            inst if self._drain_rate == 0.0
+            else 0.7 * self._drain_rate + 0.3 * inst
+        )
+        self._drain_t = now
+        self._drain_rows = 0
 
     def _ensure_workers(self, loop) -> None:
         self._worker_tasks = [t for t in self._worker_tasks if not t.done()]
@@ -264,29 +500,49 @@ class Batcher:
     def _take_chunk(self):
         """Pop a chunk of whole enqueued batches up to the coalesce limit
         (a single oversized enqueue dispatches alone), bounding dispatch
-        latency and compile-shape spread. One clamped gauge update per
-        flush — per-enqueue sets only churned the gauge with intermediate
-        values (hot-path metric cost at high request rates)."""
+        latency and compile-shape spread. Armed mode orders the window by
+        tier (highest first, FIFO within a tier) once a backlog has mixed
+        tiers, and sheds deadline-expired entries instead of serving them
+        — an answer after the caller stopped waiting is pure waste. One
+        clamped gauge update per flush — per-enqueue sets only churned the
+        gauge with intermediate values (hot-path metric cost at high
+        request rates)."""
         if not self._pending:
             return None
-        chunk = [self._pending.popleft()]
-        rows = _payload_rows(chunk[0][0])
-        while (
-            self._pending
-            and rows + _payload_rows(self._pending[0][0]) <= self.coalesce_limit
+        if (
+            self.armed
+            and len(self._pending) > 1
+            and len({e.tier for e in self._pending}) > 1
         ):
+            # stable sort: FIFO preserved within each tier
+            self._pending = deque(
+                sorted(self._pending, key=lambda e: -e.tier)
+            )
+        chunk = []
+        rows = 0
+        now = time.monotonic()
+        while self._pending:
+            head = self._pending[0]
+            if chunk and rows + head.rows > self.coalesce_limit:
+                break
             entry = self._pending.popleft()
+            self._pending_rows -= entry.rows
+            self._drop_bucket_rows(entry)
+            if entry.deadline is not None and now > entry.deadline:
+                self._shed(entry, "deadline")
+                continue
             chunk.append(entry)
-            rows += _payload_rows(entry[0])
-        self._pending_rows -= rows
+            rows += entry.rows
         self._pending_bytes = sum(
-            e[0].nbytes for e in self._pending if isinstance(e[0], WireBatch)
+            e.payload.nbytes
+            for e in self._pending
+            if isinstance(e.payload, WireBatch)
         )
         if self._space is not None:
             self._space.set()
         if self.metrics is not None:
             self.metrics.queue_length.set(max(self._pending_rows, 0))
-        return chunk
+        return chunk if chunk else None
 
     # ------------------------------------------------------------ dispatch
     async def _dispatch(self, batch) -> None:
@@ -300,7 +556,7 @@ class Batcher:
         fused = False
         try:
             t0 = time.perf_counter()
-            oldest = min(e[2] for e in batch)
+            oldest = min(e.t_enq for e in batch)
             if self.metrics is not None:
                 self.metrics.stage_duration.labels(stage="queue").observe(
                     t0 - oldest,
@@ -308,13 +564,21 @@ class Batcher:
                         {"trace_id": disp_span.trace_id} if disp_span else None
                     ),
                 )
+                # per-enqueue queue wait (the shed policy's p99 story):
+                # "queue" above is per-CHUNK (its oldest member); these are
+                # per admitted batch, the distribution deadlines cut into
+                qw = self.metrics.stage_duration.labels(stage="queue_wait")
+                for e in batch:
+                    wait = t0 - e.t_enq
+                    qw.observe(wait)
+                    self.metrics.queue_wait_seconds.observe(wait)
             if disp_span is not None:
                 q_ns = time.time_ns()
                 tracing.record_span(
                     "queue", tracing.new_span(disp_span), disp_span.span_id,
                     q_ns - int((t0 - oldest) * 1e9), q_ns,
                 )
-            payloads = [e[0] for e in batch]
+            payloads = [e.payload for e in batch]
             rc = None
             if all(isinstance(p, WireBatch) for p in payloads):
                 if self.ring is not None:
@@ -350,11 +614,12 @@ class Batcher:
                 self.column_dispatches += 1
         except Exception as exc:  # pragma: no cover - defensive
             for e in batch:
-                if not e[1].done():
-                    e[1].set_exception(exc)
+                if not e.fut.done():
+                    e.fut.set_exception(exc)
             return
         finally:
             self._inflight -= 1
+            self._note_drained(sum(e.rows for e in batch))
             if self._full is not None:
                 # a slot freed: a worker holding its window open should
                 # re-evaluate — refilling the pipeline beats waiting
@@ -370,7 +635,7 @@ class Batcher:
             # request spans → dispatch span links (registered while their
             # scopes are still open: the futures resolve after this), and
             # the dispatch span itself links back to every distinct request
-            req_spans = [e[3] for e in batch if e[3] is not None]
+            req_spans = [e.span for e in batch if e.span is not None]
             for rs in req_spans:
                 tracing.add_span_link(rs, disp_span)
             end_ns = time.time_ns()
@@ -378,7 +643,7 @@ class Batcher:
                 "dispatch", disp_span, "",
                 end_ns - int((time.perf_counter() - oldest) * 1e9), end_ns,
                 attributes={
-                    "batch.rows": sum(_payload_rows(e[0]) for e in batch),
+                    "batch.rows": sum(e.rows for e in batch),
                     "batch.requests": len(batch),
                     "batch.fused": fused,
                 },
@@ -386,8 +651,8 @@ class Batcher:
             )
         off = 0
         for e in batch:
-            payload, fut = e[0], e[1]
-            n = _payload_rows(payload)
+            payload, fut = e.payload, e.fut
+            n = e.rows
             sl = slice(off, off + n)
             if not fut.done():
                 fut.set_result(
@@ -400,6 +665,17 @@ class Batcher:
                     )
                 )
             off += n
+
+    def arm_overload(self, deadline_ms: float) -> None:
+        """(Re)arm or disarm the overload door at runtime. The scenario
+        harness (bench.py) warms XLA chunk shapes through the OPEN door and
+        only then arms it for the timed windows — a warm wave shed by the
+        armed door never dispatches, leaving its chunk shape uncompiled so
+        the compile lands inside a measured step disguised as queueing
+        latency. Per-entry deadlines are stamped at enqueue, so flipping
+        between windows never retro-affects queued items."""
+        self.overload_deadline_s = max(0.0, deadline_ms) / 1e3
+        self.armed = self.overload_deadline_s > 0
 
     def debug(self) -> dict:
         """Live front-door state for /v1/debug/pipeline (docs/observability.md):
@@ -426,6 +702,15 @@ class Batcher:
             "adaptive_closes": self.adaptive_closes,
             "window_expires": self.window_expires,
             "close_reasons": dict(self.close_reasons),
+            "overload_armed": self.armed,
+            "overload_deadline_ms": self.overload_deadline_s * 1e3,
+            "tenant_share": self.tenant_share,
+            "tenant_buckets": self.tenant_buckets,
+            "shed_rows": dict(self.shed_rows),
+            "shed_by_tier": list(self.shed_by_tier),
+            "admitted_by_tier": list(self.admitted_by_tier),
+            "priority_inversions": self.priority_inversions,
+            "drain_rate_rows_per_s": self._drain_rate,
             "closed": self._closed,
         }
 

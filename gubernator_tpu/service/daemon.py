@@ -185,6 +185,10 @@ class Daemon:
             close_bytes=conf.behaviors.batch_close_bytes,
             max_queue_rows=conf.behaviors.batch_queue_rows,
             ring=self.ring,
+            overload_deadline_ms=conf.behaviors.overload_deadline_ms,
+            tenant_share=conf.behaviors.overload_tenant_share,
+            tenant_buckets=conf.behaviors.overload_tenant_buckets,
+            shed_retry_ms=conf.behaviors.overload_retry_ms,
         )
         # front-door parse/encode pool: the native parser and response
         # encoder drop the GIL, so offloading big request buffers here lets

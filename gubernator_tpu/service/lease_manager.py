@@ -48,7 +48,13 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from gubernator_tpu.proto import gubernator_pb2 as pb
-from gubernator_tpu.types import Behavior
+from gubernator_tpu.types import (
+    Behavior,
+    PRIORITY_MASK,
+    PRIORITY_SHIFT,
+    PRIORITY_TIERS,
+    priority_tier,
+)
 
 import logging
 
@@ -59,11 +65,13 @@ log = logging.getLogger("gubernator_tpu.lease")
 LEDGER_SUFFIX = "\x00lease"
 
 # behavior bits a lease grant forwards into the decide path — the client's
-# routing/replication intent, never RESET/DRAIN (a grant must consume
-# honestly) and never Gregorian (lease windows are always milliseconds)
+# routing/replication intent plus its priority tier (so the front door's
+# overload plane sees leased consumption at the edge's tier), never
+# RESET/DRAIN (a grant must consume honestly) and never Gregorian (lease
+# windows are always milliseconds)
 _GRANT_BEHAVIOR = int(
     Behavior.NO_BATCHING | Behavior.GLOBAL | Behavior.MULTI_REGION
-)
+) | (PRIORITY_MASK << PRIORITY_SHIFT)
 
 
 @dataclass
@@ -85,6 +93,10 @@ class LeaseManager:
         self.min_ttl_ms = conf.lease_min_ttl_ms
         self.max_ttl_ms = conf.lease_max_ttl_ms
         self.max_outstanding = conf.lease_max_outstanding
+        # tier-aware sizing (GUBER_PRIORITY_LEASE_SCALING, default off):
+        # grants scale with the requester's priority tier and pressured
+        # keys push shrink hints at low-tier edges
+        self.priority_scaling = getattr(conf, "lease_priority_scaling", False)
         self.metrics = daemon.metrics
         self._leases: Dict[str, LeaseRecord] = {}
         self._by_key: Dict[str, int] = {}  # hash_key → Σ outstanding
@@ -98,6 +110,7 @@ class LeaseManager:
         self.denies = 0
         self.expirations = 0
         self.unknown_returns = 0
+        self.shrink_hints = 0
         self.tokens_granted = 0
         self.tokens_returned = 0
         self.tokens_expired = 0
@@ -259,6 +272,12 @@ class LeaseManager:
         error = ""
         if want > 0:
             want = min(want, self._cap(int(req.limit)))
+            if self.priority_scaling:
+                # tier-aware sizing: tier 3 keeps the full slice, each tier
+                # below loses a quarter (tier 0 → 25%) — high-priority
+                # edges find budget first when every edge is asking
+                tier = priority_tier(req.behavior)
+                want = max(1, (want * (tier + 1)) // PRIORITY_TIERS)
             lr = await self._check(self._ledger_item(req, want, ttl))
             if lr.error:
                 error = lr.error
@@ -337,6 +356,26 @@ class LeaseManager:
             self.denies += 1
             self.metrics.lease_ops.labels(op="deny").inc()
         self._observe()
+        # push-shrink hint: when the key is pressured (Σ outstanding ≥ 80%
+        # of the cap), ask lower-tier edges to cut their local grant ahead
+        # of the TTL so high-tier traffic finds budget; tier 3 never
+        # shrinks, and the hint is advisory (an edge that ignores it is
+        # still bounded by TTL reclamation)
+        shrink_to = 0
+        if (
+            self.priority_scaling
+            and rec is not None
+            and rec.outstanding > 0
+        ):
+            tier = priority_tier(req.behavior)
+            cap = self._cap(int(req.limit))
+            pressured = self._by_key.get(hash_key, 0) * 5 >= cap * 4
+            if pressured and tier < PRIORITY_TIERS - 1:
+                target = (rec.outstanding * (tier + 1)) // PRIORITY_TIERS
+                if target < rec.outstanding:
+                    shrink_to = max(1, target)
+                    self.shrink_hints += 1
+                    self.metrics.lease_ops.labels(op="shrink_hint").inc()
         return pb.LeaseQuotaResp(
             lease_id=rec.lease_id if rec is not None else "",
             granted=granted,
@@ -346,6 +385,7 @@ class LeaseManager:
             retry_after_ms=retry_after,
             outstanding=self._by_key.get(hash_key, 0),
             error=error,
+            shrink_to=shrink_to,
         )
 
     # -------------------------------------------------------- introspection
@@ -374,6 +414,7 @@ class LeaseManager:
                 "denies": self.denies,
                 "expirations": self.expirations,
                 "unknown_returns": self.unknown_returns,
+                "shrink_hints": self.shrink_hints,
             },
             "tokens": {
                 "granted": self.tokens_granted,
@@ -385,5 +426,6 @@ class LeaseManager:
                 "min_ttl_ms": self.min_ttl_ms,
                 "max_ttl_ms": self.max_ttl_ms,
                 "max_outstanding": self.max_outstanding,
+                "priority_scaling": self.priority_scaling,
             },
         }

@@ -329,6 +329,31 @@ class DaemonMetrics:
             "Items queued toward peers (forwarding)",
             registry=r,
         )
+        # --- overload plane (service/batcher.py shed policy;
+        # docs/robustness.md "Overload & QoS")
+        self.shed_total = Counter(
+            # renders as gubernator_tpu_shed_total
+            "gubernator_tpu_shed",
+            "Rate-limit rows shed by the front-door overload plane before "
+            "reaching the engine, by reason (queue_full = bounded ring had "
+            "no space the item could wait out, deadline = the item's "
+            "enqueue deadline passed or the queue-wait estimate exceeded "
+            "it, fairness = the item's tenant bucket was over its fair "
+            "share of the window, preempted = evicted from the queue by a "
+            "higher-priority arrival) and the item's priority tier "
+            "(0 = best-effort .. 3 = shed last)",
+            ["reason", "tier"],
+            registry=r,
+        )
+        self.queue_wait_seconds = Histogram(
+            "gubernator_tpu_queue_wait_seconds",
+            "Seconds each admitted front-door batch waited in the coalesce "
+            "queue before its dispatch began (per enqueued batch, not per "
+            "chunk — the p99 of this series is the queueing half of the "
+            "overload story; shed items never appear here)",
+            registry=r,
+            buckets=LATENCY_BUCKETS,
+        )
         self.batch_send_retries = Counter(
             "gubernator_batch_send_retries",
             "Forwarded requests re-sent after peer errors/ownership moves",

@@ -23,6 +23,7 @@ from gubernator_tpu.proto import globalsync_pb2 as globalsync_pb
 from gubernator_tpu.proto import gubernator_pb2 as pb
 from gubernator_tpu.proto import handoff_pb2 as handoff_pb
 from gubernator_tpu.proto import peers_pb2 as peers_pb
+from gubernator_tpu.service import deadline as deadline_mod
 from gubernator_tpu.service.breaker import CircuitBreaker
 from gubernator_tpu.types import Behavior, PeerInfo, has_behavior
 
@@ -258,6 +259,13 @@ class PeerClient:
         # legitimately behind several RPCs, not timed out.
         chunks_ahead = (len(self._queue) + self.batch_limit - 1) // self.batch_limit
         deadline = self.batch_wait_s + self.timeout_s * max(1, chunks_ahead) + 1.0
+        # ... but never past the caller's own remaining gRPC deadline: a
+        # deep queue can push the computed budget beyond what the inbound
+        # request has left, and waiting out the difference only burns a
+        # worker on an answer nobody is listening for
+        inbound = deadline_mod.remaining()
+        if inbound is not None:
+            deadline = min(deadline, max(inbound, 0.001))
         try:
             return await asyncio.wait_for(asyncio.shield(fut), timeout=deadline)
         except asyncio.TimeoutError:

@@ -17,6 +17,7 @@ from aiohttp import web
 from google.protobuf import json_format
 
 from gubernator_tpu import tracing
+from gubernator_tpu.service import deadline as deadline_mod
 from gubernator_tpu.proto import globalsync_pb2 as globalsync_pb
 from gubernator_tpu.proto import gubernator_pb2 as pb
 from gubernator_tpu.proto import handoff_pb2 as handoff_pb
@@ -68,6 +69,7 @@ def build_grpc_services(daemon):
     async def get_rate_limits(request: bytes, context):
         # raw wire bytes: the native ingress parses them straight into
         # columns (daemon.get_rate_limits_raw); pb fallback inside
+        deadline_mod.set_inbound_deadline(context.time_remaining())
         try:
             return await daemon.get_rate_limits_raw(request)
         except ValueError as exc:  # batch too large etc.
@@ -90,6 +92,7 @@ def build_grpc_services(daemon):
 
     @_timed(m, "/peers.GetPeerRateLimits")
     async def get_peer_rate_limits(request: peers_pb.GetPeerRateLimitsReq, context):
+        deadline_mod.set_inbound_deadline(context.time_remaining())
         return await daemon.get_peer_rate_limits(request)
 
     @_timed(m, "/peers.UpdatePeerGlobals")

@@ -23,6 +23,7 @@ from gubernator_tpu.ops.batch import (
 )
 from gubernator_tpu.proto import gubernator_pb2 as pb
 from gubernator_tpu.proto import peers_pb2 as peers_pb
+from gubernator_tpu import types
 from gubernator_tpu.types import Behavior
 
 # the reference rejects batches above this size outright (gubernator.go:41-42);
@@ -93,10 +94,11 @@ def columns_from_pb(
         hash_keys[i] = r.name + "_" + r.unique_key
         fp[i] = fingerprint(r.name, r.unique_key)
         algo[i] = r.algorithm
-        # client-facing flag bits only (native parser applies the same
-        # mask): the behavior word's high bits carry the INTERNAL cascade
-        # level, which must never arrive from the wire
-        behavior[i] = r.behavior & 63
+        # client-facing bits only — flag values 1..32 plus the 2-bit
+        # priority tier at bits 6-7 (native parser applies the same mask):
+        # the behavior word's high bits carry the INTERNAL cascade level,
+        # which must never arrive from the wire
+        behavior[i] = r.behavior & 255
         hits[i] = min(max(r.hits, -clip), clip)
         limit[i] = min(max(r.limit, -clip), clip)
         burst[i] = min(max(r.burst, -clip), clip)
@@ -515,7 +517,7 @@ def encode_response_columns(
 _SYNC_WIRE_BEHAVIOR = int(
     Behavior.NO_BATCHING | Behavior.GLOBAL | Behavior.RESET_REMAINING
     | Behavior.DRAIN_OVER_LIMIT
-)
+) | (types.PRIORITY_MASK << types.PRIORITY_SHIFT)
 
 
 def sync_wire_pb(
@@ -524,7 +526,7 @@ def sync_wire_pb(
     """Pack one owner's pending-hit batch into a SyncGlobalsWireReq, or
     None when any entry cannot ride the compact layout exactly (Gregorian /
     MULTI_REGION behaviors must not be dropped, created_at must be present
-    and within the ±2047 ms delta budget of the batch base, tracing
+    and within the ±511 ms delta budget of the batch base, tracing
     metadata has no compact lane). The receive half is sync_wire_items."""
     from gubernator_tpu.ops import wire as wire_mod
 
@@ -576,10 +578,12 @@ def sync_wire_pb(
         ).astype(np.int32)
         reset = 1 if it.behavior & int(Behavior.RESET_REMAINING) else 0
         drain = 1 if it.behavior & int(Behavior.DRAIN_OVER_LIMIT) else 0
+        prio = types.priority_tier(it.behavior)
         delta = (it.created_at - base + wire_mod.DELTA_BIAS)
         # lane hits stay 0: hits64 is authoritative on this codec
         lanes[4, i] = np.int64(
             ((delta & wire_mod._DELTA_MASK) << wire_mod.HITS_BITS)
+            | (prio << wire_mod.PRIO_SHIFT)
             | (reset << 30) | (drain << 31)
         ).astype(np.int32)
         hits64[i] = it.hits
@@ -609,7 +613,7 @@ def sync_wire_pb(
 
 _REGION_WIRE_BEHAVIOR = int(
     Behavior.NO_BATCHING | Behavior.MULTI_REGION | Behavior.DRAIN_OVER_LIMIT
-)
+) | (types.PRIORITY_MASK << types.PRIORITY_SHIFT)
 
 
 def region_wire_item_ok(it: "pb.RateLimitReq") -> bool:
@@ -642,7 +646,7 @@ def region_wire_item_ok(it: "pb.RateLimitReq") -> bool:
 def split_region_encodable(pairs):
     """Partition one region-bound batch into (encodable, fallback) pairs.
     The lane base is the first encodable item's created_at; items outside
-    its ±2047 ms delta budget spill to the fallback too."""
+    its ±511 ms delta budget spill to the fallback too."""
     from gubernator_tpu.ops import wire as wire_mod
 
     enc, fb = [], []
@@ -723,10 +727,12 @@ def sync_regions_pb(
             | (int(it.algorithm) << wire_mod.DUR_BITS)
         ).astype(np.int32)
         drain = 1 if it.behavior & int(Behavior.DRAIN_OVER_LIMIT) else 0
+        prio = types.priority_tier(it.behavior)
         delta = it.created_at - base + wire_mod.DELTA_BIAS
         # lane hits stay 0: the hits64 sidecar is authoritative
         lanes[4, i] = np.int64(
             ((delta & wire_mod._DELTA_MASK) << wire_mod.HITS_BITS)
+            | (prio << wire_mod.PRIO_SHIFT)
             | (drain << 31)
         ).astype(np.int32)
         hits64[i] = it.hits

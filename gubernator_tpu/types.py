@@ -60,6 +60,35 @@ def with_cascade_level(behavior: int, level: int) -> int:
     )
 
 
+# ---- priority tiers (docs/robustness.md "Overload & QoS").
+# A 2-bit priority field rides the behavior word's bits 6-7 — the two
+# client-facing flag bits the frozen reference enum (values 1..32) leaves
+# free below the internal cascade-level field (bits 8-15). Tier 0 is the
+# default (best-effort); higher tiers are shed LAST under overload
+# (service/batcher.py shed policy) and sized first by the lease plane.
+# Like cascade levels, the field survives every packed-ingress layout:
+# the compact wire carries it in dedicated lane bits (ops/wire.py) and the
+# kernel echoes it in the egress flags, so a decision's tier is visible to
+# the batcher and the metrics plane without any host-side side table.
+PRIORITY_SHIFT = 6
+PRIORITY_MASK = 0x3
+PRIORITY_TIERS = 4  # tiers 0..3; 3 = most important, shed last
+
+
+def priority_tier(behavior: int) -> int:
+    """The priority tier encoded in a behavior word (0 = best-effort)."""
+    return (int(behavior) >> PRIORITY_SHIFT) & PRIORITY_MASK
+
+
+def with_priority(behavior: int, tier: int) -> int:
+    """Behavior word with the priority tier field set."""
+    if not (0 <= tier <= PRIORITY_MASK):
+        raise ValueError(f"priority tier {tier} out of range")
+    return (int(behavior) & ~(PRIORITY_MASK << PRIORITY_SHIFT)) | (
+        tier << PRIORITY_SHIFT
+    )
+
+
 class Behavior(enum.IntFlag):
     """Bitflag behaviors (reference gubernator.proto:71-142).
 

@@ -398,7 +398,7 @@ def test_sync_wire_codec_fallbacks(frozen_now):
         if bad is None:
             continue
         assert sync_wire_pb([("g_k", bad)], "s") is None, label
-    # created_at skew beyond the ±2047 ms delta budget of the batch base
+    # created_at skew beyond the ±511 ms delta budget of the batch base
     far = item(created_at=t + 5_000)
     assert sync_wire_pb([("g_k", ok), ("g_k2", far)], "s") is None
     # metadata (trace propagation) has no compact lane

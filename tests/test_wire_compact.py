@@ -81,8 +81,8 @@ def test_codec_roundtrip_exact():
     math-visible bits; leaky burst reconstructs as limit, token as 0)."""
     rng = np.random.default_rng(3)
     cols = mk_cols(64, rng)
-    cols.created_at[5] = NOW - 2048  # delta floor
-    cols.created_at[6] = NOW + 2047  # delta ceiling
+    cols.created_at[5] = NOW - 512  # delta floor
+    cols.created_at[6] = NOW + 511  # delta ceiling
     hb, err = pack_columns(cols, NOW)
     assert not err.any()
     base = wire.pick_base(hb)
@@ -104,9 +104,9 @@ def test_encodable_rejections():
 
     base = NOW
     assert wire.wire_encodable(hb_of(), base)
-    # created_at outside the ±2048 ms delta window
-    assert not wire.wire_encodable(hb_of(created_at=NOW + 2048), base)
-    assert not wire.wire_encodable(hb_of(created_at=NOW - 2049), base)
+    # created_at outside the ±512 ms delta window
+    assert not wire.wire_encodable(hb_of(created_at=NOW + 512), base)
+    assert not wire.wire_encodable(hb_of(created_at=NOW - 513), base)
     # hits beyond 18 bits
     hb = hb_of()
     hb.hits[0] = 1 << 18
