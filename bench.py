@@ -1116,6 +1116,80 @@ def dispatch_case(rng, now) -> dict:
     out["accept_ring_le_direct"] = (
         bool(rv is not None and rv <= 1.0) if on_tpu else None)
 
+    # ------------------- part 1b: fused drain K-sweep (the launch tax)
+    # The kill-the-launch-tax record: at the smallest (most launch-bound)
+    # batch size, 32 concurrent submitters through the fused multi-slot
+    # drain (ops/ring_drain.py) at K ∈ {1,2,4,8} vs the host issue loop.
+    # Per mode: launches per retired slot (the amortization factor) and
+    # the submit p50/p99. The persistent tier (GUBER_RING_ISSUE=
+    # persistent) is staged — interpreter-parity-tested, priced on the
+    # next TPU run.
+    async def fused_sweep():
+        n, label = sizes[0]
+        parsed = wire_batch_from_wire(corpus(n, "fk"))
+        if parsed is None:
+            return {"error": "native parser unavailable"}
+        parts = [parsed[0]]
+        SUBMITS = 32
+
+        async def timed(ring, lat):
+            t0 = time.perf_counter()
+            await ring.submit(parts)
+            lat.append(time.perf_counter() - t0)
+
+        def xla_launches(dbg, mode):
+            return (dbg["drain_launches"] + dbg["host_slots"]
+                    if mode == "fused" else dbg["launches"])
+
+        async def drive(mode, k):
+            ring = RequestRing(
+                runner, slots=8, issue_mode=mode, drain_k=k)
+            await asyncio.gather(*(
+                timed(ring, []) for _ in range(8)))  # trace + warm
+            d0 = xla_launches(ring.debug(), mode)
+            lat: list = []
+            t0 = time.perf_counter()
+            await asyncio.gather(*(
+                timed(ring, lat) for _ in range(SUBMITS)))
+            wall = time.perf_counter() - t0
+            launches = xla_launches(ring.debug(), mode) - d0
+            await ring.drain()
+            return {
+                "rows": n,
+                "serving_dispatch_ms": round(wall / SUBMITS * 1e3, 3),
+                "submit_p50_ms": round(
+                    float(np.percentile(lat, 50)) * 1e3, 3),
+                "submit_p99_ms": round(
+                    float(np.percentile(lat, 99)) * 1e3, 3),
+                "launches": launches,
+                "launches_per_slot": round(launches / SUBMITS, 4),
+            }
+
+        res = {"host": await drive("host", 8)}
+        for k in (1, 2, 4, 8):
+            res[f"fused_k{k}"] = await drive("fused", k)
+            log(f"[dispatch] fused K={k}: "
+                f"{res[f'fused_k{k}']['launches']} launches/"
+                f"{SUBMITS} slots, p99 "
+                f"{res[f'fused_k{k}']['submit_p99_ms']} ms (host p99 "
+                f"{res['host']['submit_p99_ms']} ms)")
+        res["persistent"] = (
+            "staged: interpreter-mode fence parity green "
+            "(tests/test_ring_drain.py); awaits device run"
+        )
+        return res
+
+    out["fused_drain"] = asyncio.run(fused_sweep())
+    fd = out["fused_drain"]
+    if "error" not in fd:
+        # acceptance: launches/decision reduced ≥4× at K=8, p99 no worse
+        # than the host issue loop (10% CI-noise allowance)
+        out["accept_drain_amortize_4x"] = bool(
+            fd["host"]["launches"] >= 4 * fd["fused_k8"]["launches"]
+            and fd["fused_k8"]["submit_p99_ms"]
+            <= fd["host"]["submit_p99_ms"] * 1.1
+        )
+
     # -------------------------- part 2: fused vs two-pass install/merge
     LIVE = (1 << 20) if on_tpu else (1 << 14)
     BATCH = (1 << 17) if on_tpu else (1 << 10)

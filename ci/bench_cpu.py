@@ -600,6 +600,148 @@ def ring_smoke() -> dict:
     return out
 
 
+def ring_drain_smoke() -> dict:
+    """Fused multi-slot drain regression gate (kill-the-launch-tax PR,
+    ops/ring_drain.py — the jitted while_loop consumer behind
+    GUBER_RING_ISSUE=fused):
+
+    (a) **byte parity at ~1M keys** — a fused-drain daemon must serve
+        byte-identical responses to a direct-dispatch daemon over a
+        distinct-key corpus of 64×16384 = 1 048 576 keys (the fused graph
+        walks the same decide2_wire_cols per slot, in ticket order — any
+        divergence is a drain-protocol bug: misgrouped slots, stale bank
+        rows, fence skew);
+    (b) **launches/decision strictly decreasing in K** — the whole point
+        of the PR: over the same concurrent corpus, raising
+        GUBER_RING_DRAIN_K must strictly reduce drain launches (K=1 is
+        one-launch-per-slot; K=8 retires groups);
+    (c) **zero-loss drain** — drain() racing live fused launches strands
+        nothing: every submitter resolves, published == consumed,
+        occupancy 0.
+    """
+    import asyncio
+
+    from gubernator_tpu.config import BehaviorConfig, DaemonConfig
+    from gubernator_tpu.service.daemon import Daemon
+
+    os.environ["GUBER_WIRE_COMPACT"] = "1"  # fused path needs compact wire
+    now = int(time.time() * 1000)
+
+    def corpus(reqs: int, rows: int, tag: str):
+        from gubernator_tpu.proto import gubernator_pb2 as pb
+
+        return [
+            pb.GetRateLimitsReq(
+                requests=[
+                    pb.RateLimitReq(
+                        name="drain", unique_key=f"{tag}r{r}i{i}", hits=1,
+                        limit=1 << 20, duration=3_600_000, created_at=now,
+                    )
+                    for i in range(rows)
+                ]
+            ).SerializeToString()
+            for r in range(reqs)
+        ]
+
+    def conf(**beh) -> DaemonConfig:
+        beh.setdefault("batch_wait_ms", 1.0)
+        beh.setdefault("front_workers", 8)
+        return DaemonConfig(
+            grpc_address="127.0.0.1:0", http_address="",
+            cache_size=1 << 21, max_batch_size=4096,
+            behaviors=BehaviorConfig(**beh),
+        )
+
+    async def drive(d: Daemon, datas):
+        t0 = time.perf_counter()
+        rs = await asyncio.gather(*(d.get_rate_limits_raw(x) for x in datas))
+        return time.perf_counter() - t0, rs
+
+    async def parity():
+        out: dict = {}
+        # one 4096-row request per ring slot: ~1M distinct keys total
+        df = await Daemon.spawn(conf(
+            ring_enable=True, ring_issue="fused", ring_slots=8,
+            ring_drain_k=8, coalesce_limit=4096,
+        ))
+        dd = await Daemon.spawn(conf(coalesce_limit=4096))
+        await drive(df, corpus(4, 4096, "w"))  # shape warm
+        await drive(dd, corpus(4, 4096, "w"))
+        datas = corpus(256, 4096, "m")
+        t_fused, r1 = await drive(df, datas)
+        t_direct, r2 = await drive(dd, datas)
+        dbg = df.ring.debug()
+        out["identical"] = r1 == r2
+        out["keys"] = 256 * 4096
+        out["drain_launches"] = dbg["drain_launches"]
+        out["drained_slots"] = dbg["drained_slots"]
+        out["host_slots"] = dbg["host_slots"]
+        out["serve_s_fused"] = round(t_fused, 4)
+        out["serve_s_direct"] = round(t_direct, 4)
+        await df.close()
+        await dd.close()
+        return out
+
+    async def k_sweep():
+        # same concurrent corpus per K: drain launches must strictly fall
+        launches = {}
+        for k in (1, 2, 8):
+            d = await Daemon.spawn(conf(
+                ring_enable=True, ring_issue="fused", ring_slots=8,
+                ring_drain_k=k, coalesce_limit=64,
+            ))
+            await drive(d, corpus(8, 64, f"w{k}"))  # shape warm
+            await drive(d, corpus(64, 64, f"s{k}"))
+            dbg = d.ring.debug()
+            launches[k] = dbg["drain_launches"] + dbg["host_slots"]
+            await d.close()
+        return launches
+
+    async def zero_loss():
+        d = await Daemon.spawn(conf(
+            ring_enable=True, ring_issue="fused", ring_slots=4,
+            ring_drain_k=4, coalesce_limit=64,
+        ))
+        pending = [
+            asyncio.create_task(d.get_rate_limits_raw(x))
+            for x in corpus(32, 64, "z")
+        ]
+        await asyncio.sleep(0.02)  # fused launches in flight
+        await d.ring.drain()
+        outs = await asyncio.gather(*pending)
+        dbg = d.ring.debug()
+        await d.close()
+        return (
+            all(isinstance(o, bytes) for o in outs)
+            and dbg["closed"] and dbg["occupancy"] == 0
+            and dbg["published"] == dbg["consumed"]
+        )
+
+    out = asyncio.run(parity())
+    out["launches_by_k"] = asyncio.run(k_sweep())
+    out["drain_zero_loss"] = asyncio.run(zero_loss())
+    if not out["identical"]:
+        print(json.dumps({"error": "ring drain smoke: fused-drain "
+                          "responses diverge from the direct path at 1M "
+                          "keys", **out}))
+        sys.exit(1)
+    if out["drain_launches"] == 0 or out["drained_slots"] == 0:
+        print(json.dumps({"error": "ring drain smoke: fused drain never "
+                          "engaged", **out}))
+        sys.exit(1)
+    lk = out["launches_by_k"]
+    if not (lk[1] > lk[2] > lk[8]):
+        print(json.dumps({"error": "ring drain smoke: launches/decision "
+                          "not strictly decreasing in K — the drain is "
+                          "not amortizing the launch tax", **out}))
+        sys.exit(1)
+    if not out["drain_zero_loss"]:
+        print(json.dumps({"error": "ring drain smoke: drain through live "
+                          "fused launches lost or stranded work", **out}))
+        sys.exit(1)
+    return out
+
+
 def telemetry_smoke() -> dict:
     """Table-telemetry regression gate (observability PR) at a 1M-key
     population:
@@ -2252,6 +2394,7 @@ def main() -> None:
         "lease_smoke": lease_smoke(),
         "tier_smoke": tier_smoke(),
         "ring_smoke": ring_smoke(),
+        "ring_drain_smoke": ring_drain_smoke(),
         "overload_smoke": overload_smoke(),
     }))
 

@@ -156,6 +156,13 @@ class BehaviorConfig:
     # dispatch order becomes tier-major. 0 (default) = disarmed: the legacy
     # unbounded-backpressure door
     overload_deadline_ms: float = 0.0
+    # derive the enqueue deadline from MEASURED dispatch speed instead of a
+    # hard-coded guess: GUBER_OVERLOAD_DEADLINE_MS=auto arms the overload
+    # plane with deadline = max(overload_retry_ms,
+    # OVERLOAD_AUTO_DEADLINE_MULT × EWMA of stage_duration{stage="issue"}),
+    # re-evaluated per enqueue — one knob that tracks real device speed on
+    # both backends (docs/robustness.md "Overload & QoS")
+    overload_deadline_auto: bool = False
     # fair admission: one tenant (key-fingerprint bucket) may hold at most
     # this fraction of the bounded ring once the queue is ≥ half full;
     # excess rows from that tenant shed with reason="fairness"
@@ -175,6 +182,20 @@ class BehaviorConfig:
     # ring depth in slots: submits past this many published-but-unconsumed
     # batches wait (bounded backpressure, no drops, FIFO order)
     ring_slots: int = 64
+    # consume tier (docs/latency.md "Launch budget"): "auto" resolves per
+    # backend (fused on TPU, host on CPU); "host" = one XLA launch per
+    # published slot; "fused" = ONE jitted while_loop launch drains up to
+    # ring_drain_k published slots (ops/ring_drain.py); "persistent" =
+    # staged Pallas fence-claim tier (runs the fused drain with a watchdog
+    # until the device run validates the resident loop)
+    ring_issue: str = "auto"
+    # max published slots one fused drain launch retires (the launch-
+    # amortization factor; clamped to ring_slots)
+    ring_drain_k: int = 8
+    # fixed device slot width in rows for the fused tiers; chunks wider
+    # than this ride the per-slot host path. 0 = auto-size to the first
+    # fused chunk's padded dispatch size
+    ring_slot_width: int = 0
     # warm-up breadth: "" compiles only the 1-row shapes (fast spawn);
     # "pow2" additionally compiles every pow2 coalesce shape up to
     # coalesce_limit (token graph), "pow2-mixed" both math graphs — without
@@ -603,6 +624,23 @@ class DaemonConfig:
                 "GUBER_RING_SLOTS must be >= 2 (a 1-slot ring serializes "
                 "staging against consumption — no overlap to buy)"
             )
+        if self.behaviors.ring_issue not in (
+            "auto", "host", "fused", "persistent"
+        ):
+            raise ConfigError(
+                "GUBER_RING_ISSUE must be auto, host, fused or persistent, "
+                f"got {self.behaviors.ring_issue!r}"
+            )
+        if self.behaviors.ring_drain_k < 1:
+            raise ConfigError(
+                "GUBER_RING_DRAIN_K must be >= 1 (published slots one "
+                "fused drain launch may retire)"
+            )
+        if self.behaviors.ring_slot_width < 0:
+            raise ConfigError(
+                "GUBER_RING_SLOT_WIDTH must be >= 0 (0 = auto-size to the "
+                "first fused chunk)"
+            )
         if self.behaviors.peer_breaker_errors <= 0:
             raise ConfigError("GUBER_PEER_BREAKER_ERRORS must be >= 1")
         if self.behaviors.peer_breaker_probes <= 0:
@@ -750,8 +788,18 @@ def setup_daemon_config(
                 env, "GUBER_BATCH_CLOSE_BYTES", 1 << 20
             ),
             batch_queue_rows=_get_int(env, "GUBER_BATCH_QUEUE_ROWS", 0),
-            overload_deadline_ms=_get_float_ms(
-                env, "GUBER_OVERLOAD_DEADLINE_MS", 0.0
+            # GUBER_OVERLOAD_DEADLINE_MS=auto arms the plane with the
+            # measured-dispatch-speed deadline (service/batcher.py derives
+            # it from the issue-stage EWMA) instead of a fixed number
+            overload_deadline_ms=(
+                0.0
+                if _get(env, "GUBER_OVERLOAD_DEADLINE_MS", "")
+                .strip().lower() == "auto"
+                else _get_float_ms(env, "GUBER_OVERLOAD_DEADLINE_MS", 0.0)
+            ),
+            overload_deadline_auto=(
+                _get(env, "GUBER_OVERLOAD_DEADLINE_MS", "")
+                .strip().lower() == "auto"
             ),
             overload_tenant_share=_get_fraction(
                 env, "GUBER_OVERLOAD_TENANT_SHARE", 0.5
@@ -762,6 +810,9 @@ def setup_daemon_config(
             overload_retry_ms=_get_int(env, "GUBER_OVERLOAD_RETRY_MS", 25),
             ring_enable=_get_bool(env, "GUBER_RING_ENABLE", False),
             ring_slots=_get_int(env, "GUBER_RING_SLOTS", 64),
+            ring_issue=_get(env, "GUBER_RING_ISSUE", "auto"),
+            ring_drain_k=_get_int(env, "GUBER_RING_DRAIN_K", 8),
+            ring_slot_width=_get_int(env, "GUBER_RING_SLOT_WIDTH", 0),
             warm_shapes=_get(env, "GUBER_WARM_SHAPES", ""),
             global_timeout_ms=_get_float_ms(env, "GUBER_GLOBAL_TIMEOUT", 500.0),
             global_sync_wait_ms=_get_float_ms(env, "GUBER_GLOBAL_SYNC_WAIT", 100.0),

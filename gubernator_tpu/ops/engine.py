@@ -612,16 +612,16 @@ def _padded_rows(batch) -> int:
     return batch.rows
 
 
-def prepare_check_wire(engine, parts, now_ms=None) -> "PendingCheck | None":
-    """Fused front-door preparation: pre-packed native wire lanes
-    (service/wire.WireBatch pieces) are scattered into ONE staged compact
-    ingress grid — the request bytes were traversed once by the parser and
-    this scatter is the only further touch. Returns a PendingCheck for the
-    standard issue/finish halves, or None when the batch needs the general
-    columns path (engine not wire-capable, non-encodable rows, duplicate
-    fingerprints, created_at skew beyond the ±511 ms delta budget, Store
-    attached) — the fallback is semantically identical, it just pays the
-    full pack."""
+def _assemble_wire_parts(engine, parts, now_ms=None, pad_to=None):
+    """Shared gating + single-scatter grid assembly of the fused wire
+    paths (direct front door and ring slots): pre-packed native lanes are
+    scattered into ONE padded compact ingress grid. Returns None when the
+    batch needs the general columns path (engine not wire-capable,
+    non-encodable rows, duplicate fingerprints, created_at skew beyond the
+    ±511 ms delta budget, Store attached, or rows exceeding `pad_to`),
+    else (grid, cols_list, err, now, n, act_fp, clamped, casc, tol, pad).
+    `pad_to` fixes the padded width (the ring's static slot shape); the
+    default pads to the bucketed dispatch size."""
     if not getattr(engine, "supports_wire_ingress", False):
         return None
     if engine.store is not None or not engine.supports_pipeline:
@@ -630,7 +630,7 @@ def prepare_check_wire(engine, parts, now_ms=None) -> "PendingCheck | None":
         return None
     cols_list = [p.cols for p in parts]
     n = sum(c.fp.shape[0] for c in cols_list)
-    if n == 0:
+    if n == 0 or (pad_to is not None and n > pad_to):
         return None
     one = len(cols_list) == 1
     fp = cols_list[0].fp if one else np.concatenate([c.fp for c in cols_list])
@@ -670,7 +670,7 @@ def prepare_check_wire(engine, parts, now_ms=None) -> "PendingCheck | None":
         | (delta[active] > wire_mod.DELTA_BIAS - 1)
     ).any():
         return None
-    pad = _pad_size(n)
+    pad = pad_to if pad_to is not None else _pad_size(n)
     grid = wire_mod.assemble_wire_grid(
         [p.lanes for p in parts], clipped, base, pad, active
     )
@@ -679,9 +679,13 @@ def prepare_check_wire(engine, parts, now_ms=None) -> "PendingCheck | None":
     # directly — the unique-fp contract above makes them single-pass, so
     # the in-trace fold is always sound here
     casc = wire_mod.grid_has_cascade(grid, n)
-    staged = engine.stage_wire(
-        grid, wire_mod.grid_math_mode(grid, n), cascade=casc
-    )
+    return grid, cols_list, err, now, n, act_fp, clamped, casc, tol, pad
+
+
+def _wire_pending(engine, assembled, staged):
+    """PendingCheck over one assembled wire grid (direct or ring slot) —
+    the object both finish halves consume unchanged."""
+    _grid, cols_list, err, now, n, act_fp, clamped, casc, tol, pad = assembled
     lazy = _LazyWireBatch(cols_list, now, tol, pad)
     p = Pass(rows=np.arange(n), batch=lazy, member_rows=[])
     return PendingCheck(
@@ -689,6 +693,64 @@ def prepare_check_wire(engine, parts, now_ms=None) -> "PendingCheck | None":
         clamped=clamped, rows=n, mark=act_fp, casc=casc, casc_intrace=casc,
         promote=shadow_probe(engine, act_fp, now),
     )
+
+
+def prepare_check_wire(engine, parts, now_ms=None) -> "PendingCheck | None":
+    """Fused front-door preparation: pre-packed native wire lanes
+    (service/wire.WireBatch pieces) are scattered into ONE staged compact
+    ingress grid — the request bytes were traversed once by the parser and
+    this scatter is the only further touch. Returns a PendingCheck for the
+    standard issue/finish halves, or None when the batch needs the general
+    columns path — the fallback is semantically identical, it just pays
+    the full pack."""
+    assembled = _assemble_wire_parts(engine, parts, now_ms=now_ms)
+    if assembled is None:
+        return None
+    from gubernator_tpu.ops import wire as wire_mod
+
+    grid, n = assembled[0], assembled[4]
+    staged = engine.stage_wire(
+        grid, wire_mod.grid_math_mode(grid, n), cascade=assembled[7]
+    )
+    return _wire_pending(engine, assembled, staged)
+
+
+class RingSlotPrep:
+    """One ring slot's prepared dispatch (prep pool, no engine state): the
+    assembled HOST-side wire grid padded to the ring's FIXED slot width —
+    the device slot buffer's static shape — plus the PendingCheck the
+    standard finish half consumes once the fused drain's egress bank is
+    fetched. The grid is staged into the device ring by the engine thread
+    (ops/ring_drain.DeviceRing.stage, serialized with the drain launches),
+    never device_put here; `math`/`cascade` are the static dispatch modes
+    the ring groups consecutive slots by."""
+
+    __slots__ = ("grid", "math", "cascade", "pending")
+
+    def __init__(self, grid, math, cascade, pending):
+        self.grid = grid
+        self.math = math
+        self.cascade = cascade
+        self.pending = pending
+
+
+def prepare_ring_slot(
+    engine, parts, width: int, now_ms=None
+) -> "RingSlotPrep | None":
+    """Ring-slot variant of prepare_check_wire: same gating, same grid
+    assembly, but padded to the ring's fixed `width`. None routes the
+    chunk to the host per-slot path (which pays a launch but is
+    byte-identical) — including chunks wider than the slot."""
+    assembled = _assemble_wire_parts(engine, parts, now_ms=now_ms,
+                                     pad_to=width)
+    if assembled is None:
+        return None
+    from gubernator_tpu.ops import wire as wire_mod
+
+    grid, n, casc = assembled[0], assembled[4], assembled[7]
+    pending = _wire_pending(engine, assembled, None)
+    return RingSlotPrep(grid, wire_mod.grid_math_mode(grid, n), casc,
+                        pending)
 
 
 def prepare_check_columns(engine, cols, now_ms=None) -> PendingCheck:

@@ -25,17 +25,21 @@ Three serving-plane mechanics live here (docs/latency.md "Serving plane"):
   batching latency). `batch_wait_ms` remains the hard ceiling.
 
 * **Overload plane** (docs/robustness.md "Overload & QoS"). Armed by
-  `GUBER_OVERLOAD_DEADLINE_MS` (or an inbound gRPC deadline), each enqueue
-  carries a deadline and a priority tier (types.PRIORITY_SHIFT behavior
-  bits). A full ring or a hopeless queue-wait estimate sheds the LOWEST
-  tier first with a fast per-item OVER_LIMIT-style overload row
-  (ops/batch.ERR_OVERLOAD) instead of queueing work whose answer nobody
-  will wait for; a higher-tier arrival preempts queued lower-tier entries
-  rather than being shed itself, which makes priority inversions zero by
-  construction. Per-tenant fair admission (fingerprint buckets) caps any
-  one tenant at its share of the window once the queue is under pressure.
-  With the knob unset and no inbound deadline, behavior is exactly the
-  legacy unbounded backpressure.
+  `GUBER_OVERLOAD_DEADLINE_MS` (a ms value, or `auto` to derive the
+  deadline from the engine's issue-stage EWMA — OVERLOAD_AUTO_DEADLINE_MULT
+  below) or an inbound gRPC deadline; each enqueue carries a deadline and a
+  priority tier (types.PRIORITY_SHIFT behavior bits). A full ring or a
+  hopeless queue-wait estimate sheds the LOWEST tier first with a fast
+  per-item OVER_LIMIT-style overload row (ops/batch.ERR_OVERLOAD) instead
+  of queueing work whose answer nobody will wait for; a higher-tier arrival
+  preempts queued lower-tier entries rather than being shed itself, which
+  makes priority inversions zero by construction. Per-tenant fair admission
+  (fingerprint buckets) caps any one tenant at its share of the window once
+  the queue is under pressure. The admission estimate and fairness shares
+  are COST-weighted (_payload_cost: cascade levels and lease rows dispatch
+  more device work per row), so an expensive tenant cannot starve cheap
+  traffic by staying under a raw row budget. With the knob unset and no
+  inbound deadline, behavior is exactly the legacy unbounded backpressure.
 
 NO_BATCHING items bypass the window (reference peer_client.go:126-162's fast
 path) by calling the runner directly.
@@ -59,11 +63,26 @@ from gubernator_tpu.ops.batch import (
 from gubernator_tpu.ops.engine import ms_now
 from gubernator_tpu.service import deadline as deadline_mod
 from gubernator_tpu.service.wire import WireBatch, concat_columns
-from gubernator_tpu.types import PRIORITY_MASK, PRIORITY_SHIFT
+from gubernator_tpu.types import (
+    CASCADE_LEVEL_MASK,
+    CASCADE_LEVEL_SHIFT,
+    PRIORITY_MASK,
+    PRIORITY_SHIFT,
+    Algorithm,
+)
 
 # device batches coalesce far beyond the reference's 1000-item RPC cap — the
 # kernel's throughput comes from large batches; this caps one dispatch.
 DEFAULT_COALESCE_LIMIT = 16384
+
+# GUBER_OVERLOAD_DEADLINE_MS=auto: the per-item deadline is this multiple of
+# the engine's issue-stage EWMA (runner.issue_ewma, the device-launch half of
+# a dispatch), floored at shed_retry_ms. 200 launches of queue-wait headroom
+# ≈ tens of ms on CPU loopback / low ms on TPU — deep enough that the door
+# only closes under genuine backlog, shallow enough that a doomed caller gets
+# its overload verdict while a retry is still useful (docs/robustness.md
+# "Overload & QoS").
+OVERLOAD_AUTO_DEADLINE_MULT = 200
 
 
 def _payload_rows(payload) -> int:
@@ -88,6 +107,24 @@ def _payload_tier(payload) -> int:
     return int(((beh.astype(np.int64) >> PRIORITY_SHIFT) & PRIORITY_MASK).max())
 
 
+def _payload_cost(payload) -> int:
+    """The enqueue's dispatch cost in row-equivalents: 1 per row, plus the
+    row's cascade depth (every extra level walks its own kernel row) and a
+    +1 surcharge for concurrency-lease rows (lease acquire/renew carries
+    install/reclaim work a plain bucket row doesn't). The overload door's
+    admission estimate and fairness shares are denominated in this, not raw
+    row count — a cascade-heavy tenant consumes its share proportionally to
+    the device work it dispatches. For plain single-level traffic cost ==
+    rows, so uniform workloads see exactly the legacy row-weighted door."""
+    cols = _payload_cols(payload)
+    if cols.fp.shape[0] == 0:
+        return 0
+    beh = cols.behavior.astype(np.int64)
+    casc = (beh >> CASCADE_LEVEL_SHIFT) & CASCADE_LEVEL_MASK
+    lease = (cols.algo == int(Algorithm.CONCURRENCY_LEASE)).astype(np.int64)
+    return int((1 + casc + lease).sum())
+
+
 def _payload_bucket(payload, buckets: int) -> int:
     """The enqueue's tenant bucket: its first row's fingerprint folded into
     `buckets` — key fingerprints are name+key hashes, so a tenant's
@@ -101,16 +138,17 @@ def _payload_bucket(payload, buckets: int) -> int:
 class _Entry:
     """One enqueued batch awaiting dispatch."""
 
-    __slots__ = ("payload", "fut", "t_enq", "span", "rows", "tier", "bucket",
-                 "deadline")
+    __slots__ = ("payload", "fut", "t_enq", "span", "rows", "cost", "tier",
+                 "bucket", "deadline")
 
-    def __init__(self, payload, fut, t_enq, span, rows, tier, bucket,
+    def __init__(self, payload, fut, t_enq, span, rows, cost, tier, bucket,
                  deadline):
         self.payload = payload
         self.fut = fut
         self.t_enq = t_enq  # perf_counter at enqueue
         self.span = span
         self.rows = rows
+        self.cost = cost  # row-equivalents (_payload_cost)
         self.tier = tier  # 0 (best-effort) .. 3 (shed last)
         self.bucket = bucket  # tenant fingerprint bucket
         self.deadline = deadline  # absolute monotonic instant, or None
@@ -141,6 +179,7 @@ class Batcher:
         max_queue_rows: int = 0,
         ring=None,
         overload_deadline_ms: float = 0.0,
+        overload_deadline_auto: bool = False,
         tenant_share: float = 0.5,
         tenant_buckets: int = 64,
         shed_retry_ms: int = 25,
@@ -170,7 +209,12 @@ class Batcher:
         # per-item deadline; 0 disarms everything but inbound-gRPC-deadline
         # bounding (legacy unbounded backpressure otherwise)
         self.overload_deadline_s = max(0.0, overload_deadline_ms) / 1e3
-        self.armed = self.overload_deadline_s > 0
+        # auto mode (GUBER_OVERLOAD_DEADLINE_MS=auto): armed with a deadline
+        # derived per enqueue from the runner's issue-stage EWMA
+        # (OVERLOAD_AUTO_DEADLINE_MULT × issue_ewma, floored at
+        # shed_retry_ms) — self-tuning to what a launch costs here
+        self.overload_deadline_auto = bool(overload_deadline_auto)
+        self.armed = self.overload_deadline_s > 0 or self.overload_deadline_auto
         self.tenant_share = tenant_share
         # fairness bucket count, forced to a power of two (fp & (n-1) fold)
         tb = max(1, tenant_buckets)
@@ -183,14 +227,17 @@ class Batcher:
         # parent-child causality; OTLP links restore it —
         # docs/observability.md).
         self._pending: Deque[_Entry] = deque()
-        self._bucket_rows: dict = {}  # tenant bucket → queued rows
-        # EWMA of the drain rate (rows/s over dispatch completions) — the
-        # queue-wait estimate `pending_rows / rate` that sheds doomed
-        # enqueues up front instead of letting them expire in the queue
+        self._bucket_cost: dict = {}  # tenant bucket → queued cost units
+        # EWMA of the drain rate (cost units/s over dispatch completions) —
+        # the queue-wait estimate `pending_cost / rate` that sheds doomed
+        # enqueues up front instead of letting them expire in the queue.
+        # Cost units (_payload_cost), NOT raw rows: a cascade row drains
+        # slower than a plain row, and the estimate must know that.
         self._drain_rate = 0.0
         self._drain_t = 0.0
-        self._drain_rows = 0
+        self._drain_cost = 0
         self._pending_rows = 0
+        self._pending_cost = 0
         self._pending_bytes = 0
         self._wake: Optional[asyncio.Event] = None
         self._full: Optional[asyncio.Event] = None  # adaptive early close
@@ -247,30 +294,35 @@ class Batcher:
             self._full = asyncio.Event()
             self._space = asyncio.Event()
         tier = _payload_tier(payload)
+        cost = _payload_cost(payload)
         bucket = _payload_bucket(payload, self.tenant_buckets)
         deadline = self._item_deadline()
         entry = _Entry(
             payload, loop.create_future(), time.perf_counter(),
-            tracing.current_span(), rows, tier, bucket, deadline,
+            tracing.current_span(), rows, cost, tier, bucket, deadline,
         )
         # per-tenant fair admission: once the queue is under pressure
         # (≥ half full), no tenant bucket may hold more than its share of
         # the window — one abusive tenant saturating the ring cannot starve
-        # the rest (armed mode only)
+        # the rest (armed mode only). Shares are COST units against the
+        # row-denominated window: a cascade-heavy tenant exhausts its share
+        # in proportion to the device work it dispatches, so it cannot
+        # starve cheap single-row traffic by staying under a raw row count.
         if (
             self.armed
-            and self._pending_rows * 2 >= self.max_queue_rows
-            and self._bucket_rows.get(bucket, 0) + rows
+            and self._pending_cost * 2 >= self.max_queue_rows
+            and self._bucket_cost.get(bucket, 0) + cost
             > self.tenant_share * self.max_queue_rows
         ):
             return self._shed(entry, "fairness")
         # queue-wait estimate: work that cannot be served before its
         # deadline is answered NOW, not after expiring in the queue
+        # (cost units over a cost-unit drain rate)
         if deadline is not None:
             remain = deadline - time.monotonic()
             if remain <= 0 or (
                 self._drain_rate > 0
-                and self._pending_rows / self._drain_rate > remain
+                and self._pending_cost / self._drain_rate > remain
             ):
                 return self._shed(entry, "deadline")
         # bounded ring: callers past the cap wait for drain progress instead
@@ -300,7 +352,8 @@ class Batcher:
                 return self._shed(entry, "queue_full")
         self._pending.append(entry)
         self._pending_rows += rows
-        self._bucket_rows[bucket] = self._bucket_rows.get(bucket, 0) + rows
+        self._pending_cost += cost
+        self._bucket_cost[bucket] = self._bucket_cost.get(bucket, 0) + cost
         self.admitted_by_tier[tier] += rows
         self._pending_bytes += (
             payload.nbytes if isinstance(payload, WireBatch) else 0
@@ -322,12 +375,22 @@ class Batcher:
     def _item_deadline(self) -> Optional[float]:
         """This enqueue's absolute monotonic deadline: the tighter of the
         overload knob and the inbound gRPC deadline (service/deadline.py);
-        None when neither applies — the legacy unbounded contract."""
-        knob = (
-            time.monotonic() + self.overload_deadline_s
-            if self.overload_deadline_s > 0
-            else None
-        )
+        None when neither applies — the legacy unbounded contract.
+
+        Auto mode (GUBER_OVERLOAD_DEADLINE_MS=auto) derives the knob per
+        enqueue: OVERLOAD_AUTO_DEADLINE_MULT × the runner's issue-stage
+        EWMA, floored at shed_retry_ms (and at any explicit ms value also
+        set). Re-evaluated every enqueue, so the door tracks the engine's
+        actual launch cost as load and batch shapes shift."""
+        knob_s = self.overload_deadline_s
+        if self.overload_deadline_auto:
+            knob_s = max(
+                knob_s,
+                self.shed_retry_ms / 1e3,
+                OVERLOAD_AUTO_DEADLINE_MULT
+                * getattr(self.runner, "issue_ewma", 0.0),
+            )
+        knob = time.monotonic() + knob_s if knob_s > 0 else None
         inbound = deadline_mod.inbound_deadline()
         if knob is None:
             return inbound
@@ -392,7 +455,8 @@ class Batcher:
         for v in chosen:
             self._pending.remove(v)
             self._pending_rows -= v.rows
-            self._drop_bucket_rows(v)
+            self._pending_cost -= v.cost
+            self._drop_bucket_cost(v)
             self._shed(v, "preempted")
         self._pending_bytes = sum(
             e.payload.nbytes
@@ -401,31 +465,32 @@ class Batcher:
         )
         return True
 
-    def _drop_bucket_rows(self, entry: _Entry) -> None:
-        left = self._bucket_rows.get(entry.bucket, 0) - entry.rows
+    def _drop_bucket_cost(self, entry: _Entry) -> None:
+        left = self._bucket_cost.get(entry.bucket, 0) - entry.cost
         if left > 0:
-            self._bucket_rows[entry.bucket] = left
+            self._bucket_cost[entry.bucket] = left
         else:
-            self._bucket_rows.pop(entry.bucket, None)
+            self._bucket_cost.pop(entry.bucket, None)
 
-    def _note_drained(self, rows: int) -> None:
-        """Fold one dispatch completion into the drain-rate EWMA."""
+    def _note_drained(self, cost: int) -> None:
+        """Fold one dispatch completion into the drain-rate EWMA (cost
+        units/s — the same units the queue-wait estimate divides by)."""
         now = time.monotonic()
         if self._drain_t == 0.0:
             self._drain_t = now
-            self._drain_rows = rows
+            self._drain_cost = cost
             return
-        self._drain_rows += rows
+        self._drain_cost += cost
         dt = now - self._drain_t
         if dt < 1e-4:
             return
-        inst = self._drain_rows / dt
+        inst = self._drain_cost / dt
         self._drain_rate = (
             inst if self._drain_rate == 0.0
             else 0.7 * self._drain_rate + 0.3 * inst
         )
         self._drain_t = now
-        self._drain_rows = 0
+        self._drain_cost = 0
 
     def _ensure_workers(self, loop) -> None:
         self._worker_tasks = [t for t in self._worker_tasks if not t.done()]
@@ -527,7 +592,8 @@ class Batcher:
                 break
             entry = self._pending.popleft()
             self._pending_rows -= entry.rows
-            self._drop_bucket_rows(entry)
+            self._pending_cost -= entry.cost
+            self._drop_bucket_cost(entry)
             if entry.deadline is not None and now > entry.deadline:
                 self._shed(entry, "deadline")
                 continue
@@ -619,7 +685,7 @@ class Batcher:
             return
         finally:
             self._inflight -= 1
-            self._note_drained(sum(e.rows for e in batch))
+            self._note_drained(sum(e.cost for e in batch))
             if self._full is not None:
                 # a slot freed: a worker holding its window open should
                 # re-evaluate — refilling the pipeline beats waiting
@@ -675,7 +741,7 @@ class Batcher:
         latency. Per-entry deadlines are stamped at enqueue, so flipping
         between windows never retro-affects queued items."""
         self.overload_deadline_s = max(0.0, deadline_ms) / 1e3
-        self.armed = self.overload_deadline_s > 0
+        self.armed = self.overload_deadline_s > 0 or self.overload_deadline_auto
 
     def debug(self) -> dict:
         """Live front-door state for /v1/debug/pipeline (docs/observability.md):
@@ -684,6 +750,7 @@ class Batcher:
         return {
             "pending_requests": len(self._pending),
             "pending_rows": self._pending_rows,
+            "pending_cost": self._pending_cost,
             "pending_bytes": self._pending_bytes,
             "inflight": self._inflight,
             "workers": self.workers,
@@ -704,13 +771,14 @@ class Batcher:
             "close_reasons": dict(self.close_reasons),
             "overload_armed": self.armed,
             "overload_deadline_ms": self.overload_deadline_s * 1e3,
+            "overload_deadline_auto": self.overload_deadline_auto,
             "tenant_share": self.tenant_share,
             "tenant_buckets": self.tenant_buckets,
             "shed_rows": dict(self.shed_rows),
             "shed_by_tier": list(self.shed_by_tier),
             "admitted_by_tier": list(self.admitted_by_tier),
             "priority_inversions": self.priority_inversions,
-            "drain_rate_rows_per_s": self._drain_rate,
+            "drain_rate_cost_per_s": self._drain_rate,
             "closed": self._closed,
         }
 

@@ -166,12 +166,21 @@ class Daemon:
         # device ring protocol over the same runner surface
         self.ring = None
         if conf.behaviors.ring_enable:
+            from gubernator_tpu.ops.ring_drain import default_ring_issue
             from gubernator_tpu.service.ring import RequestRing
 
+            ring_issue = conf.behaviors.ring_issue
+            if ring_issue == "auto":
+                # fused drain on real TPU, host issue loop on CPU builds
+                # (docs/latency.md "Launch budget")
+                ring_issue = default_ring_issue()
             self.ring = RequestRing(
                 self.runner,
                 slots=conf.behaviors.ring_slots,
                 metrics=self.metrics,
+                issue_mode=ring_issue,
+                drain_k=conf.behaviors.ring_drain_k,
+                slot_width=conf.behaviors.ring_slot_width,
             )
         self.batcher = Batcher(
             self.runner,
@@ -186,6 +195,7 @@ class Daemon:
             max_queue_rows=conf.behaviors.batch_queue_rows,
             ring=self.ring,
             overload_deadline_ms=conf.behaviors.overload_deadline_ms,
+            overload_deadline_auto=conf.behaviors.overload_deadline_auto,
             tenant_share=conf.behaviors.overload_tenant_share,
             tenant_buckets=conf.behaviors.overload_tenant_buckets,
             shed_retry_ms=conf.behaviors.overload_retry_ms,

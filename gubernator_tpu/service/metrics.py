@@ -211,11 +211,13 @@ class DaemonMetrics:
         self.dispatch_launches = Counter(
             # renders as gubernator_tpu_dispatch_launches_total
             "gubernator_tpu_dispatch_launches",
-            "Decision-kernel launches by feed path: ring = fed from the "
-            "device-resident request ring's persistent serving loop "
-            "(service/ring.py), xla = the direct per-flush dispatch "
-            "round-trip (docs/latency.md 'Dispatch budget')",
-            ["path"],  # ring | xla
+            "Decision-kernel launches by feed path: ring = per-slot "
+            "dispatches from the request ring's host issue loop "
+            "(service/ring.py), fused = multi-slot drain launches that "
+            "retire up to GUBER_RING_DRAIN_K published slots each "
+            "(ops/ring_drain.py), xla = the direct per-flush dispatch "
+            "round-trip (docs/latency.md 'Launch budget')",
+            ["path"],  # ring | fused | xla
             registry=r,
         )
         self.ring_occupancy = Gauge(
@@ -224,6 +226,14 @@ class DaemonMetrics:
             "by GUBER_RING_SLOTS; sustained saturation means submitters "
             "are in backpressure and the serving loop is the bottleneck",
             registry=r,
+        )
+        self.ring_drain_slots = Histogram(
+            "gubernator_tpu_ring_drain_slots",
+            "Published ring slots retired per fused drain launch — "
+            "_sum/_count is the scrapeable launch-amortization factor "
+            "(slots/launch; docs/latency.md 'Launch budget')",
+            registry=r,
+            buckets=(1, 2, 4, 8, 16, 32, 64),
         )
         self.dispatch_duration = Histogram(
             "gubernator_tpu_dispatch_duration",

@@ -34,13 +34,36 @@ in sequence), but the finish half of each dispatch overlaps the next
 ticket's issue through the runner's own prepare/issue/finish pipeline —
 the ring serializes LAUNCH ORDER, not completion latency.
 
+The CONSUME side has three tiers behind GUBER_RING_ISSUE (docs/latency.md
+"Launch budget"):
+
+* **host** — the original loop: one runner dispatch (one XLA launch) per
+  published slot. The CPU default and the byte-parity oracle.
+* **fused** — the device-resident drain (ops/ring_drain.py): slots and
+  fence words live in device buffers, and ONE jitted while_loop launch
+  decides up to GUBER_RING_DRAIN_K consecutively published slots with the
+  donated table in the carry, amortizing the launch round-trip K×. Slots
+  the fused path can't take (duplicate keys, non-encodable rows, chunks
+  wider than the slot) ride the per-slot host path in ticket order —
+  byte-identical either way. The TPU default.
+* **persistent** — staged for the TPU run: the Pallas fence-claim kernel
+  (ops/ring_drain.fence_claim, interpreter-mode parity-tested) replaces
+  the host's claim loop so steady state pays zero XLA launches; until the
+  device run validates the resident loop this mode runs the fused drain
+  with a watchdog that re-launches a failed drain once (preemption cover)
+  and counts `watchdog_relaunches`.
+
 Knobs: GUBER_RING_ENABLE turns the plane on (service/daemon.py routes
-all-wire flushes here), GUBER_RING_SLOTS sizes the ring. Metrics:
-gubernator_tpu_dispatch_launches_total{path="ring"|"xla"} splits launch
-counts by feed path, gubernator_tpu_ring_occupancy gauges published-but-
-unconsumed slots, and the ring_put / ring_poll stage_duration labels time
-the submit-side staging and the egress-fence wait (docs/latency.md
-"Dispatch budget").
+all-wire flushes here), GUBER_RING_SLOTS sizes the ring, GUBER_RING_ISSUE
+picks the consume tier, GUBER_RING_DRAIN_K bounds slots per fused launch,
+GUBER_RING_SLOT_WIDTH fixes the device slot width (0 = auto-size to the
+first fused chunk). Metrics:
+gubernator_tpu_dispatch_launches_total{path="ring"|"fused"|"xla"} splits
+launch counts by feed path, gubernator_tpu_ring_drain_slots records
+published slots retired per fused launch (the scrapeable amortization
+factor), gubernator_tpu_ring_occupancy gauges published-but-unconsumed
+slots, and the ring_put / ring_poll stage_duration labels time the
+submit-side staging and the egress-fence wait.
 """
 
 from __future__ import annotations
@@ -69,12 +92,27 @@ class RequestRing:
     `t`; fence value `t + 1` (never 0, so an unused slot is unambiguous).
     """
 
-    def __init__(self, runner, slots: int = 64, metrics=None):
+    def __init__(self, runner, slots: int = 64, metrics=None,
+                 issue_mode: str = "host", drain_k: int = 8,
+                 slot_width: int = 0):
         if slots < 2:
             raise ValueError("RequestRing needs at least 2 slots")
+        if issue_mode not in ("host", "fused", "persistent"):
+            raise ValueError(
+                f"GUBER_RING_ISSUE must be host|fused|persistent, "
+                f"got {issue_mode!r}"
+            )
+        if drain_k < 1:
+            raise ValueError("GUBER_RING_DRAIN_K must be >= 1")
         self.runner = runner
         self.slots = int(slots)
         self.metrics = metrics
+        self.issue_mode = issue_mode
+        self.drain_k = int(min(drain_k, slots))
+        # fixed device slot width (rows); 0 = auto-size to the first fused
+        # chunk's padded size (wider chunks then ride the host path)
+        self.slot_width = int(slot_width)
+        self._dring = None  # ops/ring_drain.DeviceRing, fused tiers only
         self.seq_in = np.zeros(self.slots, dtype=np.int64)
         self.seq_out = np.zeros(self.slots, dtype=np.int64)
         # slot payload staging (the emulation's stand-in for the DMA'd
@@ -94,10 +132,15 @@ class RequestRing:
         self._inorder: Optional[asyncio.Queue] = None
         self._closed = False
         # introspection counters (ring_smoke + /v1/debug/pipeline)
-        self.launches = 0  # dispatches fed from the ring
+        self.launches = 0  # tickets retired through the ring
         self.fallbacks = 0  # non-fusable slots that rode the columns path
         self.backpressure_waits = 0  # submits that found the ring full
         self.max_occupancy = 0
+        # fused-tier counters (ring_drain_smoke + /v1/debug/pipeline)
+        self.drain_launches = 0  # fused drain launches (XLA launches)
+        self.drained_slots = 0  # tickets retired by fused drains
+        self.host_slots = 0  # fused-ineligible tickets (per-slot path)
+        self.watchdog_relaunches = 0  # persistent-tier drain re-launches
 
     # ------------------------------------------------------------ lifecycle
     def _ensure_started(self) -> None:
@@ -109,8 +152,11 @@ class RequestRing:
         self._space = asyncio.Event()
         self._drained = asyncio.Event()
         self._inorder = asyncio.Queue()
-        self._issue_task = loop.create_task(self._issue_loop(),
-                                            name="ring-issue")
+        consume = (
+            self._issue_loop if self.issue_mode == "host"
+            else self._issue_loop_fused
+        )
+        self._issue_task = loop.create_task(consume(), name="ring-issue")
         self._finish_task = loop.create_task(self._finish_loop(),
                                              name="ring-finish")
 
@@ -203,34 +249,181 @@ class RequestRing:
             parts, span = self._staged[slot]
             self._staged[slot] = None
             await self._inorder.put(
-                (t, loop.create_task(self._dispatch(parts, span)))
+                ([t], loop.create_task(self._dispatch(parts, span)))
             )
             t += 1
 
+    # ------------------------------------------------- fused consume tier
+    def _prepare_slot(self, parts, span):
+        """Prep-pool half of one fused slot: assemble the fixed-width wire
+        grid + PendingCheck (ops/engine.prepare_ring_slot). None routes
+        the chunk to the per-slot host path. Auto-sizes the device ring on
+        the first fusable chunk when GUBER_RING_SLOT_WIDTH=0."""
+        import time as _time
+
+        from gubernator_tpu.ops.engine import _pad_size, prepare_ring_slot
+
+        engine = self.runner.engine
+        if self._dring is None and self.slot_width == 0:
+            # first fused chunk sizes the slots: wide enough for its own
+            # padded dispatch, floored so ordinary coalesced flushes fit
+            n = sum(p.cols.fp.shape[0] for p in parts)
+            self.slot_width = max(64, _pad_size(n))
+        t0 = _time.perf_counter()
+        prep = prepare_ring_slot(engine, parts, self.slot_width)
+        if prep is not None:
+            self.runner._observe_stage("put", t0, span)
+            for p in parts:
+                self.runner._count_decisions(p.cols.algo)
+        return prep
+
+    def _ensure_dring(self):
+        if self._dring is None:
+            from gubernator_tpu.ops.ring_drain import DeviceRing
+
+            engine = self.runner.engine
+            self._dring = DeviceRing(
+                self.slots, self.slot_width, self.drain_k,
+                evictees=bool(getattr(engine, "_evictees", False)),
+            )
+        return self._dring
+
+    async def _fail(self, exc):
+        raise exc
+
+    async def _issue_loop_fused(self) -> None:
+        """Fused consume loop (GUBER_RING_ISSUE=fused|persistent): walk
+        tickets strictly in order, group consecutively published fusable
+        slots that share the drain graph's static modes (math, cascade),
+        and retire each group with ONE device drain launch
+        (ops/ring_drain.drain_ring). Launch order across groups — and
+        across the interleaved per-slot host dispatches — stays strict
+        ticket order, the byte-parity contract; each group's finish
+        overlaps the next group's prepare/issue through the runner's fetch
+        pool, same as the host tier."""
+        t = 0
+        loop = asyncio.get_running_loop()
+        while True:
+            while t >= self._head:
+                if self._closed:
+                    await self._inorder.put(None)  # finish-loop sentinel
+                    return
+                self._published.clear()
+                if t < self._head:  # raced a publish
+                    break
+                await self._published.wait()
+            # lift every currently published ticket, up to one drain's worth
+            todo = []
+            while t < self._head and len(todo) < self.drain_k:
+                slot = t % self.slots
+                assert int(self.seq_in[slot]) == t + 1, (
+                    f"ring fence violation: slot {slot} has seq "
+                    f"{int(self.seq_in[slot])}, expected {t + 1}"
+                )
+                parts, span = self._staged[slot]
+                self._staged[slot] = None
+                todo.append((t, parts, span))
+                t += 1
+            preps = await asyncio.gather(*(
+                loop.run_in_executor(
+                    self.runner._prep, self._prepare_slot, parts, span
+                )
+                for _t, parts, span in todo
+            ))
+            i = 0
+            while i < len(todo):
+                if preps[i] is None:
+                    # fused-ineligible: per-slot host dispatch. Awaited in
+                    # full (not pipelined) so a following fused drain can
+                    # never launch before this earlier ticket's dispatch —
+                    # strict launch order is what byte-parity rests on.
+                    tk, parts, span = todo[i]
+                    self.host_slots += 1
+                    task = loop.create_task(self._dispatch(parts, span))
+                    await asyncio.wait({task})
+                    await self._inorder.put(([tk], task))
+                    i += 1
+                    continue
+                j = i + 1
+                while (
+                    j < len(todo)
+                    and preps[j] is not None
+                    and preps[j].math == preps[i].math
+                    and preps[j].cascade == preps[i].cascade
+                    # a slot with shadow fault-backs must HEAD its group:
+                    # its promote-merge precedes the whole launch, so any
+                    # earlier slot in the same drain would decide against
+                    # post-merge state the per-slot path never saw
+                    and preps[j].pending.promote is None
+                ):
+                    j += 1
+                group = [preps[x] for x in range(i, j)]
+                tickets = [todo[x][0] for x in range(i, j)]
+                span = todo[i][2]
+                try:
+                    bank, n = await self.runner.drain_ring_issue(
+                        self._ensure_dring(), group, tickets[0], span=span
+                    )
+                except Exception as exc:
+                    if self.issue_mode == "persistent":
+                        # watchdog: a preempted/failed drain re-launches
+                        # once before the group is failed out
+                        self.watchdog_relaunches += 1
+                        try:
+                            bank, n = await self.runner.drain_ring_issue(
+                                self._ensure_dring(), group, tickets[0],
+                                span=span,
+                            )
+                        except Exception as exc2:
+                            await self._inorder.put(
+                                (tickets, loop.create_task(self._fail(exc2)))
+                            )
+                            i = j
+                            continue
+                    else:
+                        await self._inorder.put(
+                            (tickets, loop.create_task(self._fail(exc)))
+                        )
+                        i = j
+                        continue
+                self.drain_launches += 1
+                self.drained_slots += len(group)
+                task = loop.create_task(
+                    self.runner.drain_ring_finish(group, bank, n, span=span)
+                )
+                await self._inorder.put((tickets, task))
+                i = j
+
     async def _finish_loop(self) -> None:
-        """Retire tickets in order: await each dispatch, publish the egress
-        fence, resolve the submitter's poll, free the slot."""
+        """Retire tickets in order: await each dispatch (one ticket on the
+        host/fallback path, a whole drain group on the fused path),
+        publish the egress fences, resolve the submitters' polls, free the
+        slots."""
         while True:
             item = await self._inorder.get()
             if item is None:
                 self._drained.set()
                 return
-            t, task = item
-            slot = t % self.slots
-            fut = self._done.get(t)
+            tickets, task = item
             try:
                 rc = await task
             except Exception as exc:  # pragma: no cover - defensive
-                if fut is not None and not fut.done():
-                    fut.set_exception(exc)
+                results = [exc] * len(tickets)
             else:
+                results = rc if isinstance(rc, list) else [rc]
+            for t, res in zip(tickets, results):
+                slot = t % self.slots
+                fut = self._done.get(t)
                 if fut is not None and not fut.done():
-                    fut.set_result(rc)
-            self.launches += 1
-            # egress fence AFTER the result is materialized — the order the
-            # submitter's poll relies on
-            self.seq_out[slot] = t + 1
-            self._consumed = t + 1
+                    if isinstance(res, Exception):
+                        fut.set_exception(res)
+                    else:
+                        fut.set_result(res)
+                self.launches += 1
+                # egress fence AFTER the result is materialized — the
+                # order the submitter's poll relies on
+                self.seq_out[slot] = t + 1
+                self._consumed = t + 1
             self._set_occupancy()
             self._space.set()
 
@@ -265,4 +458,11 @@ class RequestRing:
             "backpressure_waits": self.backpressure_waits,
             "max_occupancy": self.max_occupancy,
             "closed": self._closed,
+            "issue_mode": self.issue_mode,
+            "drain_k": self.drain_k,
+            "slot_width": self.slot_width,
+            "drain_launches": self.drain_launches,
+            "drained_slots": self.drained_slots,
+            "host_slots": self.host_slots,
+            "watchdog_relaunches": self.watchdog_relaunches,
         }

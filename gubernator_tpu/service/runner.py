@@ -73,6 +73,12 @@ class EngineRunner:
         # cumulative per-algorithm decision counts (the debug-plane mirror
         # of gubernator_tpu_decisions_total; /v1/debug/pipeline)
         self.algo_counts = {k: 0 for k in _ALGO_LABELS}
+        # EWMA of the issue stage (seconds) — the device-launch half of a
+        # dispatch. The batcher's auto overload deadline
+        # (GUBER_OVERLOAD_DEADLINE_MS=auto) is derived from this: a queue
+        # estimate denominated in what a launch actually costs on THIS
+        # deployment, not a hand-tuned wall-clock guess.
+        self.issue_ewma = 0.0
 
     def _count_decisions(self, algo_col) -> None:
         """Per-algorithm decision accounting (the
@@ -180,6 +186,11 @@ class EngineRunner:
         under the dispatch span. Wall-clock ns for the span are derived
         from the same perf_counter interval the histogram measured."""
         dt = time.perf_counter() - t0
+        if stage == "issue":
+            self.issue_ewma = (
+                dt if self.issue_ewma == 0.0
+                else 0.9 * self.issue_ewma + 0.1 * dt
+            )
         if self.metrics is not None:
             self.metrics.stage_duration.labels(stage=stage).observe(
                 dt, exemplar=_exemplar(span)
@@ -247,6 +258,108 @@ class EngineRunner:
 
         pending = await loop.run_in_executor(self._exec, lambda: issue(prepared))
         return await loop.run_in_executor(self._fetch, lambda: finish(pending))
+
+    # ------------------------------------------------- fused ring drain
+    # (ops/ring_drain.py) — the multi-slot twin of _issue_and_finish: one
+    # ENGINE-THREAD launch decides a whole group of published ring slots,
+    # one FETCH-THREAD materialization decodes every slot's egress bank.
+    # Split into two awaitables (not one) so the ring's consume loop can
+    # serialize LAUNCH order across groups while group j's finish overlaps
+    # group j+1's issue — the same pipelining shape the host issue loop has.
+
+    async def drain_ring_issue(self, dring, group, start: int, span=None):
+        """ENGINE-THREAD half of one fused drain: per-slot issue-time work
+        (shadow promote for the group head, checkpoint marks) in ticket
+        order, stage each slot's grid + ingress fence into the device ring,
+        then ONE `drain_ring` launch over the whole group. Returns the
+        un-fetched (bank, drained) device handles."""
+        loop = asyncio.get_running_loop()
+
+        def issue():
+            t0 = time.perf_counter()
+            from gubernator_tpu.ops.engine import promote_rows
+
+            engine = self.engine
+            for prep in group:
+                pending = prep.pending
+                if pending.promote is not None:
+                    # shadow fault-back through the conservative merge
+                    # BEFORE the drain launch — grouping guarantees only
+                    # the HEAD slot carries a promote, so merge→decide
+                    # order matches the per-slot path exactly
+                    _, pending.promote_putback = promote_rows(
+                        engine, pending.promote, pending.now
+                    )
+                    pending.promote = None
+                if (
+                    pending.mark is not None
+                    and getattr(engine, "ckpt", None) is not None
+                ):
+                    engine.ckpt.mark(pending.mark)
+            head = group[0]
+            if engine._batch_needs_full(head.math):
+                engine.migrate_layout_full()
+            engine._seen_pad_sizes.add(dring.width)
+            engine.last_dispatch_rows = dring.width
+            for i, prep in enumerate(group):
+                dring.stage((start + i) % dring.slots, prep.grid, start + i)
+            bank, n = dring.drain(
+                engine, start, len(group), head.math, head.cascade
+            )
+            self._observe_stage("issue", t0, span)
+            if self.metrics is not None:
+                self.metrics.dispatch_launches.labels(path="fused").inc()
+                self.metrics.ring_drain_slots.observe(len(group))
+            return bank, n
+
+        return await loop.run_in_executor(self._exec, issue)
+
+    async def drain_ring_finish(self, group, bank, n, span=None):
+        """FETCH-THREAD half of one fused drain: ONE bank fetch covers the
+        whole group; each slot's PendingCheck then runs the standard
+        finish (dropped-claim retries and shadow rehydrates via the engine
+        thread, evictee harvest, cascade folds) over its egress slice.
+        Returns the per-slot responses in ticket order."""
+        loop = asyncio.get_running_loop()
+
+        def fixup(fn):
+            return self._exec.submit(fn).result()
+
+        def finish():
+            t0 = time.perf_counter()
+            from gubernator_tpu.ops.engine import finish_check_columns
+
+            fetched = np.asarray(bank)
+            drained = int(n)
+            if drained != len(group):
+                raise RuntimeError(
+                    f"ring drain fence violation: group of {len(group)} "
+                    f"published slots, device retired {drained}"
+                )
+            done = []
+            for i, prep in enumerate(group):
+                pending = prep.pending
+                pending.passes[0][3] = fetched[i]
+                done.append(finish_check_columns(self.engine, pending, fixup))
+            self._observe_stage("fetch", t0, span)
+
+            def apply():
+                for _rc, delta in done:
+                    self.engine.stats.merge(delta)
+                if self.metrics is not None:
+                    self.metrics.dispatch_duration.observe(
+                        time.perf_counter() - t0
+                    )
+                    self.metrics.observe_engine(self.engine.stats)
+                    self._observe_probe_bytes()
+                    gs = getattr(self.engine, "global_stats", None)
+                    if gs is not None:
+                        self.metrics.observe_global(gs)
+
+            self._exec.submit(apply)  # fire-and-forget, engine thread
+            return [rc for rc, _delta in done]
+
+        return await loop.run_in_executor(self._fetch, finish)
 
     def _observe_shard_stages(self) -> None:
         """Fold the mesh engine's host-staging split (route/pack/put ms

@@ -33,7 +33,7 @@ from gubernator_tpu.proto import gubernator_pb2 as pb
 from gubernator_tpu.service import deadline as deadline_mod
 from gubernator_tpu.service.batcher import Batcher
 from gubernator_tpu.service.daemon import Daemon
-from gubernator_tpu.types import priority_tier, with_priority
+from gubernator_tpu.types import priority_tier, with_cascade_level, with_priority
 
 from tests.cluster import daemon_config
 
@@ -234,6 +234,99 @@ async def test_tier_rides_wire_and_dispatch_order():
     assert runner.dispatch_tiers == [0, 2, 0]
     assert b.admitted_by_tier[2] == 16 and b.admitted_by_tier[0] == 32
     assert b.priority_inversions == 0
+    await b.drain()
+
+
+def _cascade_cols(rows: int, level: int, base: int = 0, fp0: int = 0) -> RequestColumns:
+    """A column batch whose rows each carry `level` cascade levels — the
+    expensive traffic the cost-weighted door must account at more than one
+    unit per row."""
+    c = _cols(rows, base=base, fp0=fp0)
+    return c._replace(
+        behavior=np.full(rows, with_cascade_level(0, level), dtype=np.int32)
+    )
+
+
+@async_test
+async def test_cost_weighted_fairness_stops_cascade_starvation():
+    """Equal ROW budgets, unequal device cost: a cascade-heavy tenant
+    (level-3 rows ≈ 4 kernel rows each) exhausts its fairness share by
+    COST and sheds, while the cheap single-row tenant keeps being
+    admitted. The control run — identical row counts, no cascades — never
+    pressures the door, proving it was the cost weighting (not the row
+    counts) that capped the abuser."""
+    runner = GatedRunner()
+    b = Batcher(
+        runner, batch_wait_ms=0.5, coalesce_limit=128, workers=1,
+        adaptive=True, max_queue_rows=128, overload_deadline_ms=5_000.0,
+        tenant_share=0.25, tenant_buckets=64,
+    )
+    first = asyncio.ensure_future(b.check(_cols(16)))
+    await asyncio.sleep(0.05)  # worker picked it up; engine gated
+    # cascade tenant (bucket 5): 16 rows × (1 + 3 levels) = 64 cost units
+    # — only 16 ROWS, an eighth of the ring, but half its cost capacity
+    casc1 = asyncio.ensure_future(b.check(_cascade_cols(16, 3, base=1_000, fp0=5)))
+    await asyncio.sleep(0.05)  # 64 pending cost = half the ring → pressured
+    # 8 more cascade rows = 32 cost: bucket 5 would hold 96 > share (32)
+    casc2 = asyncio.ensure_future(b.check(_cascade_cols(8, 3, base=2_000, fp0=5)))
+    # the cheap tenant (bucket 7) stays admissible under the same pressure
+    victim = asyncio.ensure_future(b.check(_cols(16, base=3_000, fp0=7)))
+    await asyncio.sleep(0.05)
+    runner.gate.set()
+    r1, rc1, rc2, rv = await asyncio.gather(first, casc1, casc2, victim)
+    assert _served_all(r1) and _served_all(rc1)
+    assert _shed_all(rc2), "cascade tenant beyond its COST share must shed"
+    assert _served_all(rv), "cheap single-row traffic must not starve"
+    assert b.shed_rows["fairness"] == 8
+    assert b.priority_inversions == 0
+    await b.drain()
+
+    # control: the SAME row counts without cascade levels never even
+    # pressure the door (16+8 rows ≪ the 64-row pressure point) — under
+    # the old row-weighted accounting the abuser above was this invisible
+    runner2 = GatedRunner()
+    b2 = Batcher(
+        runner2, batch_wait_ms=0.5, coalesce_limit=128, workers=1,
+        adaptive=True, max_queue_rows=128, overload_deadline_ms=5_000.0,
+        tenant_share=0.25, tenant_buckets=64,
+    )
+    first2 = asyncio.ensure_future(b2.check(_cols(16)))
+    await asyncio.sleep(0.05)
+    p1 = asyncio.ensure_future(b2.check(_cols(16, base=1_000, fp0=5)))
+    await asyncio.sleep(0.05)
+    p2 = asyncio.ensure_future(b2.check(_cols(8, base=2_000, fp0=5)))
+    await asyncio.sleep(0.05)
+    runner2.gate.set()
+    rf, rp1, rp2 = await asyncio.gather(first2, p1, p2)
+    assert _served_all(rf) and _served_all(rp1) and _served_all(rp2)
+    assert b2.shed_rows["fairness"] == 0
+    await b2.drain()
+
+
+@async_test
+async def test_auto_deadline_tracks_issue_ewma():
+    """GUBER_OVERLOAD_DEADLINE_MS=auto arms the door with a deadline
+    derived from the runner's issue-stage EWMA
+    (OVERLOAD_AUTO_DEADLINE_MULT × issue_ewma, floored at shed_retry_ms)
+    — re-evaluated per enqueue as the EWMA moves."""
+    from gubernator_tpu.service.batcher import OVERLOAD_AUTO_DEADLINE_MULT
+
+    runner = GatedRunner()
+    b = Batcher(
+        runner, batch_wait_ms=0.5, coalesce_limit=64, workers=1,
+        adaptive=True, max_queue_rows=1024, overload_deadline_auto=True,
+        shed_retry_ms=25,
+    )
+    assert b.armed  # auto arms the full overload plane
+    # no EWMA yet (cold runner): the shed_retry floor keeps the door sane
+    d0 = b._item_deadline()
+    assert d0 is not None
+    assert abs((d0 - time.monotonic()) - 0.025) < 0.01
+    # a measured issue stage moves the deadline with it
+    runner.issue_ewma = 0.002
+    d1 = b._item_deadline()
+    want = OVERLOAD_AUTO_DEADLINE_MULT * 0.002
+    assert abs((d1 - time.monotonic()) - want) < 0.05
     await b.drain()
 
 
